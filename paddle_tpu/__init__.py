@@ -1,0 +1,109 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Not a port: the reference's C++ kernel library, CUDA kernels, executors and
+CINN compiler are all *absorbed by XLA* (see SURVEY.md §7 design stance);
+this package is the framework shell — imperative tensor/autograd UX, nn/
+optimizer/data APIs, the Fleet distributed stack mapped onto jax.sharding
+meshes, and Pallas kernels for the fused hot ops.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import ops as _ops_ns
+from .core import dtypes as _dtypes
+from .core import tensor as _tensor_mod
+from .core.device import (
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.dtypes import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.tape import is_grad_enabled, no_grad, set_grad_enabled
+from .core.tensor import Parameter, Tensor, is_tensor
+
+# wire the ops namespace into Tensor dunders
+_tensor_mod._bind_ops(_ops_ns)
+
+# lift every op to the top-level namespace (paddle.add, paddle.reshape, ...)
+from .ops import *  # noqa: F401,F403
+
+from . import autograd  # noqa: E402
+from .autograd import grad  # noqa: E402
+from .autograd.backward import backward as _backward_multi  # noqa: E402,F401
+
+# ---------------------------------------------------------------------------
+# Tensor method binding: every op whose first arg is a tensor becomes a method
+_TENSOR_METHODS = (
+    "add subtract multiply divide floor_divide mod remainder pow maximum "
+    "minimum fmax fmin atan2 sqrt rsqrt exp expm1 log log2 log10 log1p abs "
+    "neg sign sin cos tan asin acos atan sinh cosh tanh asinh acosh atanh "
+    "erf erfinv floor ceil round trunc frac reciprocal square sigmoid "
+    "isfinite isinf isnan scale clip lerp nan_to_num matmul mm bmm dot inner "
+    "outer addmm kron cross cumsum cumprod logsumexp logcumsumexp logaddexp "
+    "trace diff sum mean prod max min amax amin all any nanmean nansum "
+    "median nanmedian std var count_nonzero quantile cast reshape reshape_ "
+    "transpose t swapaxes moveaxis flatten squeeze squeeze_ unsqueeze "
+    "unsqueeze_ split chunk unbind tile expand broadcast_to expand_as flip "
+    "roll repeat_interleave tril triu diag diagonal gather gather_nd "
+    "index_select index_sample take_along_axis put_along_axis scatter "
+    "scatter_nd_add masked_fill masked_select where unique argmax argmin "
+    "argsort sort topk kthvalue mode nonzero searchsorted equal not_equal "
+    "less_than less_equal greater_than greater_equal logical_and logical_or "
+    "logical_xor logical_not bitwise_and bitwise_or bitwise_xor bitwise_not "
+    "isclose allclose equal_all norm det inv pinv cholesky matrix_power "
+    "slice pad index_put"
+).split()
+
+for _name in _TENSOR_METHODS:
+    _fn = getattr(_ops_ns, _name, None)
+    if _fn is not None and not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _fn)
+
+# paddle-compat static-mode switches (static graph == jax.jit here; these are
+# retained as no-ops so reference scripts run unmodified)
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for the "
+        "compiled path (whole-step jax.jit)."
+    )
+
+
+def disable_static():
+    return None
+
+
+def in_dynamic_mode():
+    return True
+
+
+def disable_signal_handler():
+    return None
+
+
+# subsystem namespaces — extended as subsystems land (build order: SURVEY §7)
+from . import linalg  # noqa: E402

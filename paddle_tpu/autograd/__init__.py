@@ -1,0 +1,18 @@
+"""paddle_tpu.autograd — imperative autograd API over jax VJPs.
+
+Reference parity: python/paddle/autograd/ (unverified, mount empty).
+"""
+from ..core.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .backward import backward, grad, run_backward
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "backward",
+    "grad",
+    "PyLayer",
+    "PyLayerContext",
+]

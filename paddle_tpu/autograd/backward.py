@@ -1,0 +1,265 @@
+"""Reverse-topological backward executor.
+
+Reference parity: egr::RunBackward's ready-queue walk over GradNodes
+(reference: paddle/fluid/eager/backward.cc — unverified, mount empty).
+Differences by design: grad "kernels" are jax VJP closures (XLA-compiled on
+use), so this walker is pure scheduling — cotangent bookkeeping, hook firing,
+leaf accumulation, and graph release. It runs identically on concrete arrays
+(eager) and on tracers (when a whole step containing .backward() is jitted).
+"""
+from __future__ import annotations
+
+from collections import defaultdict, deque
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+def _as_value(g):
+    return g.value if isinstance(g, Tensor) else g
+
+
+def _collect_graph(root_nodes):
+    """DFS the producer graph; return (reachable nodes, edge counts).
+
+    pending[n] = number of input-edges from reachable consumer nodes into n.
+    """
+    pending = defaultdict(int)
+    seen, stack, nodes = set(), list(root_nodes), []
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        nodes.append(n)
+        for inp in n.inputs:
+            p = inp._node
+            if p is not None:
+                pending[id(p)] += 1
+                if id(p) not in seen:
+                    stack.append(p)
+    return nodes, pending
+
+
+def _fire_hooks(tensor, ct):
+    if tensor._hooks:
+        g = Tensor(ct)
+        for hook in list(tensor._hooks):
+            r = hook(g)
+            if r is not None:
+                g = r if isinstance(r, Tensor) else Tensor(r)
+        ct = g.value
+    return ct
+
+
+def _engine(root_pairs, retain_graph, accumulate_fn):
+    """Shared walker. root_pairs: [(tensor, cotangent_value)].
+
+    accumulate_fn(tensor, ct_value) is called for every tensor that receives
+    a final cotangent (leaves, retain_grad tensors, and requested targets).
+    """
+    ct_map = {}  # id(tensor) -> cotangent value
+    alive = {}  # id(tensor) -> tensor (keep targets alive)
+
+    root_nodes = []
+    for t, ct in root_pairs:
+        if id(t) in ct_map:
+            ct_map[id(t)] = ct_map[id(t)] + ct
+        else:
+            ct_map[id(t)] = ct
+        alive[id(t)] = t
+        # leaf roots are finalized below with everything else; roots with a
+        # producer get their ct consumed (and hooks fired) when it runs
+        if t._node is not None:
+            root_nodes.append(t._node)
+
+    nodes, pending = _collect_graph(root_nodes)
+    queue = deque(n for n in nodes if pending[id(n)] == 0)
+    processed = set()
+
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"GradNode<{node.name}> was already released; call "
+                "backward(retain_graph=True) to backprop twice through the "
+                "same graph."
+            )
+        # gather output cotangents (zeros where no contribution arrived)
+        cts = []
+        for i, (shape, dtype) in enumerate(node.out_meta):
+            ref = node.out_refs[i]
+            t = ref() if ref is not None else None
+            ct = None if t is None else ct_map.pop(id(t), None)
+            if ct is not None and t is not None:
+                # the tensor's gradient is now fully accumulated: hooks fire
+                # exactly once, on the final value (paddle semantics)
+                ct = _fire_hooks(t, ct)
+                if t._retain_grad:
+                    accumulate_fn(t, ct)
+            if ct is None:
+                ct = dispatch.zero_cotangent(shape, dtype)
+            cts.append(ct)
+        out_ct = tuple(cts) if node.multi else cts[0]
+        in_cts = node.vjp_fn(out_ct)
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        if len(in_cts) != len(node.inputs):
+            raise RuntimeError(
+                f"GradNode<{node.name}> returned {len(in_cts)} grads for "
+                f"{len(node.inputs)} inputs"
+            )
+        for inp, ct in zip(node.inputs, in_cts):
+            # a None cotangent (custom vjp "no grad") still consumes the
+            # graph edge — the pending decrement must happen regardless, or
+            # the producer stalls and upstream grads silently vanish
+            if ct is not None:
+                key = id(inp)
+                if key in ct_map:
+                    ct_map[key] = ct_map[key] + ct
+                else:
+                    ct_map[key] = ct
+                alive[key] = inp
+            p = inp._node
+            if p is not None:
+                pending[id(p)] -= 1
+                if pending[id(p)] == 0:
+                    queue.append(p)
+        if not retain_graph:
+            node.release()
+
+    # finalize: every tensor still holding a cotangent is a leaf (or a
+    # retain_grad intermediate whose ct was never popped — popped cts were
+    # consumed by their producer node above).
+    for key, ct in ct_map.items():
+        t = alive[key]
+        accumulate_fn(t, _fire_hooks(t, ct))
+
+
+def run_backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward(): accumulate .grad on leaves (paddle semantics)."""
+    if tensor.stop_gradient and tensor._node is None:
+        raise RuntimeError(
+            "backward() on a tensor with stop_gradient=True and no grad graph"
+        )
+    if grad_tensor is None:
+        ct = jnp.ones(tensor.value.shape, tensor.value.dtype)
+    else:
+        ct = _as_value(grad_tensor)
+        ct = jnp.broadcast_to(jnp.asarray(ct, tensor.value.dtype),
+                              tensor.value.shape)
+
+    def accumulate(t, ct_val):
+        if t.stop_gradient and not t._retain_grad:
+            return
+        if t._node is not None and not t._retain_grad:
+            return  # non-leaf grads not retained by default (paddle parity)
+        g = Tensor(ct_val)
+        if t.grad is None:
+            t.grad = g
+        else:
+            t.grad = Tensor(t.grad.value + ct_val)
+
+    _engine([(tensor, ct)], retain_graph, accumulate)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity (multiple roots)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    pairs = []
+    for t, g in zip(tensors, grad_tensors):
+        ct = (
+            jnp.ones(t.value.shape, t.value.dtype)
+            if g is None
+            else jnp.asarray(_as_value(g), t.value.dtype)
+        )
+        pairs.append((t, ct))
+
+    def accumulate(t, ct_val):
+        if t.stop_gradient:
+            return
+        if t._node is not None and not t._retain_grad:
+            return
+        t.grad = Tensor(ct_val) if t.grad is None else Tensor(t.grad.value + ct_val)
+
+    _engine(pairs, retain_graph, accumulate)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity: return grads of outputs w.r.t. inputs."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported on the eager "
+            "tape yet; use paddle_tpu.incubate.autograd functional transforms "
+            "(jax.grad composition) for higher-order derivatives."
+        )
+    single_out = isinstance(outputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    single_in = isinstance(inputs, Tensor)
+    inputs = [inputs] if single_in else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    retain = bool(retain_graph) if retain_graph is not None else False
+    target_ids = {id(t): i for i, t in enumerate(inputs)}
+    results = [None] * len(inputs)
+
+    # temporarily mark targets so intermediate targets also receive cts
+    saved_flags = [(t, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t._retain_grad = True
+
+    pairs = []
+    for t, g in zip(outputs, grad_outputs):
+        ct = (
+            jnp.ones(t.value.shape, t.value.dtype)
+            if g is None
+            else jnp.asarray(_as_value(g), t.value.dtype)
+        )
+        pairs.append((t, ct))
+
+    def accumulate(t, ct_val):
+        i = target_ids.get(id(t))
+        if i is None:
+            return
+        results[i] = (
+            Tensor(ct_val)
+            if results[i] is None
+            else Tensor(results[i].value + ct_val)
+        )
+
+    try:
+        _engine(pairs, retain, accumulate)
+    finally:
+        for t, f in saved_flags:
+            t._retain_grad = f
+
+    if not allow_unused:
+        for i, r in enumerate(results):
+            if r is None:
+                raise RuntimeError(
+                    f"input {i} is unreachable from outputs; pass "
+                    "allow_unused=True to get None instead"
+                )
+    return results[0] if single_in else results

@@ -1,0 +1,74 @@
+"""Custom autograd functions.
+
+Reference parity: paddle.autograd.PyLayer
+(reference: python/paddle/autograd/py_layer.py — unverified, mount empty).
+User-defined forward/backward pairs become GradNodes whose vjp calls the
+user's backward under no_grad.
+"""
+from __future__ import annotations
+
+from ..core import dispatch, tape
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle alias
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        out_vals = tuple(o.value if isinstance(o, Tensor) else o for o in outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_mask = [dispatch._is_diff_tensor(a) for a in tensor_inputs]
+
+        def vjp_fn(out_cts):
+            with tape.no_grad():
+                ct_tensors = tuple(Tensor(c) for c in out_cts)
+                grads = cls.backward(ctx, *ct_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs"
+                )
+            return tuple(
+                (g.value if isinstance(g, Tensor) else g)
+                for g, m in zip(grads, diff_mask)
+                if m
+            )
+
+        wrapped = dispatch.custom_vjp_apply(
+            cls.__name__, tensor_inputs, out_vals, vjp_fn
+        )
+        return wrapped if multi else wrapped[0]

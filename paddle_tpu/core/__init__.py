@@ -1,0 +1,11 @@
+"""Core runtime: tensor, dtype, device, dispatch, autograd state, RNG."""
+from . import device, dispatch, dtypes, random, tape, tensor  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    set_device,
+)
+from .dtypes import convert_dtype, get_default_dtype, set_default_dtype  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor  # noqa: F401

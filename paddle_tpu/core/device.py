@@ -1,0 +1,149 @@
+"""Device/Place layer.
+
+Reference parity: phi::Place + DeviceContextPool + paddle.set_device
+(reference: paddle/phi/common/place.h, paddle/phi/core/device_context.cc —
+unverified, mount empty). On TPU there is no per-stream context to manage: XLA
+owns scheduling. This layer is therefore a thin selection mechanism that
+routes creation ops (and jit compilation) onto a chosen jax.Device, plus the
+CustomDevice-style "fake backend" trick for CI: ``set_device('cpu')`` runs the
+whole framework on host CPU (the analog of the reference's custom_cpu plugin
+test backend, test/custom_runtime/ — unverified).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Place:
+    """Device identity, paddle.CPUPlace()/TPUPlace(id) analog."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0):
+    return Place("tpu", device_id)
+
+
+class _DeviceState(threading.local):
+    def __init__(self):
+        self.place = None  # lazily resolved
+
+
+_STATE = _DeviceState()
+
+# Platforms we treat as "the accelerator" in preference order. "axon" is how
+# a tunneled TPU chip shows up; "tpu" is the native platform name.
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _accelerator_devices():
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    return accel
+
+
+def _default_place() -> Place:
+    if _accelerator_devices():
+        return Place("tpu", 0)
+    return Place("cpu", 0)
+
+
+def set_device(device) -> Place:
+    """paddle.set_device parity. Accepts 'cpu', 'tpu', 'tpu:1', Place."""
+    if isinstance(device, Place):
+        _STATE.place = device
+        return device
+    if not isinstance(device, str):
+        raise TypeError(f"set_device expects str or Place, got {type(device)}")
+    dev = device.lower()
+    # The reference's gpu place maps to the accelerator here so that
+    # reference scripts run unmodified ("gpu" -> the TPU chip).
+    if dev.startswith("gpu"):
+        dev = "tpu" + dev[3:]
+    if ":" in dev:
+        kind, _, idx = dev.partition(":")
+        place = Place(kind, int(idx))
+    else:
+        place = Place(dev, 0)
+    if place.device_type not in ("cpu", "tpu"):
+        raise ValueError(f"unknown device {device!r}; expected cpu/tpu[:i]")
+    _STATE.place = place
+    # Steer jax's default device so eager computation stays on the chosen
+    # backend (otherwise ops on freshly created arrays bounce to whatever
+    # backend is jax's global default — catastrophic over a tunneled chip).
+    try:
+        jax.config.update("jax_default_device", jax_device(place))
+    except Exception:
+        pass  # backend not initializable yet (e.g. restricted CI) — harmless
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place() -> Place:
+    if _STATE.place is None:
+        _STATE.place = _default_place()
+    return _STATE.place
+
+
+def jax_device(place: Place | None = None):
+    """Resolve a Place to a concrete jax.Device (local)."""
+    p = place or current_place()
+    if p.device_type == "cpu":
+        cpus = [d for d in jax.devices() if d.platform == "cpu"]
+        if not cpus:
+            # jax can always materialize host CPU devices
+            cpus = jax.devices("cpu")
+        return cpus[min(p.device_id, len(cpus) - 1)]
+    accel = _accelerator_devices()
+    if not accel:
+        # fake-backend mode: 'tpu' place on a CPU-only host (CI) routes to CPU,
+        # mirroring the reference's custom_cpu plugin trick.
+        return jax_device(Place("cpu", p.device_id))
+    return accel[min(p.device_id, len(accel) - 1)]
+
+
+def is_compiled_with_cuda() -> bool:  # reference API parity
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    """Local visible device count for the current place kind."""
+    p = current_place()
+    if p.device_type == "cpu":
+        return len([d for d in jax.devices() if d.platform == "cpu"]) or 1
+    return len(_accelerator_devices()) or 1

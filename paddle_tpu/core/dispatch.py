@@ -1,0 +1,164 @@
+"""Op dispatch: the eager execution + autograd recording path.
+
+Reference parity: the generated "dygraph functions" + Phi kernel dispatch
+(reference: paddle/fluid/eager/api/generated/, paddle/phi/core/kernel_factory.cc
+— unverified, mount empty). TPU-first redesign: there is no kernel registry —
+XLA *is* the kernel library. Each op is one pure jax function; dispatch does:
+
+  eager, no grad   -> cached ``jax.jit`` of the op (one compiled executable
+                      per (op, static-kwargs, shapes) — XLA's analog of a
+                      Phi kernel selection)
+  eager, grad      -> ``jax.vjp`` at call time; the VJP closure becomes the
+                      GradNode (replaces Paddle's generated per-op grad nodes)
+  inside trace     -> raw jax call so the *outer* whole-step jit sees the op
+                      and fuses it (the CINN-replacement path, SURVEY.md §3.5)
+
+AMP hooks in paddle_tpu.amp rewrite input dtypes here, mirroring the AMP
+dtype-promotion pass in the reference's generated dygraph functions.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as dtypes_mod
+from . import tape as tape_mod
+from .tensor import Tensor
+
+_JIT_CACHE: dict = {}
+
+# amp hook: callable (op_name, vals) -> vals, installed by paddle_tpu.amp
+_AMP_HOOK = [None]
+
+
+def set_amp_hook(fn):
+    _AMP_HOOK[0] = fn
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _jitted(fn, kw):
+    key = (fn, _freeze(kw))
+    j = _JIT_CACHE.get(key)
+    if j is None:
+        j = jax.jit(functools.partial(fn, **kw)) if kw else jax.jit(fn)
+        _JIT_CACHE[key] = j
+    return j
+
+
+def _unwrap(a):
+    return a.value if isinstance(a, Tensor) else a
+
+
+def _is_diff_tensor(a):
+    return (
+        isinstance(a, Tensor)
+        and not a.stop_gradient
+        and dtypes_mod.is_differentiable_dtype(a.dtype)
+    )
+
+
+def zero_cotangent(shape, dtype):
+    """A zero cotangent matching jax.vjp's expectations (float0 for ints)."""
+    d = np.dtype(dtype)
+    if jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating):
+        return jnp.zeros(shape, d)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def apply(name, fn, args, kw=None, cache=True, nondiff=False):
+    """Execute op ``fn`` over ``args`` (mix of Tensors and statics).
+
+    ``fn`` must be a pure jax function taking the positional args (arrays in
+    Tensor positions) plus static keyword args. Returns Tensor or tuple of
+    Tensors mirroring fn's output structure. ``cache=False`` skips the per-op
+    jit cache — required when ``fn`` is a per-call closure (indexing).
+    ``nondiff=True`` declares the op non-differentiable (bool/int outputs):
+    no GradNode is recorded and no vjp residuals are kept.
+    """
+    kw = kw or {}
+    vals = [_unwrap(a) for a in args]
+    if _AMP_HOOK[0] is not None:
+        vals = _AMP_HOOK[0](name, vals)
+
+    grad_needed = (
+        not nondiff
+        and tape_mod.grad_enabled()
+        and any(_is_diff_tensor(a) for a in args)
+    )
+
+    if not grad_needed:
+        if tape_mod.in_trace() or not cache:
+            out = fn(*vals, **kw)
+        else:
+            out = _jitted(fn, kw)(*vals)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    # --- autograd path: vjp over the differentiable tensor args only
+    diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]
+    diff_tensors = [args[i] for i in diff_idx]
+    diff_vals = tuple(vals[i] for i in diff_idx)
+
+    def f_diff(*dvals):
+        full = list(vals)
+        for i, v in zip(diff_idx, dvals):
+            full[i] = v
+        return fn(*full, **kw)
+
+    out, vjp_fn = jax.vjp(f_diff, *diff_vals)
+
+    is_multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if is_multi else (out,)
+    out_meta = [(o.shape, o.dtype) for o in outs]
+
+    node = tape_mod.GradNode(name, vjp_fn, diff_tensors, out_meta, multi=is_multi)
+    wrapped = tuple(
+        _make_out(o, node, i) for i, o in enumerate(outs)
+    )
+    return wrapped if is_multi else wrapped[0]
+
+
+def _make_out(val, node, idx):
+    t = Tensor(val, stop_gradient=False)
+    t._node = node
+    t._out_idx = idx
+    node.out_refs[idx] = weakref.ref(t)
+    return t
+
+
+def _wrap_outputs(out, stop_gradient):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+def custom_vjp_apply(name, inputs, outputs_vals, vjp_fn):
+    """Record a hand-written GradNode (PyLayer / fused kernels).
+
+    ``inputs``: the differentiable input Tensors; ``outputs_vals``: tuple of
+    raw output arrays; ``vjp_fn``: tuple(out_cts) -> tuple(in_cts aligned
+    with inputs).
+    """
+    grad_needed = tape_mod.grad_enabled() and any(
+        _is_diff_tensor(a) for a in inputs
+    )
+    outs_t = tuple(outputs_vals)
+    if not grad_needed:
+        return tuple(Tensor(o, stop_gradient=True) for o in outs_t)
+    diff_tensors = [a for a in inputs if _is_diff_tensor(a)]
+    out_meta = [(o.shape, o.dtype) for o in outs_t]
+    # custom vjp_fns always receive the full tuple of output cotangents and
+    # must return cotangents aligned with the *differentiable* inputs.
+    node = tape_mod.GradNode(name, vjp_fn, diff_tensors, out_meta, multi=True)
+    return tuple(_make_out(o, node, i) for i, o in enumerate(outs_t))

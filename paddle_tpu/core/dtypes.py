@@ -1,0 +1,110 @@
+"""Dtype registry for paddle_tpu.
+
+Reference parity: paddle exposes dtype objects (``paddle.float32`` etc.) used
+across the tensor API (reference: paddle/phi/common/data_type.h — unverified,
+mount empty; see SURVEY.md caveat). On TPU we map every public dtype directly
+onto the JAX/NumPy dtype system so arrays never need conversion at dispatch
+time; bfloat16 is first-class (it is the MXU-native matmul dtype).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects. These ARE numpy dtype-compatible objects, so
+# ``jnp.zeros(shape, dtype=paddle_tpu.float32)`` works with no translation.
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int": int32,
+    "int64": int64,
+    "long": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+#: default dtype for floating-point tensor creation (paddle default: float32)
+_default_dtype = [np.dtype("float32")]
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity."""
+    _default_dtype[0] = np.dtype(convert_dtype(d))
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def convert_dtype(dtype):
+    """Normalize any user-facing dtype spec to a numpy dtype.
+
+    Accepts strings ("float32", "bf16"), numpy dtypes, jnp dtypes, python
+    types (float/int/bool), and paddle-style "paddle.float32" reprs.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.split(".")[-1].lower()
+        if key in _STR_TO_DTYPE:
+            return np.dtype(_STR_TO_DTYPE[key])
+        return np.dtype(dtype)
+    if dtype is float:
+        return np.dtype(_default_dtype[0])
+    if dtype is int:
+        return np.dtype("int64")
+    if dtype is bool:
+        return np.dtype("bool")
+    return np.dtype(dtype)
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_complex_dtype(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_differentiable_dtype(dtype) -> bool:
+    return is_floating_point_dtype(dtype) or is_complex_dtype(dtype)
+
+
+def is_integer_dtype(dtype) -> bool:
+    d = np.dtype(convert_dtype(dtype))
+    return jnp.issubdtype(d, jnp.integer)

@@ -1,0 +1,79 @@
+"""RNG state management.
+
+Reference parity: paddle.seed + the stateful generator machinery
+(reference: paddle/phi/core/generator.cc — unverified, mount empty). JAX RNG
+is explicit-key; this module bridges the stateful API onto keys:
+
+- Eager: a global splittable key; every consumer splits it (stateful feel).
+- Traced (jitted step): a ``key_scope`` installs a *traced* base key; each
+  consumer folds in a Python-side counter, so every dropout call site gets a
+  distinct, deterministic subkey per step without baking constants into the
+  compiled program. The per-parallel-axis RNGStatesTracker (TP-parity dropout
+  semantics) lives in paddle_tpu.distributed.fleet.meta_parallel.random and
+  builds on key_scope.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class _RNGState(threading.local):
+    """Lazy: creating a key touches the jax backend, which must not happen
+    at import time (breaks device selection and CPU-only CI)."""
+
+    def __init__(self):
+        self._key = None
+        self.scope = None  # (traced_key, [counter]) when inside a jitted step
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(0)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
+
+
+_STATE = _RNGState()
+
+
+def seed(value: int):
+    """paddle.seed parity."""
+    _STATE.key = jax.random.key(int(value))
+    return _STATE.key
+
+
+def next_key():
+    """Return a fresh PRNG subkey, trace-safe."""
+    if _STATE.scope is not None:
+        base, counter = _STATE.scope
+        sub = jax.random.fold_in(base, counter[0])
+        counter[0] += 1
+        return sub
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+@contextlib.contextmanager
+def key_scope(base_key):
+    """Route next_key() to fold-ins of ``base_key`` (used inside jit traces)."""
+    prev = _STATE.scope
+    _STATE.scope = (base_key, [0])
+    try:
+        yield
+    finally:
+        _STATE.scope = prev
+
+
+def get_rng_state():
+    return jax.random.key_data(_STATE.key)
+
+
+def set_rng_state(state):
+    _STATE.key = jax.random.wrap_key_data(np.asarray(state))

@@ -1,0 +1,116 @@
+"""Eager autograd graph state.
+
+Reference parity: the eager autograd engine — GradNodeBase/AutogradMeta and
+egr::RunBackward (reference: paddle/fluid/eager/backward.cc, grad_node_info.h
+— unverified, mount empty). TPU-first redesign: instead of per-op hand-written
+grad nodes, every eager op call records a ``GradNode`` holding the jax VJP
+closure produced by ``jax.vjp`` at call time. The backward walk is a plain
+reverse-topological traversal over these nodes. The *performance* path is a
+whole-step ``jax.jit`` (see paddle_tpu/jit) where XLA differentiates the full
+program; this tape is the imperative/debug path, exactly the split SURVEY.md
+§7 prescribes.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class GradNode:
+    """One recorded op: maps output cotangents -> input cotangents."""
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_meta",
+        "n_outputs",
+        "out_refs",
+        "multi",
+        "__weakref__",
+    )
+
+    def __init__(self, name, vjp_fn, inputs, out_meta, multi=False):
+        self.name = name
+        self.vjp_fn = vjp_fn  # callable: out_cts -> tuple(in_cts)
+        self.inputs = inputs  # list[Tensor] — differentiable inputs only
+        self.out_meta = out_meta  # list[(shape, dtype)] per output
+        self.n_outputs = len(out_meta)
+        self.out_refs = [None] * len(out_meta)  # weakrefs to output Tensors
+        self.multi = multi  # whether vjp_fn takes a tuple of cotangents
+
+    def release(self):
+        # Drop residuals so memory frees as backward consumes the graph
+        self.vjp_fn = None
+        self.inputs = ()
+
+    def __repr__(self):
+        return f"GradNode<{self.name}>"
+
+
+class _AutogradState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+        # tracing depth > 0 means we are inside a functional jax trace
+        # (to_static / jitted train step); per-op jit must be skipped so the
+        # outer jit sees raw jax ops and can fuse them.
+        self.trace_depth = 0
+
+
+STATE = _AutogradState()
+
+
+def grad_enabled() -> bool:
+    return STATE.grad_enabled
+
+
+def is_grad_enabled() -> bool:
+    return STATE.grad_enabled
+
+
+def in_trace() -> bool:
+    return STATE.trace_depth > 0
+
+
+@contextlib.contextmanager
+def trace_scope():
+    """Mark that ops should execute as raw jax calls (inside an outer jit)."""
+    STATE.trace_depth += 1
+    try:
+        yield
+    finally:
+        STATE.trace_depth -= 1
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad parity: usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = STATE.grad_enabled
+        STATE.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        STATE.grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = STATE.grad_enabled
+        STATE.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        STATE.grad_enabled = self._prev
+        return False
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    prev = STATE.grad_enabled
+    STATE.grad_enabled = bool(mode)
+    try:
+        yield
+    finally:
+        STATE.grad_enabled = prev

@@ -1,0 +1,43 @@
+"""The flat op namespace: everything paddle exposes at tensor level.
+
+Aggregates creation/math/reduction/manipulation/search/logic/linalg into one
+namespace consumed by paddle_tpu/__init__.py (as ``paddle_tpu.<op>``) and
+bound as Tensor methods. Reference parity: python/paddle/tensor/__init__.py
+(unverified, mount empty).
+"""
+from __future__ import annotations
+
+from . import creation, linalg, logic, manipulation, math, reduction, search
+
+_MODULES = [creation, math, reduction, manipulation, search, logic, linalg]
+
+# helper/infra names that are callable but are NOT ops
+_EXCLUDE = {
+    "unary",
+    "binary",
+    "normalize_axis",
+    "static_int_list",
+    "convert_dtype",
+    "get_default_dtype",
+    "Tensor",
+    "Parameter",
+}
+
+__all__ = []
+
+
+def _export(mod):
+    for name, obj in vars(mod).items():
+        if name.startswith("_") or name in _EXCLUDE:
+            continue
+        if not callable(obj):
+            continue
+        if not getattr(obj, "__module__", "").startswith("paddle_tpu"):
+            continue  # raw jnp/np functions leaked via direct assignment
+        globals().setdefault(name, obj)
+        if name not in __all__:
+            __all__.append(name)
+
+
+for _m in _MODULES:
+    _export(_m)

@@ -1,0 +1,48 @@
+"""Op-definition helpers: thin factories over core.dispatch.apply."""
+from __future__ import annotations
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+def unary(name, fn, nondiff=False):
+    def op(x, name=None):
+        return dispatch.apply(op_name, fn, (x,), nondiff=op_nondiff)
+
+    op_name = name
+    op_nondiff = nondiff
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def binary(name, fn, nondiff=False):
+    def op(x, y, name=None):
+        return dispatch.apply(op_name, fn, (x, y), nondiff=op_nondiff)
+
+    op_name = name
+    op_nondiff = nondiff
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def normalize_axis(axis):
+    """Make axis hashable/static (lists -> tuples)."""
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, list):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, tuple):
+        return tuple(int(a) for a in axis)
+    if axis is None:
+        return None
+    return int(axis)
+
+
+def static_int_list(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return int(v)

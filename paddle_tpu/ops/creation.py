@@ -1,0 +1,273 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (unverified, mount
+empty). Creation happens directly on the current Place's jax device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import device as device_mod
+from ..core import random as random_mod
+from ..core import tape as tape_mod
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+from ._helpers import static_int_list
+
+
+def _device():
+    return device_mod.jax_device()
+
+
+def _place(arr):
+    """Put a freshly created array on the current device (eager only)."""
+    if tape_mod.in_trace():
+        return arr
+    return jax.device_put(arr, _device())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        out = Tensor(data.value, stop_gradient=stop_gradient)
+        if dtype is not None:
+            out = out.astype(dtype)
+            out.stop_gradient = stop_gradient
+        return out
+    if dtype is None:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64:
+            arr = arr.astype(get_default_dtype())
+        elif arr.dtype == np.int32:
+            # paddle default integer dtype follows input; keep as-is
+            pass
+    else:
+        arr = np.asarray(data, dtype=convert_dtype(dtype))
+    return Tensor(_place(jnp.asarray(arr)), stop_gradient=stop_gradient)
+
+
+def tensor(data, dtype=None, place=None, stop_gradient=True):
+    return to_tensor(data, dtype, place, stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(_place(jnp.zeros(_shape(shape), d)))
+
+
+def ones(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(_place(jnp.ones(_shape(shape), d)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = convert_dtype(dtype)
+    if d is None:
+        d = get_default_dtype() if isinstance(fill_value, float) else None
+    arr = jnp.full(_shape(shape), fill_value, d)
+    return Tensor(_place(arr))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    d = convert_dtype(dtype)
+    return Tensor(_place(jnp.zeros_like(v, dtype=d)))
+
+
+def ones_like(x, dtype=None, name=None):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    d = convert_dtype(dtype)
+    return Tensor(_place(jnp.ones_like(v, dtype=d)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    d = convert_dtype(dtype)
+    return Tensor(_place(jnp.full_like(v, fill_value, dtype=d)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            d = get_default_dtype()
+        else:
+            d = np.dtype("int64")
+    return Tensor(_place(jnp.arange(start, end, step, dtype=d)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(_place(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=d)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(_place(jnp.logspace(start, stop, int(num), base=base, dtype=d)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(_place(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=d)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    vals = [t.value if isinstance(t, Tensor) else jnp.asarray(t) for t in tensors]
+    outs = jnp.meshgrid(*vals, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(_place(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(_place(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype))))
+
+
+def _one_hot(xv, *, n):
+    return jax.nn.one_hot(xv, n, dtype=get_default_dtype())
+
+
+def one_hot(x, num_classes, name=None):
+    from ..core import dispatch
+
+    return dispatch.apply("one_hot", _one_hot, (x,), {"n": int(num_classes)})
+
+
+def clone(x, name=None):
+    from .manipulation import assign
+
+    return assign(x)
+
+
+# ----------------------------------------------------------------- random
+
+
+def rand(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    key = random_mod.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    key = random_mod.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=d))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return Tensor(
+        jax.random.randint(key, _shape(shape), int(low), int(high)).astype(
+            convert_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = convert_dtype(dtype) or x.dtype
+    return randint(low, high, tuple(x.shape), d)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_mod.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype) or get_default_dtype()
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return Tensor(
+        jax.random.uniform(key, _shape(shape), dtype=d, minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        mv = mean.value if isinstance(mean, Tensor) else mean
+        sv = std.value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(mv), jnp.shape(sv)
+        )
+        key = random_mod.next_key()
+        return Tensor(
+            jax.random.normal(key, out_shape, dtype=get_default_dtype()) * sv + mv
+        )
+    key = random_mod.next_key()
+    return Tensor(
+        jax.random.normal(key, _shape(shape), dtype=get_default_dtype()) * std + mean
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(key, xv).astype(xv.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if xv.ndim == 1:
+        out = jax.random.choice(
+            key,
+            xv.shape[0],
+            shape=(int(num_samples),),
+            replace=bool(replacement),
+            p=xv / xv.sum(),
+        )
+    else:
+        keys = jax.random.split(key, xv.shape[0])
+        out = jnp.stack(
+            [
+                jax.random.choice(
+                    k,
+                    xv.shape[1],
+                    shape=(int(num_samples),),
+                    replace=bool(replacement),
+                    p=row / row.sum(),
+                )
+                for k, row in zip(keys, xv)
+            ]
+        )
+    return Tensor(out.astype(jnp.int64))

@@ -1,0 +1,274 @@
+"""Linear algebra ops (paddle.linalg parity).
+
+Reference parity: python/paddle/tensor/linalg.py (unverified, mount empty).
+Decompositions route to jnp.linalg — XLA implements these natively; on TPU
+they run through the MXU where applicable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ._helpers import normalize_axis
+
+from .math import matmul, mm, bmm, dot, outer, inner  # re-export  # noqa: F401
+
+
+def _norm(x, *, p, axis, keepdim):
+    if axis is None and p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None else 2
+    return dispatch.apply(
+        "norm",
+        _norm,
+        (x,),
+        {"p": p, "axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+    )
+
+
+vector_norm = norm
+
+
+def _matrix_norm(x, *, p, keepdim):
+    return jnp.linalg.norm(x, ord=p, axis=(-2, -1), keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return dispatch.apply(
+        "matrix_norm", _matrix_norm, (x,), {"p": p, "keepdim": bool(keepdim)}
+    )
+
+
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return dispatch.apply("det", _det, (x,))
+
+
+def _slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+def slogdet(x, name=None):
+    return dispatch.apply("slogdet", _slogdet, (x,))
+
+
+def _inv(x):
+    return jnp.linalg.inv(x)
+
+
+def inv(x, name=None):
+    return dispatch.apply("inv", _inv, (x,))
+
+
+def _pinv(x, *, rcond):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch.apply("pinv", _pinv, (x,), {"rcond": float(rcond)})
+
+
+def _solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def solve(x, y, name=None):
+    return dispatch.apply("solve", _solve, (x, y))
+
+
+def _triangular_solve(a, b, *, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular,
+    )
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return dispatch.apply(
+        "triangular_solve",
+        _triangular_solve,
+        (x, y),
+        {
+            "upper": bool(upper),
+            "transpose": bool(transpose),
+            "unitriangular": bool(unitriangular),
+        },
+    )
+
+
+def _cholesky(x, *, upper):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return dispatch.apply("cholesky", _cholesky, (x,), {"upper": bool(upper)})
+
+
+def _cholesky_solve(b, l, *, upper):
+    a = jnp.matmul(l, jnp.swapaxes(l, -1, -2)) if not upper else jnp.matmul(
+        jnp.swapaxes(l, -1, -2), l
+    )
+    return jnp.linalg.solve(a, b)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return dispatch.apply(
+        "cholesky_solve", _cholesky_solve, (x, y), {"upper": bool(upper)}
+    )
+
+
+def _qr(x, *, mode):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+def qr(x, mode="reduced", name=None):
+    out = dispatch.apply("qr", _qr, (x,), {"mode": mode})
+    return out[0], out[1]
+
+
+def _svd(x, *, full_matrices):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+def svd(x, full_matrices=False, name=None):
+    out = dispatch.apply("svd", _svd, (x,), {"full_matrices": bool(full_matrices)})
+    return out[0], out[1], out[2]
+
+
+def _eigh(x, *, uplo):
+    return tuple(jnp.linalg.eigh(x, UPLO=uplo))
+
+
+def eigh(x, UPLO="L", name=None):
+    out = dispatch.apply("eigh", _eigh, (x,), {"uplo": UPLO})
+    return out[0], out[1]
+
+
+def _eigvalsh(x, *, uplo):
+    return jnp.linalg.eigvalsh(x, UPLO=uplo)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch.apply("eigvalsh", _eigvalsh, (x,), {"uplo": UPLO})
+
+
+def _eig(x):
+    return tuple(jnp.linalg.eig(x))
+
+
+def eig(x, name=None):
+    # CPU-only in XLA; fine for the eager/debug path
+    out = dispatch.apply("eig", _eig, (x,), cache=False)
+    return out[0], out[1]
+
+
+def _matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return dispatch.apply("matrix_power", _matrix_power, (x,), {"n": int(n)})
+
+
+def _matrix_rank(x, *, tol):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return dispatch.apply("matrix_rank", _matrix_rank, (x,), {"tol": tol})
+
+
+def _lstsq(a, b, *, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    out = dispatch.apply("lstsq", _lstsq, (x, y), {"rcond": rcond})
+    return tuple(out)
+
+
+def _cond(x, *, p):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return dispatch.apply("cond", _cond, (x,), {"p": p})
+
+
+def _lu(x):
+    import jax.scipy.linalg as jsl
+
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    out = dispatch.apply("lu", _lu, (x,))
+    if get_infos:
+        from .creation import zeros
+
+        return out[0], out[1], zeros([1], dtype="int32")
+    return out[0], out[1]
+
+
+def _einsum(*operands, equation):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return dispatch.apply("einsum", _einsum, tuple(operands), {"equation": equation})
+
+
+def _multi_dot(*mats):
+    return jnp.linalg.multi_dot(mats)
+
+
+def multi_dot(x, name=None):
+    return dispatch.apply("multi_dot", _multi_dot, tuple(x))
+
+
+def _householder_product(a, tau):
+    # form Q from householder reflectors (geqrf layout)
+    m, n = a.shape[-2], a.shape[-1]
+    q = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
+        v = v.at[..., i].set(1.0)
+        t = tau[..., i]
+        vvt = jnp.einsum("...i,...j->...ij", v, v)
+        h = jnp.eye(m, dtype=a.dtype) - t[..., None, None] * vvt
+        return jnp.matmul(q, h)
+
+    q = jax.lax.fori_loop(0, n, body, q)
+    return q[..., :, :n]
+
+
+def householder_product(x, tau, name=None):
+    return dispatch.apply("householder_product", _householder_product, (x, tau))

@@ -1,0 +1,136 @@
+"""Comparison & logical ops.
+
+Reference parity: python/paddle/tensor/logic.py (unverified, mount empty).
+Comparisons return bool tensors and are non-differentiable (stop_gradient
+outputs), matching the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import binary
+
+
+def _cmp(name, jfn):
+    def op(x, y, name=None):
+        # comparisons are non-differentiable: bool outputs, no GradNode
+        return dispatch.apply(op_name, fn, (x, y), nondiff=True)
+
+    def fn(xv, yv):
+        return jfn(xv, yv)
+
+    fn.__name__ = "_" + name
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+
+
+def _to_bool(v):
+    return v.astype(bool) if hasattr(v, "astype") else bool(v)
+
+
+def _and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def _or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def _xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+logical_and = binary("logical_and", _and, nondiff=True)
+logical_or = binary("logical_or", _or, nondiff=True)
+logical_xor = binary("logical_xor", _xor, nondiff=True)
+
+
+def logical_not(x, out=None, name=None):
+    return dispatch.apply("logical_not", jnp.logical_not, (x,), nondiff=True)
+
+
+def _band(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def _bor(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def _bxor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+bitwise_and = binary("bitwise_and", _band, nondiff=True)
+bitwise_or = binary("bitwise_or", _bor, nondiff=True)
+bitwise_xor = binary("bitwise_xor", _bxor, nondiff=True)
+
+
+def bitwise_not(x, out=None, name=None):
+    return dispatch.apply("bitwise_not", jnp.bitwise_not, (x,), nondiff=True)
+
+
+def _isclose(x, y, *, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch.apply(
+        "isclose",
+        _isclose,
+        (x, y),
+        {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)},
+        nondiff=True,
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    def _allclose(xv, yv, *, rtol, atol, equal_nan):
+        return jnp.allclose(xv, yv, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    return dispatch.apply(
+        "allclose",
+        _allclose,
+        (x, y),
+        {"rtol": float(rtol), "atol": float(atol), "equal_nan": bool(equal_nan)},
+        cache=False,
+        nondiff=True,
+    )
+
+
+def equal_all(x, y, name=None):
+    def _equal_all(xv, yv):
+        if xv.shape != yv.shape:
+            return jnp.asarray(False)
+        return jnp.all(xv == yv)
+
+    return dispatch.apply("equal_all", _equal_all, (x, y), cache=False, nondiff=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def _shift_left(x, y):
+    return jnp.left_shift(x, y)
+
+
+def _shift_right(x, y):
+    return jnp.right_shift(x, y)
+
+
+bitwise_left_shift = binary("bitwise_left_shift", _shift_left)
+bitwise_right_shift = binary("bitwise_right_shift", _shift_right)

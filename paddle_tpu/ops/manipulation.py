@@ -1,0 +1,698 @@
+"""Shape/layout/indexing ops.
+
+Reference parity: python/paddle/tensor/manipulation.py + phi kernels
+(unverified, mount empty). All static-shape ops trace cleanly under jit;
+dynamic-output ops (nonzero/unique/masked_select) are eager-only by nature —
+they raise a clear error inside traces, matching the TPU/XLA static-shape
+execution model.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis, static_int_list
+
+# ----------------------------------------------------------------- basic
+
+
+def _cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype, name=None):
+    d = convert_dtype(dtype)
+    return dispatch.apply("cast", _cast, (x,), {"dtype": np.dtype(d).name})
+
+
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None, name=None):
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    out = dispatch.apply("assign", _assign, (x,))
+    if output is not None:
+        return output._inplace(lambda _alias: out)
+    return out
+
+
+def _reshape(x, *, shape):
+    shape = list(shape)
+    # paddle: 0 means "copy this dim from input"
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return dispatch.apply(
+        "reshape", _reshape, (x,), {"shape": static_int_list(shape)}
+    )
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace(reshape, shape)
+
+
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return dispatch.apply(
+        "transpose", _transpose, (x,), {"perm": static_int_list(perm)}
+    )
+
+
+def _t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def t(x, name=None):
+    return dispatch.apply("t", _t, (x,))
+
+
+matrix_transpose = t
+
+
+def _swapaxes(x, *, a, b):
+    return jnp.swapaxes(x, a, b)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return dispatch.apply(
+        "swapaxes", _swapaxes, (x,), {"a": int(axis0), "b": int(axis1)}
+    )
+
+
+transpose_ = swapaxes  # not paddle API; kept private-ish
+
+
+def _moveaxis(x, *, src, dst):
+    return jnp.moveaxis(x, src, dst)
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch.apply(
+        "moveaxis",
+        _moveaxis,
+        (x,),
+        {"src": static_int_list(source), "dst": static_int_list(destination)},
+    )
+
+
+def _flatten(x, *, start, stop):
+    shape = x.shape
+    nd = len(shape)
+    start_ = start % nd if nd else 0
+    stop_ = stop % nd if nd else 0
+    new_shape = (
+        list(shape[:start_])
+        + [int(np.prod(shape[start_ : stop_ + 1])) if nd else 1]
+        + list(shape[stop_ + 1 :])
+    )
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return dispatch.apply(
+        "flatten", _flatten, (x,), {"start": int(start_axis), "stop": int(stop_axis)}
+    )
+
+
+def _squeeze(x, *, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    return dispatch.apply("squeeze", _squeeze, (x,), {"axis": normalize_axis(axis)})
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace(squeeze, axis)
+
+
+def _unsqueeze(x, *, axis):
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    return jnp.expand_dims(x, axes)
+
+
+def unsqueeze(x, axis, name=None):
+    return dispatch.apply(
+        "unsqueeze", _unsqueeze, (x,), {"axis": normalize_axis(axis)}
+    )
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace(unsqueeze, axis)
+
+
+# ------------------------------------------------------------ joining/splitting
+
+
+def _concat(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    xs = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch.apply("concat", _concat, tuple(xs), {"axis": int(axis)})
+
+
+def _stack(*xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return dispatch.apply("stack", _stack, tuple(x), {"axis": int(axis)})
+
+
+def _split(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # sections is sizes list, possibly with one -1
+    sizes = list(sections)
+    if -1 in sizes:
+        known = builtins.sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = x.shape[axis] - known
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    sec = (
+        int(num_or_sections)
+        if isinstance(num_or_sections, int)
+        else tuple(int(s) for s in num_or_sections)
+    )
+    out = dispatch.apply(
+        "split", _split, (x,), {"sections": sec, "axis": int(axis)}
+    )
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def _unbind(x, *, axis):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+def unbind(x, axis=0, name=None):
+    return list(dispatch.apply("unbind", _unbind, (x,), {"axis": int(axis)}))
+
+
+unstack = unbind
+
+# ------------------------------------------------------------------ expansion
+
+
+def _tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+def tile(x, repeat_times, name=None):
+    return dispatch.apply(
+        "tile", _tile, (x,), {"reps": static_int_list(repeat_times)}
+    )
+
+
+def _expand(x, *, shape):
+    shape = list(shape)
+    # paddle: -1 means keep input dim
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1 and i >= offset:
+            shape[i] = x.shape[i - offset]
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    return dispatch.apply("expand", _expand, (x,), {"shape": static_int_list(shape)})
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(y.shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [expand(t, list(shape)) for t in inputs]
+
+
+def _flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return dispatch.apply("flip", _flip, (x,), {"axis": normalize_axis(axis)})
+
+
+def _roll(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch.apply(
+        "roll",
+        _roll,
+        (x,),
+        {"shifts": static_int_list(shifts), "axis": normalize_axis(axis)},
+    )
+
+
+def _repeat_interleave(x, repeats, *, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return dispatch.apply(
+            "repeat_interleave",
+            _repeat_interleave,
+            (x, repeats),
+            {"axis": normalize_axis(axis)},
+            cache=False,
+        )
+    return dispatch.apply(
+        "repeat_interleave",
+        lambda xv, axis: jnp.repeat(xv, repeats, axis=axis),
+        (x,),
+        {"axis": normalize_axis(axis)},
+        cache=False,
+    )
+
+
+# ------------------------------------------------------------------ triangular
+
+
+def _tril(x, *, k):
+    return jnp.tril(x, k)
+
+
+def tril(x, diagonal=0, name=None):
+    return dispatch.apply("tril", _tril, (x,), {"k": int(diagonal)})
+
+
+def _triu(x, *, k):
+    return jnp.triu(x, k)
+
+
+def triu(x, diagonal=0, name=None):
+    return dispatch.apply("triu", _triu, (x,), {"k": int(diagonal)})
+
+
+def _diag(x, *, offset):
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0 and getattr(x, "ndim", 1) == 1:
+
+        def _diag_pad(xv, *, offset):
+            base = jnp.full(
+                (xv.shape[0] + builtins.abs(offset),) * 2,
+                padding_value,
+                dtype=xv.dtype,
+            )
+            return base + jnp.diag(xv, k=offset) - jnp.diag(
+                jnp.full((xv.shape[0],), padding_value, xv.dtype), k=offset
+            )
+
+        return dispatch.apply(
+            "diag_pad", _diag_pad, (x,), {"offset": int(offset)}, cache=False
+        )
+    return dispatch.apply("diag", _diag, (x,), {"offset": int(offset)})
+
+
+def _diagonal(x, *, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch.apply(
+        "diagonal",
+        _diagonal,
+        (x,),
+        {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)},
+    )
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def _diag_embed(xv, *, offset):
+        return jax.vmap(lambda v: jnp.diag(v, k=offset))(
+            xv.reshape(-1, xv.shape[-1])
+        ).reshape(xv.shape[:-1] + (xv.shape[-1] + builtins.abs(offset),) * 2)
+
+    return dispatch.apply(
+        "diag_embed", _diag_embed, (input,), {"offset": int(offset)}, cache=False
+    )
+
+
+# ------------------------------------------------------------------- indexing
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, builtins.slice):
+        return builtins.slice(
+            _unwrap_index(idx.start), _unwrap_index(idx.stop), _unwrap_index(idx.step)
+        )
+    return idx
+
+
+def getitem(x, idx):
+    idx_u = _unwrap_index(idx)
+
+    def _get(xv):
+        return xv[idx_u]
+
+    return dispatch.apply("getitem", _get, (x,), cache=False)
+
+
+def setitem(x, idx, v):
+    idx_u = _unwrap_index(idx)
+
+    def _set(xv, vv):
+        return xv.at[idx_u].set(vv)
+
+    if not isinstance(v, Tensor):
+        v = Tensor(jnp.asarray(v, x.value.dtype))
+    return dispatch.apply("setitem", _set, (x, v), cache=False)
+
+
+def slice(input, axes, starts, ends, name=None):
+    idx = [builtins.slice(None)] * input.ndim
+    for ax, s, e in zip(static_int_list(axes), static_int_list(starts), static_int_list(ends)):
+        idx[ax] = builtins.slice(s, e)
+    return getitem(input, tuple(idx))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(
+        static_int_list(axes),
+        static_int_list(starts),
+        static_int_list(ends),
+        static_int_list(strides),
+    ):
+        idx[ax] = builtins.slice(s, e, st)
+    return getitem(x, tuple(idx))
+
+
+def _gather(x, index, *, axis):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch.apply("gather", _gather, (x, index), {"axis": int(axis)})
+
+
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return dispatch.apply("gather_nd", _gather_nd, (x, index))
+
+
+def _index_select(x, index, *, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return dispatch.apply(
+        "index_select", _index_select, (x, index), {"axis": int(axis)}
+    )
+
+
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return dispatch.apply("index_sample", _index_sample, (x, index))
+
+
+def _take_along_axis(x, indices, *, axis, broadcast):
+    if broadcast:
+        shape = list(x.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return dispatch.apply(
+        "take_along_axis",
+        _take_along_axis,
+        (arr, indices),
+        {"axis": int(axis), "broadcast": bool(broadcast)},
+    )
+
+
+def _put_along_axis(x, indices, values, *, axis, reduce):
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    dims = list(range(x.ndim))
+    idx = tuple(
+        indices if d == axis else jnp.arange(x.shape[d]).reshape(
+            [-1 if i == d else 1 for i in dims]
+        )
+        for d in dims
+    )
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce == "add":
+        return x.at[idx].add(values)
+    if reduce == "multiply" or reduce == "mul":
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unsupported reduce={reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values))
+    return dispatch.apply(
+        "put_along_axis",
+        _put_along_axis,
+        (arr, indices, values),
+        {"axis": int(axis), "reduce": reduce},
+    )
+
+
+def _scatter(x, index, updates, *, overwrite):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle scatter(overwrite=False) accumulates after zeroing target rows
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return dispatch.apply(
+        "scatter", _scatter, (x, index, updates), {"overwrite": bool(overwrite)}
+    )
+
+
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return dispatch.apply("scatter_nd_add", _scatter_nd_add, (x, index, updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def _masked_fill(x, mask, v):
+    return jnp.where(mask, jnp.asarray(v, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    return dispatch.apply("masked_fill", _masked_fill, (x, mask, value))
+
+
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    return dispatch.apply("where", _where, (condition, x, y))
+
+
+# --------------------------------------------------- dynamic-shape (eager-only)
+
+
+def _require_eager(name):
+    from ..core import tape
+
+    if tape.in_trace():
+        raise RuntimeError(
+            f"{name} produces a data-dependent shape and cannot run inside a "
+            "jit trace on TPU; compute it eagerly or use a fixed-size variant."
+        )
+
+
+def masked_select(x, mask, name=None):
+    _require_eager("masked_select")
+
+    def _ms(xv, mv):
+        return xv[mv]
+
+    return dispatch.apply("masked_select", _ms, (x, mask), cache=False)
+
+
+def unique(
+    x,
+    return_index=False,
+    return_inverse=False,
+    return_counts=False,
+    axis=None,
+    dtype="int64",
+    name=None,
+):
+    _require_eager("unique")
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    res = jnp.unique(
+        xv,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    _require_eager("unique_consecutive")
+    xv = np.asarray(x.value if isinstance(x, Tensor) else x)
+    if axis is None:
+        xv = xv.reshape(-1)
+    keep = np.ones(xv.shape[0], dtype=bool)
+    keep[1:] = np.any(
+        xv[1:].reshape(xv.shape[0] - 1, -1) != xv[:-1].reshape(xv.shape[0] - 1, -1),
+        axis=1,
+    )
+    out = Tensor(jnp.asarray(xv[keep]))
+    results = [out]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, xv.shape[0]))
+        results.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# ------------------------------------------------------------------------ pad
+
+
+def _pad_nd(x, *, paddings, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, paddings, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, paddings, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad-compatible. ``pad`` is paddle layout:
+    either len==2*ndim (per-dim lo/hi, dim0 first) or the common case of
+    len==2*k applying to the last k spatial dims (NCHW/NCL/NCDHW)."""
+    pad = static_int_list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            # pad covers the last k dims, ordered innermost-first (paddle)
+            for i in range(k):
+                dim = nd - 1 - i
+                pairs[dim] = (pad[2 * i], pad[2 * i + 1])
+        else:  # NHWC-style: spatial dims are 1..k
+            for i in range(k):
+                dim = 1 + (k - 1 - i)
+                pairs[dim] = (pad[2 * i], pad[2 * i + 1])
+    return dispatch.apply(
+        "pad",
+        _pad_nd,
+        (x,),
+        {"paddings": tuple(pairs), "mode": mode, "value": float(value)},
+    )
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def _as_complex(v):
+    return jax.lax.complex(v[..., 0], v[..., 1])
+
+
+def _as_real(v):
+    return jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1)
+
+
+def as_complex(x, name=None):
+    return dispatch.apply("as_complex", _as_complex, (x,))
+
+
+def as_real(x, name=None):
+    return dispatch.apply("as_real", _as_real, (x,))

@@ -1,0 +1,378 @@
+"""Elementwise & general math ops.
+
+Reference parity: python/paddle/tensor/math.py + phi math kernels
+(reference: paddle/phi/kernels/ — unverified, mount empty). Each op is one
+pure jnp function; XLA fuses chains of these into single TPU kernels, which
+is why there are no hand-written fused elementwise kernels here (the
+reference needs CUDA fusion passes for that; XLA does it natively).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ._helpers import binary, normalize_axis, unary
+
+# ---------------------------------------------------------------- elementwise
+
+
+def _add(x, y):
+    return jnp.add(x, y)
+
+
+def _sub(x, y):
+    return jnp.subtract(x, y)
+
+
+def _mul(x, y):
+    return jnp.multiply(x, y)
+
+
+def _div(x, y):
+    return jnp.true_divide(x, y)
+
+
+def _floordiv(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def _mod(x, y):
+    return jnp.mod(x, y)
+
+
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+def _maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def _minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def _fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def _fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def _atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def _hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def _remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+add = binary("add", _add)
+subtract = binary("subtract", _sub)
+multiply = binary("multiply", _mul)
+divide = binary("divide", _div)
+floor_divide = binary("floor_divide", _floordiv)
+mod = binary("mod", _mod)
+remainder = binary("remainder", _remainder)
+floor_mod = mod
+pow = binary("pow", _pow)
+maximum = binary("maximum", _maximum)
+minimum = binary("minimum", _minimum)
+fmax = binary("fmax", _fmax)
+fmin = binary("fmin", _fmin)
+atan2 = binary("atan2", _atan2)
+hypot = binary("hypot", _hypot)
+
+sqrt = unary("sqrt", jnp.sqrt)
+rsqrt = unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+exp = unary("exp", jnp.exp)
+expm1 = unary("expm1", jnp.expm1)
+log = unary("log", jnp.log)
+log2 = unary("log2", jnp.log2)
+log10 = unary("log10", jnp.log10)
+log1p = unary("log1p", jnp.log1p)
+abs = unary("abs", jnp.abs)
+neg = unary("neg", jnp.negative)
+sign = unary("sign", jnp.sign)
+sin = unary("sin", jnp.sin)
+cos = unary("cos", jnp.cos)
+tan = unary("tan", jnp.tan)
+asin = unary("asin", jnp.arcsin)
+acos = unary("acos", jnp.arccos)
+atan = unary("atan", jnp.arctan)
+sinh = unary("sinh", jnp.sinh)
+cosh = unary("cosh", jnp.cosh)
+tanh = unary("tanh", jnp.tanh)
+asinh = unary("asinh", jnp.arcsinh)
+acosh = unary("acosh", jnp.arccosh)
+atanh = unary("atanh", jnp.arctanh)
+erf = unary("erf", jax.scipy.special.erf)
+erfinv = unary("erfinv", jax.scipy.special.erfinv)
+floor = unary("floor", jnp.floor)
+ceil = unary("ceil", jnp.ceil)
+round = unary("round", jnp.round)
+trunc = unary("trunc", jnp.trunc)
+frac = unary("frac", lambda x: x - jnp.trunc(x))
+reciprocal = unary("reciprocal", jnp.reciprocal)
+square = unary("square", jnp.square)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+logit = unary("logit", jax.scipy.special.logit)
+digamma = unary("digamma", jax.scipy.special.digamma)
+lgamma = unary("lgamma", jax.scipy.special.gammaln)
+i0 = unary("i0", lambda x: jax.scipy.special.i0(x))
+angle = unary("angle", jnp.angle)
+conj = unary("conj", jnp.conj)
+real = unary("real", jnp.real)
+imag = unary("imag", jnp.imag)
+
+isfinite = unary("isfinite", jnp.isfinite, nondiff=True)
+isinf = unary("isinf", jnp.isinf, nondiff=True)
+isnan = unary("isnan", jnp.isnan, nondiff=True)
+
+
+def _scale(x, *, scale_v, bias, bias_after_scale):
+    if bias_after_scale:
+        return x * scale_v + bias
+    return (x + bias) * scale_v
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = dispatch.apply(
+        "scale",
+        _scale,
+        (x,),
+        {
+            "scale_v": float(scale),
+            "bias": float(bias),
+            "bias_after_scale": bool(bias_after_scale),
+        },
+    )
+    return out
+
+
+def _clip(x, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def clip(x, min=None, max=None, name=None):
+    return dispatch.apply("clip", _clip, (x, min, max))
+
+
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    return dispatch.apply("lerp", _lerp, (x, y, weight))
+
+
+def _nan_to_num(x, *, nan, posinf, neginf):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch.apply(
+        "nan_to_num",
+        _nan_to_num,
+        (x,),
+        {"nan": nan, "posinf": posinf, "neginf": neginf},
+    )
+
+
+def _stanh(x, *, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch.apply(
+        "stanh", _stanh, (x,), {"scale_a": scale_a, "scale_b": scale_b}
+    )
+
+
+def _rsqrt_eps(x, *, eps):
+    return jax.lax.rsqrt(x + eps)
+
+
+# -------------------------------------------------------------------- matmul
+
+
+def _matmul(x, y, *, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return dispatch.apply(
+        "matmul",
+        _matmul,
+        (x, y),
+        {"transpose_x": bool(transpose_x), "transpose_y": bool(transpose_y)},
+    )
+
+
+def _mm(x, y):
+    return jnp.matmul(x, y)
+
+
+mm = binary("mm", _mm)
+
+
+def _bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+bmm = binary("bmm", _bmm)
+
+
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+dot = binary("dot", _dot)
+
+
+def _inner(x, y):
+    return jnp.inner(x, y)
+
+
+inner = binary("inner", _inner)
+
+
+def _outer(x, y):
+    return jnp.outer(x, y)
+
+
+outer = binary("outer", _outer)
+
+
+def _addmm(inp, x, y, *, beta, alpha):
+    return beta * inp + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch.apply(
+        "addmm", _addmm, (input, x, y), {"beta": beta, "alpha": alpha}
+    )
+
+
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+kron = binary("kron", _kron)
+
+
+def _cross(x, y, *, axis):
+    return jnp.cross(x, y, axis=axis if axis is not None else -1)
+
+
+def cross(x, y, axis=9, name=None):
+    # paddle defaults to the first axis with dim 3; approximate with given axis
+    if axis == 9:
+        ax = None
+        for i, d in enumerate(x.shape):
+            if d == 3:
+                ax = i
+                break
+        axis = ax if ax is not None else -1
+    return dispatch.apply("cross", _cross, (x, y), {"axis": int(axis)})
+
+
+# ------------------------------------------------------------------ cumulative
+
+
+def _cumsum(x, *, axis):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = dispatch.apply("cumsum", _cumsum, (x,), {"axis": normalize_axis(axis)})
+    if dtype is not None:
+        from .manipulation import cast
+
+        out = cast(out, dtype)
+    return out
+
+
+def _cumprod(x, *, axis):
+    return jnp.cumprod(x, axis=axis)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = dispatch.apply("cumprod", _cumprod, (x,), {"axis": normalize_axis(dim)})
+    if dtype is not None:
+        from .manipulation import cast
+
+        out = cast(out, dtype)
+    return out
+
+
+def _logcumsumexp(x, *, axis):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    out = dispatch.apply(
+        "logcumsumexp", _logcumsumexp, (x,), {"axis": normalize_axis(axis)}
+    )
+    if dtype is not None:
+        from .manipulation import cast
+
+        out = cast(out, dtype)
+    return out
+
+
+def _logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+logaddexp = binary("logaddexp", _logaddexp)
+
+
+def _trace(x, *, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch.apply(
+        "trace",
+        _trace,
+        (x,),
+        {"offset": int(offset), "axis1": int(axis1), "axis2": int(axis2)},
+    )
+
+
+def _diff(x, *, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    if prepend is not None or append is not None:
+        from .manipulation import concat
+
+        parts = []
+        if prepend is not None:
+            parts.append(prepend)
+        parts.append(x)
+        if append is not None:
+            parts.append(append)
+        x = concat(parts, axis=axis)
+    return dispatch.apply("diff", _diff, (x,), {"n": int(n), "axis": int(axis)})
+
+
+def _multiply_no_grad_accum(x, y):  # helper used by optimizers
+    return x * y

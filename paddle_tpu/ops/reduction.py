@@ -1,0 +1,118 @@
+"""Reduction ops (paddle semantics: ``axis``/``keepdim``).
+
+Reference parity: python/paddle/tensor/math.py reductions + phi reduce
+kernels (reference: paddle/phi/kernels/gpu/reduce_*.cu — unverified, mount
+empty); on TPU, XLA lowers these straight to efficient tree reductions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ._helpers import normalize_axis
+
+
+def _make_reduce(name, jfn):
+    def fn(x, *, axis, keepdim):
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    fn.__name__ = "_" + name
+
+    def op(x, axis=None, keepdim=False, name=None):
+        return dispatch.apply(
+            op_name, fn, (x,), {"axis": normalize_axis(axis), "keepdim": bool(keepdim)}
+        )
+
+    op_name = name
+    op.__name__ = name
+    return op
+
+
+sum = _make_reduce("sum", jnp.sum)
+mean = _make_reduce("mean", jnp.mean)
+prod = _make_reduce("prod", jnp.prod)
+max = _make_reduce("max", jnp.max)
+min = _make_reduce("min", jnp.min)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+all = _make_reduce("all", jnp.all)
+any = _make_reduce("any", jnp.any)
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+nansum = _make_reduce("nansum", jnp.nansum)
+median = _make_reduce("median", jnp.median)
+nanmedian = _make_reduce("nanmedian", jnp.nanmedian)
+
+
+def _std(x, *, axis, keepdim, unbiased):
+    return jnp.std(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch.apply(
+        "std",
+        _std,
+        (x,),
+        {
+            "axis": normalize_axis(axis),
+            "keepdim": bool(keepdim),
+            "unbiased": bool(unbiased),
+        },
+    )
+
+
+def _var(x, *, axis, keepdim, unbiased):
+    return jnp.var(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch.apply(
+        "var",
+        _var,
+        (x,),
+        {
+            "axis": normalize_axis(axis),
+            "keepdim": bool(keepdim),
+            "unbiased": bool(unbiased),
+        },
+    )
+
+
+def _logsumexp(x, *, axis, keepdim):
+    from jax.scipy.special import logsumexp as lse
+
+    return lse(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return dispatch.apply(
+        "logsumexp",
+        _logsumexp,
+        (x,),
+        {"axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+    )
+
+
+def _count_nonzero(x, *, axis, keepdim):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return dispatch.apply(
+        "count_nonzero",
+        _count_nonzero,
+        (x,),
+        {"axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+    )
+
+
+def _quantile(x, q, *, axis, keepdim):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return dispatch.apply(
+        "quantile",
+        _quantile,
+        (x, q),
+        {"axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+    )

@@ -1,0 +1,210 @@
+"""Search/sort ops: argmax/argmin/argsort/sort/topk/nonzero/searchsorted/kthvalue/mode.
+
+Reference parity: python/paddle/tensor/search.py (unverified, mount empty).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dispatch, tape
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor
+from ._helpers import normalize_axis
+
+
+def _argmax(x, *, axis, keepdim):
+    if axis is None:
+        return jnp.argmax(x.reshape(-1)).astype(jnp.int64)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = dispatch.apply(
+        "argmax",
+        _argmax,
+        (x,),
+        {"axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+        nondiff=True,
+    )
+    return out.astype(convert_dtype(dtype)) if dtype != "int64" else out
+
+
+def _argmin(x, *, axis, keepdim):
+    if axis is None:
+        return jnp.argmin(x.reshape(-1)).astype(jnp.int64)
+    out = jnp.argmin(x, axis=axis).astype(jnp.int64)
+    return jnp.expand_dims(out, axis) if keepdim else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = dispatch.apply(
+        "argmin",
+        _argmin,
+        (x,),
+        {"axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+        nondiff=True,
+    )
+    return out.astype(convert_dtype(dtype)) if dtype != "int64" else out
+
+
+def _argsort(x, *, axis, descending, stable):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return dispatch.apply(
+        "argsort",
+        _argsort,
+        (x,),
+        {"axis": int(axis), "descending": bool(descending), "stable": bool(stable)},
+        nondiff=True,
+    )
+
+
+def _sort(x, *, axis, descending, stable):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return dispatch.apply(
+        "sort",
+        _sort,
+        (x,),
+        {"axis": int(axis), "descending": bool(descending), "stable": bool(stable)},
+    )
+
+
+def _topk(x, *, k, axis, largest, sorted):
+    ax = axis if axis is not None else -1
+    if largest:
+        idx = jnp.argsort(x, axis=ax, descending=True)
+    else:
+        idx = jnp.argsort(x, axis=ax)
+    idx = jnp.take(idx, jnp.arange(k), axis=ax)
+    vals = jnp.take_along_axis(x, idx, axis=ax)
+    return vals, idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    out = dispatch.apply(
+        "topk",
+        _topk,
+        (x,),
+        {
+            "k": int(k),
+            "axis": normalize_axis(axis),
+            "largest": bool(largest),
+            "sorted": bool(sorted),
+        },
+    )
+    return out[0], out[1]
+
+
+def _kthvalue(x, *, k, axis, keepdim):
+    ax = axis
+    vals = jnp.sort(x, axis=ax)
+    idxs = jnp.argsort(x, axis=ax).astype(jnp.int64)
+    v = jnp.take(vals, k - 1, axis=ax)
+    i = jnp.take(idxs, k - 1, axis=ax)
+    if keepdim:
+        v = jnp.expand_dims(v, ax)
+        i = jnp.expand_dims(i, ax)
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    out = dispatch.apply(
+        "kthvalue",
+        _kthvalue,
+        (x,),
+        {"k": int(k), "axis": int(axis), "keepdim": bool(keepdim)},
+    )
+    return out[0], out[1]
+
+
+def _mode(x, *, axis, keepdim):
+    sorted_x = jnp.sort(x, axis=axis)
+    # mode = most frequent; for float data fall back to median-of-sorted trick
+    n = x.shape[axis]
+    runs = jnp.concatenate(
+        [
+            jnp.ones(sorted_x.shape[:axis] + (1,) + sorted_x.shape[axis + 1 :], bool),
+            jnp.take(sorted_x, jnp.arange(1, n), axis=axis)
+            != jnp.take(sorted_x, jnp.arange(0, n - 1), axis=axis),
+        ],
+        axis=axis,
+    )
+    run_id = jnp.cumsum(runs, axis=axis)
+    # count run lengths via segment trick: for each pos, count matches of its id
+    counts = jnp.sum(
+        run_id[..., None] == jnp.moveaxis(run_id, axis, -1)[..., None, :], axis=-1
+    ) if axis == x.ndim - 1 else None
+    if counts is None:
+        raise NotImplementedError("mode only supports the last axis")
+    best = jnp.argmax(counts, axis=axis)
+    v = jnp.take_along_axis(sorted_x, best[..., None], axis=axis)[..., 0]
+    i = jnp.argmax(x == v[..., None], axis=axis).astype(jnp.int64)
+    if keepdim:
+        v, i = v[..., None], i[..., None]
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    ax = int(axis) % x.ndim
+    if ax != x.ndim - 1:
+        raise NotImplementedError("mode currently supports the last axis only")
+    out = dispatch.apply(
+        "mode", _mode, (x,), {"axis": ax, "keepdim": bool(keepdim)}
+    )
+    return out[0], out[1]
+
+
+def _searchsorted(a, v, *, right):
+    return jnp.searchsorted(a, v, side="right" if right else "left").astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = dispatch.apply(
+        "searchsorted",
+        _searchsorted,
+        (sorted_sequence, values),
+        {"right": bool(right)},
+        nondiff=True,
+    )
+    return out.astype(jnp.int32) if out_int32 else out
+
+
+def nonzero(x, as_tuple=False, name=None):
+    if tape.in_trace():
+        raise RuntimeError(
+            "nonzero has a data-dependent output shape and cannot run inside "
+            "a jit trace on TPU"
+        )
+    xv = np.asarray(x.value if isinstance(x, Tensor) else x)
+    idx = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    indices_u = tuple(
+        i.value if isinstance(i, Tensor) else i for i in indices
+    )
+
+    def _ip(xv, vv):
+        return xv.at[indices_u].add(vv) if accumulate else xv.at[indices_u].set(vv)
+
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, x.value.dtype))
+    return dispatch.apply("index_put", _ip, (x, value), cache=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

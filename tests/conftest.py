@@ -1,0 +1,26 @@
+"""Test environment: force CPU jax with 8 virtual devices.
+
+Mirrors the reference's custom_cpu fake-device CI trick (SURVEY.md §4): the
+full framework runs against host-CPU XLA with a virtual 8-device mesh so
+every parallelism axis (dp/mp/pp/sharding/sep/ep) is exercised without TPU
+hardware. The driver separately validates the real-chip path.
+
+Note: the axon sitecustomize imports jax at interpreter start, so env vars
+alone are too late — but backends initialize lazily, so flipping
+jax_platforms + XLA_FLAGS here (before any backend touch) still works.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# x64 available: the OpTest harness needs float64 for finite-difference
+# gradient checks (production default dtype is still float32 via creation ops).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,198 @@
+"""Eager autograd engine tests: backward walker, hooks, PyLayer, grad API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def _leaf(data):
+    t = paddle.to_tensor(np.asarray(data, dtype=np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def test_simple_chain():
+    x = _leaf([1.0, 2.0, 3.0])
+    y = (x * x + 2 * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 2)
+
+
+def test_fanout_accumulation():
+    x = _leaf([2.0])
+    a = x * 3
+    b = x * 4
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_diamond():
+    x = _leaf([1.5])
+    a = x * x
+    b = a * 2
+    c = a * 3
+    (b + c).sum().backward()
+    # d/dx (2x^2 + 3x^2) = 10x
+    np.testing.assert_allclose(x.grad.numpy(), [15.0])
+
+
+def test_grad_accumulates_across_backwards():
+    x = _leaf([1.0])
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_retain_graph():
+    x = _leaf([1.0])
+    y = x * 5
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = _leaf([1.0])
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="released"):
+        y.backward()
+
+
+def test_stop_gradient_blocks():
+    x = _leaf([1.0])
+    y = _leaf([2.0])
+    z = x * y.detach()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = _leaf([[1.0, 2.0], [3.0, 4.0]])
+    y = x * 2
+    y.backward(paddle.to_tensor(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 2)))
+
+
+def test_hook_modifies_grad():
+    x = _leaf([1.0, 1.0])
+    handle = x.register_hook(lambda g: g * 10)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [30.0, 30.0])
+    handle.remove()
+    x.clear_grad()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_retain_grads_intermediate():
+    x = _leaf([2.0])
+    y = x * 3
+    y.retain_grads()
+    (y * 4).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [4.0])
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_paddle_grad_api():
+    x = _leaf([1.0, 2.0])
+    y = _leaf([3.0, 4.0])
+    z = (x * y).sum()
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), y.numpy())
+    np.testing.assert_allclose(gy.numpy(), x.numpy())
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_grad_allow_unused():
+    x = _leaf([1.0])
+    y = _leaf([1.0])
+    z = (x * 2).sum()
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, [x, y])
+    gx, gy = paddle.grad((x * 2).sum(), [x, y], allow_unused=True)
+    assert gy is None
+
+
+def test_no_grad_context():
+    x = _leaf([1.0])
+    with paddle.no_grad():
+        y = x * 2
+    assert y._node is None and y.stop_gradient
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 3
+
+    assert f(x)._node is None
+
+
+def test_multi_output_grads():
+    x = _leaf([[3.0, 1.0, 2.0]])
+    v, i = paddle.topk(x, 2, axis=1)
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+def test_split_partial_use():
+    x = _leaf([1.0, 2.0, 3.0, 4.0])
+    a, b = paddle.split(x, 2)
+    (b * 5).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 0.0, 5.0, 5.0])
+
+
+def test_setitem_gradient():
+    v = _leaf([7.0])
+    p = _leaf([1.0, 2.0, 3.0])
+    q = p * 1.0
+    q[1:2] = v
+    q.sum().backward()
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+    np.testing.assert_allclose(p.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_pylayer():
+    class TripleMinus(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a)
+            return a * 3 - b
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return g * 3, -g
+
+    a, b = _leaf([2.0]), _leaf([5.0])
+    out = TripleMinus.apply(a, b)
+    out.sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [3.0])
+    np.testing.assert_allclose(b.grad.numpy(), [-1.0])
+
+
+def test_backward_inside_jit_trace():
+    """The tape must work on tracers: jit a whole fwd+bwd step."""
+    import jax
+
+    from paddle_tpu.core import tape
+
+    def step(xv):
+        with tape.trace_scope():
+            x = paddle.Tensor(xv, stop_gradient=False)
+            loss = (x * x).sum()
+            loss.backward()
+            return x.grad.value
+
+    g = jax.jit(step)(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+def test_clear_grad_and_zero():
+    x = _leaf([1.0])
+    (x * 2).backward()
+    x.clear_gradient(set_to_zero=True)
+    np.testing.assert_allclose(x.grad.numpy(), [0.0])
+    x.clear_grad()
+    assert x.grad is None

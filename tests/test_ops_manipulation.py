@@ -1,0 +1,210 @@
+"""Numpy-oracle tests for shape/indexing ops."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+rng = np.random.default_rng(1)
+
+
+def _f32(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_reshape_zero_dim_copy():
+    x = _f32(2, 3, 4)
+    out = paddle.reshape(paddle.to_tensor(x), [0, -1])
+    assert out.shape == [2, 12]
+
+
+def test_transpose_flatten_squeeze():
+    x = _f32(2, 3, 4)
+    np.testing.assert_array_equal(
+        paddle.transpose(paddle.to_tensor(x), [2, 0, 1]).numpy(),
+        np.transpose(x, (2, 0, 1)),
+    )
+    assert paddle.flatten(paddle.to_tensor(x), 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(paddle.to_tensor(x), [0, 2]).shape == [1, 2, 1, 3, 4]
+    assert paddle.squeeze(paddle.to_tensor(x[:1]), 0).shape == [3, 4]
+
+
+def test_concat_stack_split():
+    xs = [_f32(2, 3) for _ in range(3)]
+    np.testing.assert_array_equal(
+        paddle.concat([paddle.to_tensor(x) for x in xs], axis=1).numpy(),
+        np.concatenate(xs, axis=1),
+    )
+    np.testing.assert_array_equal(
+        paddle.stack([paddle.to_tensor(x) for x in xs], axis=0).numpy(),
+        np.stack(xs, axis=0),
+    )
+    parts = paddle.split(paddle.to_tensor(_f32(6, 3)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 3]
+    parts = paddle.split(paddle.to_tensor(_f32(7, 3)), [2, -1, 1], axis=0)
+    assert [p.shape[0] for p in parts] == [2, 4, 1]
+
+
+def test_concat_grad():
+    a = paddle.to_tensor(_f32(2, 2))
+    b = paddle.to_tensor(_f32(3, 2))
+    a.stop_gradient = b.stop_gradient = False
+    out = paddle.concat([a, b], axis=0)
+    (out * out).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), 2 * a.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), 2 * b.numpy(), rtol=1e-5)
+
+
+def test_tile_expand_flip_roll():
+    x = _f32(2, 3)
+    np.testing.assert_array_equal(
+        paddle.tile(paddle.to_tensor(x), [2, 1]).numpy(), np.tile(x, (2, 1))
+    )
+    assert paddle.expand(paddle.to_tensor(x[:, :1]), [2, 5]).shape == [2, 5]
+    assert paddle.expand(paddle.to_tensor(x), [4, -1, -1]).shape == [4, 2, 3]
+    np.testing.assert_array_equal(
+        paddle.flip(paddle.to_tensor(x), [0]).numpy(), np.flip(x, 0)
+    )
+    np.testing.assert_array_equal(
+        paddle.roll(paddle.to_tensor(x), 1, 0).numpy(), np.roll(x, 1, 0)
+    )
+
+
+def test_gather_scatter():
+    x = _f32(5, 3)
+    idx = np.array([0, 2, 4])
+    np.testing.assert_array_equal(
+        paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx), axis=0).numpy(),
+        x[idx],
+    )
+    upd = _f32(2, 3)
+    out = paddle.scatter(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([1, 3])),
+        paddle.to_tensor(upd),
+    )
+    ref = x.copy()
+    ref[[1, 3]] = upd
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_gather_nd_take_along():
+    x = _f32(3, 4)
+    idx = np.array([[0, 1], [2, 3]])
+    np.testing.assert_array_equal(
+        paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+        x[idx[:, 0], idx[:, 1]],
+    )
+    ta_idx = np.array([[0], [1], [2]])
+    np.testing.assert_array_equal(
+        paddle.take_along_axis(
+            paddle.to_tensor(x), paddle.to_tensor(ta_idx), axis=1
+        ).numpy(),
+        np.take_along_axis(x, ta_idx, axis=1),
+    )
+
+
+def test_where_masked():
+    x, y = _f32(3, 4), _f32(3, 4)
+    cond = x > 0
+    np.testing.assert_array_equal(
+        paddle.where(
+            paddle.to_tensor(cond), paddle.to_tensor(x), paddle.to_tensor(y)
+        ).numpy(),
+        np.where(cond, x, y),
+    )
+    np.testing.assert_array_equal(
+        paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(cond)).numpy(),
+        x[cond],
+    )
+    np.testing.assert_array_equal(
+        paddle.masked_fill(paddle.to_tensor(x), paddle.to_tensor(cond), 0.0).numpy(),
+        np.where(cond, 0.0, x).astype(np.float32),
+    )
+
+
+def test_sort_topk_argmax():
+    x = _f32(4, 6)
+    np.testing.assert_array_equal(
+        paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, axis=1)
+    )
+    np.testing.assert_array_equal(
+        paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), np.argmax(x, axis=1)
+    )
+    v, i = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+    ref_i = np.argsort(-x, axis=1)[:, :3]
+    np.testing.assert_array_equal(i.numpy(), ref_i)
+    np.testing.assert_allclose(v.numpy(), np.take_along_axis(x, ref_i, 1))
+
+
+def test_pad():
+    x = _f32(2, 3, 4, 5)  # NCHW
+    out = paddle.pad(paddle.to_tensor(x), [1, 2, 3, 4])  # W:(1,2), H:(3,4)
+    ref = np.pad(x, [(0, 0), (0, 0), (3, 4), (1, 2)])
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_tril_triu_diag():
+    x = _f32(4, 4)
+    np.testing.assert_array_equal(paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+    np.testing.assert_array_equal(
+        paddle.triu(paddle.to_tensor(x), 1).numpy(), np.triu(x, 1)
+    )
+    v = _f32(4)
+    np.testing.assert_array_equal(paddle.diag(paddle.to_tensor(v)).numpy(), np.diag(v))
+
+
+def test_unique_nonzero_eager():
+    x = np.array([1, 3, 1, 2, 3])
+    np.testing.assert_array_equal(
+        paddle.unique(paddle.to_tensor(x)).numpy(), np.unique(x)
+    )
+    nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+def test_one_hot_cast():
+    x = np.array([0, 2, 1])
+    oh = paddle.one_hot(paddle.to_tensor(x), 3)
+    np.testing.assert_array_equal(oh.numpy(), np.eye(3, dtype=np.float32)[x])
+    assert paddle.cast(paddle.to_tensor(x), "float32").dtype == np.float32
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype == np.int32
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+    assert paddle.full([2], 7).item(0) == 7
+    paddle.seed(42)
+    r1 = paddle.rand([3, 3]).numpy()
+    paddle.seed(42)
+    r2 = paddle.rand([3, 3]).numpy()
+    np.testing.assert_array_equal(r1, r2)
+
+
+def test_linalg_basics():
+    a = _f32(3, 3) + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.linalg.inv(paddle.to_tensor(a)).numpy(), np.linalg.inv(a), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        paddle.linalg.det(paddle.to_tensor(a)).numpy(), np.linalg.det(a), rtol=1e-4
+    )
+    b = _f32(3, 2)
+    np.testing.assert_allclose(
+        paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.linalg.solve(a, b),
+        rtol=1e-4,
+    )
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy(),
+        np.linalg.cholesky(spd),
+        rtol=1e-4,
+    )
+    x = _f32(4, 3)
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x)).numpy(), np.linalg.norm(x), rtol=1e-5
+    )

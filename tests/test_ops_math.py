@@ -1,0 +1,186 @@
+"""Numpy-oracle tests for math/reduction ops (OpTest pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+rng = np.random.default_rng(0)
+
+
+def _f32(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _pos(*shape):
+    return (rng.random(shape) + 0.5).astype(np.float32)
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp, _f32(3, 4)),
+    (paddle.log, np.log, _pos(3, 4)),
+    (paddle.sqrt, np.sqrt, _pos(3, 4)),
+    (paddle.tanh, np.tanh, _f32(3, 4)),
+    (paddle.abs, np.abs, _f32(3, 4)),
+    (paddle.floor, np.floor, _f32(3, 4)),
+    (paddle.ceil, np.ceil, _f32(3, 4)),
+    (paddle.square, np.square, _f32(3, 4)),
+    (paddle.sign, np.sign, _f32(3, 4)),
+    (paddle.sin, np.sin, _f32(3, 4)),
+    (paddle.cos, np.cos, _f32(3, 4)),
+    (paddle.log1p, np.log1p, _pos(3, 4)),
+    (paddle.reciprocal, np.reciprocal, _pos(3, 4)),
+]
+
+
+@pytest.mark.parametrize("op,ref,x", UNARY_CASES, ids=lambda c: getattr(c, "__name__", ""))
+def test_unary_forward(op, ref, x):
+    check_forward(op, ref, [x])
+
+
+BINARY_CASES = [
+    (paddle.add, np.add),
+    (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply),
+    (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum),
+    (paddle.minimum, np.minimum),
+    (paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY_CASES, ids=lambda c: getattr(c, "__name__", ""))
+def test_binary_forward(op, ref):
+    x, y = _pos(3, 4), _pos(3, 4)
+    check_forward(op, ref, [x, y])
+
+
+def test_broadcasting():
+    x, y = _f32(3, 1, 4), _f32(5, 1)
+    check_forward(paddle.add, np.add, [x, y])
+
+
+def test_matmul_variants():
+    a, b = _f32(3, 4), _f32(4, 5)
+    check_forward(paddle.matmul, np.matmul, [a, b])
+    out = paddle.matmul(
+        paddle.to_tensor(a), paddle.to_tensor(b.T), transpose_y=True
+    )
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    # batched
+    a3, b3 = _f32(2, 3, 4), _f32(2, 4, 5)
+    check_forward(paddle.bmm, np.matmul, [a3, b3])
+
+
+def test_reductions():
+    x = _f32(3, 4, 5)
+    for op, ref in [
+        (paddle.sum, np.sum),
+        (paddle.mean, np.mean),
+        (paddle.max, np.max),
+        (paddle.min, np.min),
+        (paddle.prod, np.prod),
+    ]:
+        np.testing.assert_allclose(
+            op(paddle.to_tensor(x)).numpy(), ref(x), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            op(paddle.to_tensor(x), axis=1).numpy(), ref(x, axis=1), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            op(paddle.to_tensor(x), axis=[0, 2], keepdim=True).numpy(),
+            ref(x, axis=(0, 2), keepdims=True),
+            rtol=1e-4,
+        )
+
+
+def test_std_var_unbiased():
+    x = _f32(4, 6)
+    np.testing.assert_allclose(
+        paddle.std(paddle.to_tensor(x), axis=1).numpy(),
+        np.std(x, axis=1, ddof=1),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        paddle.var(paddle.to_tensor(x), unbiased=False).numpy(),
+        np.var(x),
+        rtol=1e-4,
+    )
+
+
+def test_cumsum_logsumexp():
+    x = _f32(3, 4)
+    np.testing.assert_allclose(
+        paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+        np.cumsum(x, axis=1),
+        rtol=1e-5,
+    )
+    from scipy.special import logsumexp as sls
+
+    np.testing.assert_allclose(
+        paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+        sls(x, axis=1),
+        rtol=1e-5,
+    )
+
+
+def test_clip_scale():
+    x = _f32(3, 4)
+    np.testing.assert_allclose(
+        paddle.clip(paddle.to_tensor(x), -0.5, 0.5).numpy(),
+        np.clip(x, -0.5, 0.5),
+    )
+    np.testing.assert_allclose(
+        paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0).numpy(),
+        x * 2 + 1,
+        rtol=1e-6,
+    )
+
+
+def test_grad_unary():
+    check_grad(paddle.tanh, [rng.standard_normal((2, 3))])
+    check_grad(paddle.exp, [rng.standard_normal((2, 3)) * 0.5])
+    check_grad(paddle.sqrt, [(rng.random((2, 3)) + 0.5)])
+
+
+def test_grad_binary():
+    x = rng.standard_normal((2, 3))
+    y = rng.standard_normal((2, 3))
+    check_grad(paddle.multiply, [x, y])
+    check_grad(paddle.divide, [x, (np.abs(y) + 1.0)])
+
+
+def test_grad_matmul():
+    check_grad(
+        paddle.matmul,
+        [rng.standard_normal((2, 3)), rng.standard_normal((3, 2))],
+    )
+
+
+def test_grad_reduction():
+    check_grad(paddle.mean, [rng.standard_normal((3, 3))])
+    check_grad(
+        paddle.logsumexp, [rng.standard_normal((3, 3))], kwargs={"axis": 1}
+    )
+
+
+def test_einsum():
+    a, b = _f32(3, 4), _f32(4, 5)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.einsum("ij,jk->ik", a, b),
+        rtol=1e-5,
+    )
+
+
+def test_comparison_and_logical():
+    x, y = _f32(3, 4), _f32(3, 4)
+    assert (paddle.equal(paddle.to_tensor(x), paddle.to_tensor(x))).numpy().all()
+    np.testing.assert_array_equal(
+        paddle.less_than(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(), x < y
+    )
+    m = paddle.to_tensor(x > 0)
+    np.testing.assert_array_equal(
+        paddle.logical_not(m).numpy(), ~(x > 0)
+    )
+    assert bool(paddle.allclose(paddle.to_tensor(x), paddle.to_tensor(x)))
