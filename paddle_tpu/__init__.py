@@ -107,3 +107,12 @@ def disable_signal_handler():
 
 # subsystem namespaces — extended as subsystems land (build order: SURVEY §7)
 from . import linalg  # noqa: E402
+from . import regularizer  # noqa: E402
+from .regularizer import L1Decay, L2Decay  # noqa: E402
+from . import framework  # noqa: E402
+from .framework.io import load, save  # noqa: E402
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import distributed  # noqa: E402
+from .nn.layer.layers import ParamAttr  # noqa: E402

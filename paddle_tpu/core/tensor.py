@@ -407,7 +407,14 @@ class Parameter(Tensor):
     unverified). stop_gradient defaults False; optimizers discover these via
     Layer.parameters()."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = (
+        "trainable",
+        "optimize_attr",
+        "regularizer",
+        "is_distributed",
+        "need_clip",
+        "split_axis",
+    )
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -415,6 +422,8 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.need_clip = True
+        self.split_axis = None  # set by TP layers (mp partition axis)
         self.persistable = True
 
 

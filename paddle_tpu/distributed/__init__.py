@@ -1,0 +1,11 @@
+"""paddle.distributed namespace — populated across build stages (SURVEY §7).
+
+Currently: env contract (rank/world size). Comm API, fleet, launch, and the
+parallel wrappers land with the distributed foundation stage.
+"""
+from .env import (  # noqa: F401
+    get_current_endpoint,
+    get_rank,
+    get_trainer_endpoints,
+    get_world_size,
+)

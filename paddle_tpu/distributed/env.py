@@ -1,0 +1,43 @@
+"""Process-level distributed environment.
+
+Reference parity: the PADDLE_TRAINER_* env contract set by
+paddle.distributed.launch (python/paddle/distributed/launch/ — unverified,
+mount empty) and consumed by fleet/parallel init. On TPU the same contract
+maps onto jax.distributed's process index/count.
+"""
+from __future__ import annotations
+
+import os
+
+
+def get_rank():
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size():
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_trainer_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def get_current_endpoint():
+    return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")

@@ -1,0 +1,15 @@
+"""paddle.framework namespace: save/load + misc framework utilities."""
+from ..core.device import CPUPlace, Place, TPUPlace, get_device, set_device  # noqa: F401
+from ..core.dtypes import get_default_dtype, set_default_dtype  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..core.tensor import Parameter  # noqa: F401
+from ..nn.layer.layers import ParamAttr  # noqa: F401
+from .io import load, save  # noqa: F401
+
+
+def in_dygraph_mode():
+    return True
+
+
+def in_dynamic_mode():
+    return True

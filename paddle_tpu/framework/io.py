@@ -1,0 +1,76 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py (unverified, mount empty).
+Format: pickle with Tensors converted to numpy arrays tagged so load can
+rebuild Tensors — interchange-compatible with state dicts of numpy arrays
+(and therefore loadable by/loadable-from the reference's unpickled state
+dicts for parity testing).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+class _TensorPayload:
+    """Pickle-stable tag for tensors (stores numpy + metadata)."""
+
+    def __init__(self, array, stop_gradient=True, is_parameter=False, name=None):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.is_parameter = is_parameter
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, True, obj.name)
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, False, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        import jax.numpy as jnp
+
+        if obj.is_parameter:
+            t = Parameter(jnp.asarray(obj.array), name=obj.name)
+            t.stop_gradient = obj.stop_gradient
+            return t
+        t = Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
+                   name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    # tolerate foreign pickles holding bare numpy arrays (reference format)
+    return _unpack(obj, return_numpy)

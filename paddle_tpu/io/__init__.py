@@ -1,0 +1,28 @@
+"""Data pipeline: Dataset / DataLoader / samplers.
+
+Reference parity: python/paddle/io/ (unverified, mount empty). The
+reference's multiprocess C++ reader ops are replaced by a background
+prefetch thread pool feeding pinned numpy batches; on TPU the host→device
+transfer is overlapped by jax's async dispatch. DistributedBatchSampler
+keeps the exact rank-sharding semantics Fleet relies on.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)

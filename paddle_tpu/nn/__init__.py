@@ -1,0 +1,29 @@
+"""paddle.nn namespace parity (python/paddle/nn/__init__.py — unverified)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer, ParamAttr  # noqa: F401
+
+
+class ClipGradByGlobalNorm:
+    """Forward decl — real implementation in paddle_tpu.optimizer.clip;
+    re-exported there. Kept import-light to avoid cycles."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..optimizer.clip import ClipGradByGlobalNorm as Impl
+
+        return Impl(*args, **kwargs)
+
+
+class ClipGradByNorm:
+    def __new__(cls, *args, **kwargs):
+        from ..optimizer.clip import ClipGradByNorm as Impl
+
+        return Impl(*args, **kwargs)
+
+
+class ClipGradByValue:
+    def __new__(cls, *args, **kwargs):
+        from ..optimizer.clip import ClipGradByValue as Impl
+
+        return Impl(*args, **kwargs)

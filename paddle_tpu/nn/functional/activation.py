@@ -1,0 +1,272 @@
+"""Activation functionals (python/paddle/nn/functional/activation.py parity,
+unverified, mount empty). Pure jnp compositions — XLA fuses these into
+adjacent matmuls on TPU, which is why no hand-written fused kernels exist."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops._helpers import unary
+
+relu = unary("relu", jax.nn.relu)
+relu6 = unary("relu6", jax.nn.relu6)
+sigmoid = unary("sigmoid", jax.nn.sigmoid)
+tanh = unary("tanh", jnp.tanh)
+silu = unary("silu", jax.nn.silu)
+swish = silu
+mish = unary("mish", jax.nn.mish)
+softsign = unary("softsign", jax.nn.soft_sign)
+tanhshrink = unary("tanhshrink", lambda x: x - jnp.tanh(x))
+hardswish = unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+
+
+def _gelu(x, *, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch.apply("gelu", _gelu, (x,), {"approximate": bool(approximate)})
+
+
+def _leaky_relu(x, *, slope):
+    return jax.nn.leaky_relu(x, slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch.apply(
+        "leaky_relu", _leaky_relu, (x,), {"slope": float(negative_slope)}
+    )
+
+
+def _elu(x, *, alpha):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch.apply("elu", _elu, (x,), {"alpha": float(alpha)})
+
+
+def _celu(x, *, alpha):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch.apply("celu", _celu, (x,), {"alpha": float(alpha)})
+
+
+def _selu(x, *, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(
+    x,
+    scale=1.0507009873554805,
+    alpha=1.6732632423543772,
+    name=None,
+):
+    return dispatch.apply(
+        "selu", _selu, (x,), {"scale": float(scale), "alpha": float(alpha)}
+    )
+
+
+def _softmax(x, *, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = dispatch.apply("softmax", _softmax, (x,), {"axis": int(axis)})
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def _log_softmax(x, *, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = dispatch.apply("log_softmax", _log_softmax, (x,), {"axis": int(axis)})
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def _softplus(x, *, beta, threshold):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return dispatch.apply(
+        "softplus", _softplus, (x,), {"beta": float(beta), "threshold": float(threshold)}
+    )
+
+
+def _softshrink(x, *, threshold):
+    return jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch.apply(
+        "softshrink", _softshrink, (x,), {"threshold": float(threshold)}
+    )
+
+
+def _hardshrink(x, *, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch.apply(
+        "hardshrink", _hardshrink, (x,), {"threshold": float(threshold)}
+    )
+
+
+def _hardtanh(x, *, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch.apply("hardtanh", _hardtanh, (x,), {"mn": float(min), "mx": float(max)})
+
+
+def _thresholded_relu(x, *, threshold, value):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch.apply(
+        "thresholded_relu",
+        _thresholded_relu,
+        (x,),
+        {"threshold": float(threshold), "value": float(value)},
+    )
+
+
+def _prelu(x, w):
+    if w.size == 1:
+        return jnp.where(x >= 0, x, w.reshape(()) * x)
+    # channel-wise: weight has num_channels elements; data is NC...
+    shape = [1] * x.ndim
+    shape[1] = w.size
+    return jnp.where(x >= 0, x, w.reshape(shape) * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return dispatch.apply("prelu", _prelu, (x, weight))
+
+
+def _rrelu_eval(x, *, lower, upper):
+    return jnp.where(x >= 0, x, 0.5 * (lower + upper) * x)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    if training:
+        from ...core import random as random_mod
+
+        k = random_mod.next_key()
+
+        def _rrelu_train(xv):
+            a = jax.random.uniform(
+                k, xv.shape, xv.dtype, minval=lower, maxval=upper
+            )
+            return jnp.where(xv >= 0, xv, a * xv)
+
+        return dispatch.apply("rrelu_train", _rrelu_train, (x,), cache=False)
+    return dispatch.apply(
+        "rrelu", _rrelu_eval, (x,), {"lower": float(lower), "upper": float(upper)}
+    )
+
+
+def _glu(x, *, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch.apply("glu", _glu, (x,), {"axis": int(axis)})
+
+
+def _maxout(x, *, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis] = c // groups
+    shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return dispatch.apply(
+        "maxout", _maxout, (x,), {"groups": int(groups), "axis": int(axis)}
+    )
+
+
+def _softmax_with_cross_entropy(logits, label, *, soft_label, axis, ignore_index):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis, keepdims=True)
+    lbl = label
+    squeeze = False
+    if lbl.ndim == logits.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+        squeeze = True
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(lbl, axis), axis=axis
+    )
+    loss = -picked
+    if ignore_index >= 0:
+        mask = jnp.expand_dims(lbl, axis) != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+    return loss
+
+
+def softmax_with_cross_entropy(
+    logits,
+    label,
+    soft_label=False,
+    ignore_index=-100,
+    numeric_stable_mode=True,
+    return_softmax=False,
+    axis=-1,
+):
+    loss = dispatch.apply(
+        "softmax_with_cross_entropy",
+        _softmax_with_cross_entropy,
+        (logits, label),
+        {
+            "soft_label": bool(soft_label),
+            "axis": int(axis),
+            "ignore_index": int(ignore_index),
+        },
+    )
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def _gumbel_softmax(x, key, *, temperature, hard, axis):
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, x.shape) + 1e-20) + 1e-20)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard - jax.lax.stop_gradient(y) + y
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as random_mod
+
+    key = random_mod.next_key()  # raw key array: non-Tensor, non-diff arg
+
+    def _gs(xv, kv):
+        return _gumbel_softmax(
+            xv, kv, temperature=temperature, hard=hard, axis=axis
+        )
+
+    return dispatch.apply("gumbel_softmax", _gs, (x, key), cache=False)

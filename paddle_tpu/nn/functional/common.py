@@ -1,0 +1,295 @@
+"""Common functionals: linear, dropout, interpolate, pad, unfold, cosine_sim.
+
+Reference parity: python/paddle/nn/functional/common.py (unverified, mount
+empty). linear keeps paddle's [in, out] weight layout — a straight MXU matmul.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core import random as random_mod
+from ...ops.manipulation import pad  # re-export, paddle exposes F.pad  # noqa: F401
+
+
+def _linear(x, w, b):
+    y = jnp.matmul(x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def linear(x, weight, bias=None, name=None):
+    return dispatch.apply("linear", _linear, (x, weight, bias))
+
+
+def _dropout_train(x, key, *, p, upscale):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def _dropout_downscale_infer(x, *, p):
+    return x * (1.0 - p)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if p == 0.0 or not training:
+        if mode == "downgrade_in_infer" and not training and p > 0:
+            return dispatch.apply(
+                "dropout_infer", _dropout_downscale_infer, (x,), {"p": float(p)}
+            )
+        return x
+    key = random_mod.next_key()
+    if axis is not None:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+
+        def _dropout_axis(xv, kv):
+            shape = [
+                s if i in axes else 1 for i, s in enumerate(xv.shape)
+            ]
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(kv, keep, shape)
+            if mode == "upscale_in_train":
+                return jnp.where(mask, xv / keep, 0.0).astype(xv.dtype)
+            return jnp.where(mask, xv, 0.0).astype(xv.dtype)
+
+        return dispatch.apply("dropout_axis", _dropout_axis, (x, key), cache=False)
+    return dispatch.apply(
+        "dropout",
+        _dropout_train,
+        (x, key),
+        {"p": float(p), "upscale": mode == "upscale_in_train"},
+    )
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+
+    def _alpha_dropout(xv, kv):
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(kv, keep, xv.shape)
+        a = (keep + p * alpha_p**2 * keep) ** -0.5
+        b = -a * alpha_p * p
+        return (a * jnp.where(mask, xv, alpha_p) + b).astype(xv.dtype)
+
+    return dispatch.apply("alpha_dropout", _alpha_dropout, (x, key), cache=False)
+
+
+def _cosine_similarity(x1, x2, *, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return dispatch.apply(
+        "cosine_similarity",
+        _cosine_similarity,
+        (x1, x2),
+        {"axis": int(axis), "eps": float(eps)},
+    )
+
+
+def _interp_size(x, size, scale_factor, data_format):
+    nd = x.ndim - 2
+    if data_format.startswith("NC"):
+        spatial = x.shape[2:]
+    else:
+        spatial = x.shape[1:-1]
+    if size is not None:
+        if hasattr(size, "tolist"):
+            size = size.tolist()
+        out = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * nd))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+        out = tuple(int(s * f) for s, f in zip(spatial, sf))
+    return out
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    out_spatial = _interp_size(x, size, scale_factor, data_format)
+    channel_first = data_format.startswith("NC")
+    jmode = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "linear": "linear",
+        "trilinear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+
+    def _interp(xv):
+        v = xv
+        if channel_first:
+            # jax.image.resize wants explicit full shape
+            full = v.shape[:2] + out_spatial
+        else:
+            full = (v.shape[0],) + out_spatial + (v.shape[-1],)
+        if mode == "nearest":
+            return jax.image.resize(v, full, method="nearest")
+        return jax.image.resize(v, full, method=jmode)
+
+    return dispatch.apply("interpolate", _interp, (x,), cache=False)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def _unfold(x, *, k, s, p, d):
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])))
+    kh, kw = k
+    oh = (xp.shape[2] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+    ow = (xp.shape[3] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp,
+        filter_shape=(kh, kw),
+        window_strides=s,
+        padding="VALID",
+        rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v, n=2):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _pair(paddings, 4)
+    if len(p) == 2:
+        p = (p[0], p[0], p[1], p[1])
+    return dispatch.apply(
+        "unfold", _unfold, (x,), {"k": k, "s": s, "p": p, "d": d}
+    )
+
+
+def _fold(x, *, output_sizes, k, s, p, d):
+    n, ckk, l = x.shape
+    c = ckk // (k[0] * k[1])
+    oh, ow = output_sizes
+    ph = oh + p[0] + p[1]
+    pw = ow + p[2] + p[3]
+    lh = (ph - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    lw = (pw - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    xr = x.reshape(n, c, k[0], k[1], lh, lw)
+    out = jnp.zeros((n, c, ph, pw), x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            out = out.at[
+                :, :, i * d[0] : i * d[0] + lh * s[0] : s[0],
+                j * d[1] : j * d[1] + lw * s[1] : s[1],
+            ].add(xr[:, :, i, j])
+    return out[:, :, p[0] : p[0] + oh, p[2] : p[2] + ow]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v, n=2):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _pair(paddings, 4)
+    if len(p) == 2:
+        p = (p[0], p[0], p[1], p[1])
+    return dispatch.apply(
+        "fold",
+        _fold,
+        (x,),
+        {"output_sizes": tuple(output_sizes), "k": k, "s": s, "p": p, "d": d},
+    )
+
+
+def _pixel_shuffle(x, *, r):
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    xv = x.reshape(n, oc, r, r, h, w)
+    xv = jnp.transpose(xv, (0, 1, 4, 2, 5, 3))
+    return xv.reshape(n, oc, h * r, w * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return dispatch.apply(
+        "pixel_shuffle", _pixel_shuffle, (x,), {"r": int(upscale_factor)}
+    )
+
+
+def _pixel_unshuffle(x, *, r):
+    n, c, h, w = x.shape
+    oh, ow = h // r, w // r
+    xv = x.reshape(n, c, oh, r, ow, r)
+    xv = jnp.transpose(xv, (0, 1, 3, 5, 2, 4))
+    return xv.reshape(n, c * r * r, oh, ow)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return dispatch.apply(
+        "pixel_unshuffle", _pixel_unshuffle, (x,), {"r": int(downscale_factor)}
+    )
+
+
+def _label_smooth(label, *, epsilon):
+    k = label.shape[-1]
+    return (1.0 - epsilon) * label + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        def _ls_prior(lv, pv):
+            return (1.0 - epsilon) * lv + epsilon * pv
+
+        return dispatch.apply("label_smooth_prior", _ls_prior, (label, prior_dist), cache=False)
+    return dispatch.apply(
+        "label_smooth", _label_smooth, (label,), {"epsilon": float(epsilon)}
+    )
+
+
+def _bilinear(x1, x2, w, b):
+    # w: [out, in1, in2]
+    y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return dispatch.apply("bilinear", _bilinear, (x1, x2, weight, bias))

@@ -1,0 +1,182 @@
+"""Convolution functionals over lax.conv_general_dilated.
+
+Reference parity: python/paddle/nn/functional/conv.py + phi conv kernels
+(unverified, mount empty). Weight layout matches paddle: [out_c, in_c/groups,
+*kernel]; data formats NCL/NCHW/NCDHW (channel-first default) and NHWC-style.
+XLA lowers these directly onto the MXU — no im2col or cuDNN-algo selection
+machinery is needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, n):
+    """Paddle padding spec -> lax padding: int, list, 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+        if all(isinstance(p, (list, tuple)) for p in flat):
+            # full-dim spec incl batch/channel: take spatial entries
+            spatial = flat[-n:]
+            return [(int(a), int(b)) for a, b in spatial]
+    return [(int(padding), int(padding))] * n
+
+
+def _dn(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "OIHW", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "OIDHW", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(x, w, b, *, nd, stride, padding, dilation, groups, channel_last):
+    dn = _dn(nd, channel_last)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if b is not None:
+        if channel_last:
+            out = out + b.reshape((1,) * (nd + 1) + (-1,))
+        else:
+            out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _conv(x, w, b, nd, stride, padding, dilation, groups, data_format):
+    channel_last = not data_format.startswith("NC")
+    kw = {
+        "nd": nd,
+        "stride": _tuplize(stride, nd),
+        "padding": _freeze_pad(_conv_padding(padding, nd)),
+        "dilation": _tuplize(dilation, nd),
+        "groups": int(groups),
+        "channel_last": channel_last,
+    }
+    return dispatch.apply(f"conv{nd}d", _conv_nd, (x, w, b), kw)
+
+
+def _freeze_pad(p):
+    return p if isinstance(p, str) else tuple(tuple(q) for q in p)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, 1, stride, padding, dilation, groups, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, 2, stride, padding, dilation, groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, 3, stride, padding, dilation, groups, data_format)
+
+
+def _conv_transpose_nd(
+    x, w, b, *, nd, stride, padding, output_padding, dilation, groups, channel_last
+):
+    dn = _dn(nd, channel_last)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    # lax.conv_transpose wants IO layout handled via dimension_numbers; use
+    # gradient-based formulation: conv_transpose == lhs-dilated conv
+    pad = padding
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        k = [w.shape[2 + i] for i in range(nd)]
+        lax_pad = [
+            (
+                dilation[i] * (k[i] - 1) - pad[i][0],
+                dilation[i] * (k[i] - 1) - pad[i][1] + output_padding[i],
+            )
+            for i in range(nd)
+        ]
+    # weight [in, out/g, *k] -> flip spatial, swap to [out, in/g, *k]
+    wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    if groups == 1:
+        wt = jnp.swapaxes(wf, 0, 1)
+    else:
+        ic, ocg = w.shape[0], w.shape[1]
+        wg = wf.reshape((groups, ic // groups, ocg) + w.shape[2:])
+        wg = jnp.swapaxes(wg, 1, 2)
+        wt = wg.reshape((groups * ocg, ic // groups) + w.shape[2:])
+    out = jax.lax.conv_general_dilated(
+        x,
+        wt,
+        window_strides=(1,) * nd,
+        padding=lax_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=_dn(nd, channel_last),
+        feature_group_count=groups,
+    )
+    if b is not None:
+        if channel_last:
+            out = out + b.reshape((1,) * (nd + 1) + (-1,))
+        else:
+            out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _conv_transpose(x, w, b, nd, stride, padding, output_padding, dilation,
+                    groups, data_format, output_size=None):
+    channel_last = not data_format.startswith("NC")
+    kw = {
+        "nd": nd,
+        "stride": _tuplize(stride, nd),
+        "padding": _freeze_pad(_conv_padding(padding, nd)),
+        "output_padding": _tuplize(output_padding, nd),
+        "dilation": _tuplize(dilation, nd),
+        "groups": int(groups),
+        "channel_last": channel_last,
+    }
+    return dispatch.apply(f"conv{nd}d_transpose", _conv_transpose_nd, (x, w, b), kw)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    return _conv_transpose(x, weight, bias, 1, stride, padding, output_padding,
+                           dilation, groups, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, 2, stride, padding, output_padding,
+                           dilation, groups, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, 3, stride, padding, output_padding,
+                           dilation, groups, data_format, output_size)

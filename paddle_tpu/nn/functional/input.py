@@ -1,0 +1,32 @@
+"""Embedding / one_hot functionals.
+
+Reference parity: python/paddle/nn/functional/input.py (unverified, mount
+empty). embedding is a gather — XLA lowers it to an efficient dynamic-gather
+on TPU; the VJP is a scatter-add, no custom grad kernel needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops.creation import one_hot  # noqa: F401  (paddle exposes F.one_hot)
+
+
+def _embedding(weight, x, *, padding_idx, sparse):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return dispatch.apply(
+        "embedding",
+        _embedding,
+        (weight, x),
+        {
+            "padding_idx": None if padding_idx is None else int(padding_idx),
+            "sparse": bool(sparse),
+        },
+    )

@@ -1,0 +1,325 @@
+"""Loss functionals.
+
+Reference parity: python/paddle/nn/functional/loss.py (unverified, mount
+empty). cross_entropy mirrors paddle semantics: integer or soft labels,
+ignore_index, per-class weight, reduction modes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _cross_entropy(logits, label, weight, *, soft_label, axis, ignore_index,
+                   reduction, use_softmax, label_smoothing):
+    axis_ = axis % logits.ndim
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis_)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    n_classes = logits.shape[axis_]
+
+    if soft_label or (label.ndim == logits.ndim and label.shape == logits.shape):
+        soft = label
+        if label_smoothing > 0:
+            soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(soft * logp, axis=axis_)
+        if weight is not None:
+            w = jnp.sum(soft * weight.reshape((1,) * axis_ + (-1,)), axis=axis_)
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    lbl = label
+    if lbl.ndim == logits.ndim and lbl.shape[axis_] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis_)
+    lbl = lbl.astype(jnp.int32)
+    safe_lbl = jnp.where(lbl == ignore_index, 0, lbl)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_lbl, axis_), axis=axis_
+    )
+    loss = -jnp.squeeze(picked, axis=axis_)
+    valid = lbl != ignore_index
+    if label_smoothing > 0:
+        smooth_loss = -jnp.mean(logp, axis=axis_)
+        loss = (1 - label_smoothing) * loss + label_smoothing * smooth_loss
+    if weight is not None:
+        w = weight[safe_lbl]
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if weight is not None:
+            denom = jnp.sum(jnp.where(valid, weight[safe_lbl], 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    return dispatch.apply(
+        "cross_entropy",
+        _cross_entropy,
+        (input, label, weight),
+        {
+            "soft_label": bool(soft_label),
+            "axis": int(axis),
+            "ignore_index": int(ignore_index),
+            "reduction": reduction,
+            "use_softmax": bool(use_softmax),
+            "label_smoothing": float(label_smoothing),
+        },
+    )
+
+
+def _nll_loss(logp, label, weight, *, ignore_index, reduction):
+    lbl = label.astype(jnp.int32)
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+    loss = -picked
+    valid = lbl != ignore_index
+    if weight is not None:
+        loss = loss * weight[safe]
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = (
+            jnp.sum(jnp.where(valid, weight[safe], 0.0))
+            if weight is not None
+            else jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        )
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return dispatch.apply(
+        "nll_loss",
+        _nll_loss,
+        (input, label, weight),
+        {"ignore_index": int(ignore_index), "reduction": reduction},
+    )
+
+
+def _mse_loss(x, y, *, reduction):
+    return _reduce(jnp.square(x - y), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch.apply("mse_loss", _mse_loss, (input, label), {"reduction": reduction})
+
+
+def _l1_loss(x, y, *, reduction):
+    return _reduce(jnp.abs(x - y), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch.apply("l1_loss", _l1_loss, (input, label), {"reduction": reduction})
+
+
+def _smooth_l1(x, y, *, reduction, delta):
+    d = x - y
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return dispatch.apply(
+        "smooth_l1_loss",
+        _smooth_l1,
+        (input, label),
+        {"reduction": reduction, "delta": float(delta)},
+    )
+
+
+def _bce(x, y, w, *, reduction):
+    loss = -(y * jnp.log(jnp.maximum(x, 1e-12)) + (1 - y) * jnp.log(jnp.maximum(1 - x, 1e-12)))
+    if w is not None:
+        loss = loss * w
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    return dispatch.apply(
+        "binary_cross_entropy", _bce, (input, label, weight), {"reduction": reduction}
+    )
+
+
+def _bce_logits(x, y, w, pos_w, *, reduction):
+    max_val = jnp.maximum(-x, 0.0)
+    if pos_w is not None:
+        log_w = (pos_w - 1.0) * y + 1.0
+        loss = (1 - y) * x + log_w * (
+            jnp.log(jnp.exp(-max_val) + jnp.exp(-x - max_val)) + max_val
+        )
+    else:
+        loss = (1 - y) * x + max_val + jnp.log(
+            jnp.exp(-max_val) + jnp.exp(-x - max_val)
+        )
+    if w is not None:
+        loss = loss * w
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    return dispatch.apply(
+        "bce_with_logits",
+        _bce_logits,
+        (logit, label, weight, pos_weight),
+        {"reduction": reduction},
+    )
+
+
+def _kl_div(x, y, *, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(y) * (y - x)
+    else:
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return dispatch.apply(
+        "kl_div",
+        _kl_div,
+        (input, label),
+        {"reduction": reduction, "log_target": bool(log_target)},
+    )
+
+
+def _margin_ranking(x1, x2, lbl, *, margin, reduction):
+    loss = jnp.maximum(0.0, -lbl * (x1 - x2) + margin)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return dispatch.apply(
+        "margin_ranking_loss",
+        _margin_ranking,
+        (input, other, label),
+        {"margin": float(margin), "reduction": reduction},
+    )
+
+
+def _hinge_embedding(x, y, *, margin, reduction):
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return dispatch.apply(
+        "hinge_embedding_loss",
+        _hinge_embedding,
+        (input, label),
+        {"margin": float(margin), "reduction": reduction},
+    )
+
+
+def _cosine_embedding(x1, x2, y, *, margin, reduction):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+    )
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    return dispatch.apply(
+        "cosine_embedding_loss",
+        _cosine_embedding,
+        (input1, input2, label),
+        {"margin": float(margin), "reduction": reduction},
+    )
+
+
+def _triplet_margin(a, p, n, *, margin, p_norm, swap, reduction):
+    def dist(u, v):
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(u - v), p_norm), axis=-1), 1.0 / p_norm
+        )
+
+    d_ap = dist(a, p)
+    d_an = dist(a, n)
+    if swap:
+        d_pn = dist(p, n)
+        d_an = jnp.minimum(d_an, d_pn)
+    loss = jnp.maximum(0.0, d_ap - d_an + margin)
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2, epsilon=1e-06,
+                        swap=False, reduction="mean", name=None):
+    return dispatch.apply(
+        "triplet_margin_loss",
+        _triplet_margin,
+        (input, positive, negative),
+        {
+            "margin": float(margin),
+            "p_norm": float(p),
+            "swap": bool(swap),
+            "reduction": reduction,
+        },
+    )
+
+
+def _sigmoid_focal(logit, label, norm, *, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if norm is not None:
+        loss = loss / norm
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return dispatch.apply(
+        "sigmoid_focal_loss",
+        _sigmoid_focal,
+        (logit, label, normalizer),
+        {"alpha": float(alpha), "gamma": float(gamma), "reduction": reduction},
+    )
+
+
+def square_error_cost(input, label):
+    def _sec(x, y):
+        return jnp.square(x - y)
+
+    return dispatch.apply("square_error_cost", _sec, (input, label))
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def _log_loss(x, y, *, eps):
+        return -y * jnp.log(x + eps) - (1 - y) * jnp.log(1 - x + eps)
+
+    return dispatch.apply(
+        "log_loss", _log_loss, (input, label), {"eps": float(epsilon)}
+    )

@@ -1,0 +1,190 @@
+"""Pooling functionals via lax.reduce_window.
+
+Reference parity: python/paddle/nn/functional/pooling.py (unverified, mount
+empty). Channel-first layouts by default, adaptive variants included.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from .conv import _conv_padding, _tuplize
+
+
+def _window(nd, k, s, channel_last):
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    return dims, strides
+
+
+def _full_pad(nd, pad, channel_last, x, k, s, ceil_mode):
+    """Expand spatial pad pairs to full-rank, adding right-side extra padding
+    for ceil_mode (so the last partial window is kept, paddle parity)."""
+    if isinstance(pad, str):
+        return pad
+    pad = [list(p) for p in pad]
+    if ceil_mode:
+        spatial_off = 1 if channel_last else 2
+        for d in range(nd):
+            in_s = x.shape[spatial_off + d]
+            eff = in_s + pad[d][0] + pad[d][1]
+            rem = (eff - k[d]) % s[d]
+            if rem != 0:
+                pad[d][1] += s[d] - rem
+    pairs = tuple(tuple(p) for p in pad)
+    if channel_last:
+        return ((0, 0),) + pairs + ((0, 0),)
+    return ((0, 0), (0, 0)) + pairs
+
+
+def _max_pool(x, *, nd, k, s, pad, channel_last, ceil_mode):
+    dims, strides = _window(nd, k, s, channel_last)
+    padding = _full_pad(nd, pad, channel_last, x, k, s, ceil_mode)
+    init = (
+        -jnp.inf
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    return jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, padding)
+
+
+def _avg_pool(x, *, nd, k, s, pad, channel_last, exclusive, ceil_mode):
+    dims, strides = _window(nd, k, s, channel_last)
+    padding = _full_pad(nd, pad, channel_last, x, k, s, ceil_mode)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, padding)
+    if exclusive:
+        # divide each window by its in-bounds element count (string padding
+        # included — 'SAME' zero-pads and paddle excludes those zeros)
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, padding)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_entry(_max_pool, x, 1, kernel_size, stride, padding, data_format,
+                       dict(ceil_mode=bool(ceil_mode)))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_entry(_max_pool, x, 2, kernel_size, stride, padding, data_format,
+                       dict(ceil_mode=bool(ceil_mode)))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_entry(_max_pool, x, 3, kernel_size, stride, padding, data_format,
+                       dict(ceil_mode=bool(ceil_mode)))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_entry(_avg_pool, x, 1, kernel_size, stride, padding, data_format,
+                       dict(exclusive=bool(exclusive), ceil_mode=bool(ceil_mode)))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_entry(_avg_pool, x, 2, kernel_size, stride, padding, data_format,
+                       dict(exclusive=bool(exclusive), ceil_mode=bool(ceil_mode)))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_entry(_avg_pool, x, 3, kernel_size, stride, padding, data_format,
+                       dict(exclusive=bool(exclusive), ceil_mode=bool(ceil_mode)))
+
+
+def _pool_entry(fn, x, nd, kernel, stride, padding, data_format, extra):
+    channel_last = not data_format.startswith("NC")
+    k = _tuplize(kernel, nd)
+    s = _tuplize(stride if stride is not None else kernel, nd)
+    pad = _conv_padding(padding, nd)
+    kw = {
+        "nd": nd,
+        "k": k,
+        "s": s,
+        "pad": pad if isinstance(pad, str) else tuple(tuple(p) for p in pad),
+        "channel_last": channel_last,
+    }
+    kw.update(extra)
+    return dispatch.apply(fn.__name__, fn, (x,), kw)
+
+
+def _adaptive_pool(x, *, nd, out_sizes, channel_last, op):
+    # general adaptive pooling via per-dim segment means/maxes
+    spatial_off = 1 if channel_last else 2
+    v = x
+    for d in range(nd):
+        axis = spatial_off + d
+        in_s = v.shape[axis]
+        out_s = out_sizes[d]
+        if in_s == out_s:
+            continue
+        if in_s % out_s == 0:
+            f = in_s // out_s
+            new_shape = v.shape[:axis] + (out_s, f) + v.shape[axis + 1 :]
+            vr = v.reshape(new_shape)
+            v = (jnp.max if op == "max" else jnp.mean)(vr, axis=axis + 1)
+        else:
+            # non-divisible: gather per output index (paddle formula)
+            starts = [int(np.floor(i * in_s / out_s)) for i in range(out_s)]
+            ends = [int(np.ceil((i + 1) * in_s / out_s)) for i in range(out_s)]
+            slices = []
+            for st, en in zip(starts, ends):
+                sl = jax.lax.slice_in_dim(v, st, en, axis=axis)
+                slices.append(
+                    (jnp.max if op == "max" else jnp.mean)(sl, axis=axis, keepdims=True)
+                )
+            v = jnp.concatenate(slices, axis=axis)
+    return v
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_entry(x, 1, output_size, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_entry(x, 2, output_size, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_entry(x, 3, output_size, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_entry(x, 1, output_size, "NCL", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_entry(x, 2, output_size, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_entry(x, 3, output_size, "NCDHW", "max")
+
+
+def _adaptive_entry(x, nd, output_size, data_format, op):
+    channel_last = not data_format.startswith("NC")
+    out = _tuplize(output_size, nd)
+    out = tuple(
+        o if o is not None else x.shape[(1 if channel_last else 2) + i]
+        for i, o in enumerate(out)
+    )
+    return dispatch.apply(
+        f"adaptive_{op}_pool{nd}d",
+        _adaptive_pool,
+        (x,),
+        {"nd": nd, "out_sizes": out, "channel_last": channel_last, "op": op},
+    )

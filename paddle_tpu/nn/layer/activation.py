@@ -1,0 +1,73 @@
+"""Activation layers (python/paddle/nn/layer/activation.py parity —
+unverified)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _act_layer(name, fn, **default_kw):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            kw = dict(default_kw)
+            # positional args map onto the functional's kwargs in order
+            keys = list(default_kw)
+            for k, v in zip(keys, args):
+                kw[k] = v
+            for k, v in kwargs.items():
+                if k in kw:
+                    kw[k] = v
+            self._kw = kw
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+Silu = _act_layer("Silu", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+GELU = _act_layer("GELU", F.gelu, approximate=False)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _act_layer("ELU", F.elu, alpha=1.0)
+CELU = _act_layer("CELU", F.celu, alpha=1.0)
+SELU = _act_layer("SELU", F.selu)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _act_layer("Softshrink", F.softshrink, threshold=0.5)
+Softplus = _act_layer("Softplus", F.softplus, beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", F.softsign)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu, threshold=1.0)
+LogSigmoid = _act_layer("LogSigmoid", lambda x, **kw: F.softplus(-x).__neg__())
+Softmax = _act_layer("Softmax", F.softmax, axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax, axis=-1)
+Maxout = _act_layer("Maxout", F.maxout, groups=2, axis=1)
+GLU = _act_layer("GLU", F.glu, axis=-1)
+RReLU = _act_layer("RReLU", F.rrelu, lower=0.125, upper=1.0 / 3.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters],
+            attr=weight_attr,
+            default_initializer=I.Constant(init),
+        )
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,366 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+Cells, RNN wrapper).
+
+Reference parity: python/paddle/nn/layer/rnn.py (unverified, mount empty).
+TPU-first: the time loop is a single ``lax.scan`` — one compiled loop body
+rather than a Python-unrolled op sequence, which is the idiomatic XLA
+formulation (the reference relies on cuDNN RNN kernels here). Gate orders
+match paddle: LSTM [i, f, g(c~), o]; GRU [r, z, c].
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from .. import initializer as I
+from .layers import Layer
+
+
+def _lstm_cell(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_cell(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return (1 - z) * c + z * h
+
+
+def _simple_cell(x, h, w_ih, w_hh, b_ih, b_hh, act):
+    pre = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        pre = pre + b_ih + b_hh
+    return jnp.tanh(pre) if act == "tanh" else jax.nn.relu(pre)
+
+
+def _scan_layer(mode, act, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+    """x: [T, B, in] -> (outputs [T, B, H], h_T, c_T)."""
+
+    def step(carry, xt):
+        if mode == "LSTM":
+            h, c = carry
+            h2, c2 = _lstm_cell(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+            return (h2, c2), h2
+        h = carry
+        if mode == "GRU":
+            h2 = _gru_cell(xt, h, w_ih, w_hh, b_ih, b_hh)
+        else:
+            h2 = _simple_cell(xt, h, w_ih, w_hh, b_ih, b_hh, act)
+        return h2, h2
+
+    init = (h0, c0) if mode == "LSTM" else h0
+    carry, outs = jax.lax.scan(step, init, x, reverse=reverse)
+    if reverse:
+        pass  # scan(reverse=True) already emits outputs aligned to input order
+    if mode == "LSTM":
+        return outs, carry[0], carry[1]
+    return outs, carry, None
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_size = input_size if layer == 0 else hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                wih = self.create_parameter(
+                    [gate_mult * hidden_size, in_size], attr=weight_ih_attr,
+                    default_initializer=I.Uniform(-std, std))
+                whh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], attr=weight_hh_attr,
+                    default_initializer=I.Uniform(-std, std))
+                bih = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True,
+                    default_initializer=I.Uniform(-std, std))
+                bhh = self.create_parameter(
+                    [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
+                    default_initializer=I.Uniform(-std, std))
+                self.add_parameter(f"weight_ih{sfx}", wih)
+                self.add_parameter(f"weight_hh{sfx}", whh)
+                self.add_parameter(f"bias_ih{sfx}", bih)
+                self.add_parameter(f"bias_hh{sfx}", bhh)
+                self._param_names.append(sfx)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        nl, nd, H = self.num_layers, self.num_directions, self.hidden_size
+        # inter-layer dropout (paddle parity: applied to each stacked layer's
+        # input except the first, training only)
+        drop_p = float(self.dropout) if (self.training and self.dropout) else 0.0
+        drop_keys = None
+        if drop_p > 0.0 and nl > 1:
+            from ...core import random as random_mod
+
+            drop_keys = [random_mod.next_key() for _ in range(nl - 1)]
+
+        if initial_states is None:
+            h0 = c0 = None
+        elif mode == "LSTM":
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, None
+
+        params = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                params.append(tuple(
+                    getattr(self, f"{n}{sfx}")
+                    for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")
+                ))
+
+        act = self.activation
+        tm = self.time_major
+
+        def _run(xv, h0v, c0v, *flat_w):
+            ws = [flat_w[i * 4 : (i + 1) * 4] for i in range(nl * nd)]
+            x = xv if tm else jnp.swapaxes(xv, 0, 1)  # -> [T, B, in]
+            B = x.shape[1]
+            if h0v is None:
+                h0v = jnp.zeros((nl * nd, B, H), x.dtype)
+            if c0v is None and mode == "LSTM":
+                c0v = jnp.zeros((nl * nd, B, H), x.dtype)
+            h_finals, c_finals = [], []
+            cur = x
+            for layer in range(nl):
+                if layer > 0 and drop_keys is not None:
+                    keep = 1.0 - drop_p
+                    mask = jax.random.bernoulli(
+                        drop_keys[layer - 1], keep, cur.shape
+                    )
+                    cur = jnp.where(mask, cur / keep, 0.0).astype(cur.dtype)
+                outs_dir = []
+                for d in range(nd):
+                    idx = layer * nd + d
+                    wih, whh, bih, bhh = ws[idx]
+                    outs, hT, cT = _scan_layer(
+                        mode, act, cur, h0v[idx],
+                        c0v[idx] if mode == "LSTM" else None,
+                        wih, whh, bih, bhh, reverse=bool(d),
+                    )
+                    outs_dir.append(outs)
+                    h_finals.append(hT)
+                    if mode == "LSTM":
+                        c_finals.append(cT)
+                cur = outs_dir[0] if nd == 1 else jnp.concatenate(outs_dir, axis=-1)
+            y = cur if tm else jnp.swapaxes(cur, 0, 1)
+            hN = jnp.stack(h_finals)
+            if mode == "LSTM":
+                return y, hN, jnp.stack(c_finals)
+            return y, hN
+
+        args = [inputs, h0, c0] + [w for p in params for w in p]
+        out = dispatch.apply(f"rnn_{mode.lower()}", _run, tuple(args), cache=False)
+        if mode == "LSTM":
+            y, hN, cN = out
+            return y, (hN, cN)
+        y, hN = out
+        return y, hN
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kw)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        import paddle_tpu as paddle
+
+        B = batch_ref.shape[batch_dim_idx]
+        return paddle.full([B, self.hidden_size], init_value,
+                           dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _cell(x, h, wih, whh, bih, bhh):
+            return _simple_cell(x, h, wih, whh, bih, bhh, self.activation)
+
+        out = dispatch.apply(
+            "simple_rnn_cell", _cell,
+            (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), cache=False)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        out = dispatch.apply(
+            "lstm_cell", _lstm_cell,
+            (inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), cache=False)
+        h2, c2 = out
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = dispatch.apply(
+            "gru_cell", _gru_cell,
+            (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+             self.bias_hh), cache=False)
+        return out, out
+
+
+class RNN(Layer):
+    """Wrap a cell into a recurrent layer (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        for t in steps:
+            xt = inputs[:, t] if axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = M.stack(outs, axis=axis)
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, False, time_major)
+        self.bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+
+        sf = initial_states[0] if initial_states else None
+        sb = initial_states[1] if initial_states else None
+        yf, stf = self.fw(inputs, sf)
+        yb, stb = self.bw(inputs, sb)
+        return M.concat([yf, yb], axis=-1), (stf, stb)

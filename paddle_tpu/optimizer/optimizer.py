@@ -1,0 +1,463 @@
+"""Optimizers.
+
+Reference parity: python/paddle/optimizer/ + the fused CUDA update kernels
+(paddle/phi/kernels/gpu/fused_adam_kernel.cu — unverified, mount empty).
+TPU-first: each optimizer's update rule is ONE jitted pure function applied
+per parameter (cached per shape/dtype by jax), taking lr/step as runtime
+scalars so LR schedules never trigger recompiles. The multi-tensor "fused
+adam" path of the reference is matched by paddle_tpu.kernels.fused_adam
+(used by the jitted trainer); eager .step() here is the imperative path.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..regularizer import L1Decay, L2Decay
+from .lr import LRScheduler
+
+_jit = functools.partial(jax.jit, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_update(p, g, lr):
+    return (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+def _momentum_update(p, vel, g, lr, mu, use_nesterov):
+    g32 = g.astype(jnp.float32)
+    v2 = mu * vel + g32
+    if use_nesterov:
+        upd = g32 + mu * v2
+    else:
+        upd = v2
+    return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), v2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(10,))
+def _adam_update(p, m, v, g, lr, beta1, beta2, eps, t, weight_decay, decoupled):
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if not decoupled:
+        g32 = g32 + weight_decay * p32
+    m2 = beta1 * m + (1 - beta1) * g32
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g32)
+    mhat = m2 / (1 - jnp.power(beta1, t))
+    vhat = v2 / (1 - jnp.power(beta2, t))
+    step = lr * mhat / (jnp.sqrt(vhat) + eps)
+    if decoupled:
+        p32 = p32 * (1 - lr * weight_decay)
+    return (p32 - step).astype(p.dtype), m2, v2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _lamb_update(p, m, v, g, lr, beta1, beta2, eps, t, lamb_weight_decay):
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    m2 = beta1 * m + (1 - beta1) * g32
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g32)
+    mhat = m2 / (1 - jnp.power(beta1, t))
+    vhat = v2 / (1 - jnp.power(beta2, t))
+    r = mhat / (jnp.sqrt(vhat) + eps) + lamb_weight_decay * p32
+    w_norm = jnp.linalg.norm(p32)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return (p32 - lr * ratio * r).astype(p.dtype), m2, v2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adagrad_update(p, acc, g, lr, eps):
+    g32 = g.astype(jnp.float32)
+    acc2 = acc + jnp.square(g32)
+    return (p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(acc2) + eps)).astype(p.dtype), acc2
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(8,))
+def _rmsprop_update(p, ms, mom, g, lr, rho, eps, momentum, centered, mg):
+    g32 = g.astype(jnp.float32)
+    ms2 = rho * ms + (1 - rho) * jnp.square(g32)
+    if centered:
+        mg2 = rho * mg + (1 - rho) * g32
+        denom = jnp.sqrt(ms2 - jnp.square(mg2) + eps)
+    else:
+        mg2 = mg
+        denom = jnp.sqrt(ms2 + eps)
+    mom2 = momentum * mom + lr * g32 / denom
+    return (p.astype(jnp.float32) - mom2).astype(p.dtype), ms2, mom2, mg2
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be provided (dygraph mode requires an "
+                "explicit parameter list, paddle parity)"
+            )
+        self._lr = learning_rate
+        self._param_groups = self._build_groups(parameters)
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._accumulators: dict = {}
+        self._step_count = 0
+        self.regularization = weight_decay
+
+    # ----------------------------------------------------------- structure
+    def _build_groups(self, parameters):
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                g = dict(g)
+                g["params"] = list(g["params"])
+                groups.append(g)
+            return groups
+        return [{"params": params}]
+
+    def _all_params(self):
+        for g in self._param_groups:
+            for p in g["params"]:
+                yield g, p
+
+    # ----------------------------------------------------------------- lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr.last_lr)
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr = scheduler
+
+    # -------------------------------------------------------------- update
+    def _decay_value(self, group, p=None):
+        # per-parameter regularizer (ParamAttr) takes precedence (paddle
+        # parity); then group-level, then optimizer-level weight_decay
+        wd = None
+        if p is not None and getattr(p, "regularizer", None) is not None:
+            wd = p.regularizer
+        if wd is None:
+            wd = group.get("weight_decay", self._weight_decay)
+        if wd is None:
+            return 0.0, False
+        if isinstance(wd, L2Decay):
+            return float(wd.coeff), False
+        if isinstance(wd, L1Decay):
+            return float(wd.coeff), "l1"
+        return float(wd), False
+
+    def _apply_l1(self, p, g, coeff):
+        return Tensor(g.value + coeff * jnp.sign(p.value))
+
+    def step(self):
+        params_grads = []
+        for group, p in self._all_params():
+            if p.grad is None or p.stop_gradient:
+                continue
+            params_grads.append((p, p.grad, group))
+        if not params_grads:
+            return
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g, _ in params_grads])
+            params_grads = [
+                (p, g, grp) for (p, g), (_, _, grp) in zip(clipped, params_grads)
+            ]
+        self._step_count += 1
+        lr = self.get_lr()
+        for p, g, group in params_grads:
+            # group 'learning_rate' is a MULTIPLIER on the scheduled lr
+            # (paddle semantics), composing with the per-param multiplier
+            plr = (
+                lr
+                * float(group.get("learning_rate", 1.0))
+                * p.optimize_attr.get("learning_rate", 1.0)
+            )
+            self._update_param(p, g, plr, group)
+
+    def _update_param(self, p, g, lr, group):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for _, p in self._all_params()]
+
+    def clear_grad(self, set_to_zero=False):
+        for _, p in self._all_params():
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # --------------------------------------------------------------- state
+    def _acc(self, p, name, init=None):
+        key = (id(p), name)
+        if key not in self._accumulators:
+            self._accumulators[key] = (
+                jnp.zeros_like(p.value, dtype=jnp.float32) if init is None else init
+            )
+        return self._accumulators[key]
+
+    def _set_acc(self, p, name, value):
+        self._accumulators[(id(p), name)] = value
+
+    def state_dict(self):
+        sd = {}
+        names = {}
+        for i, (_, p) in enumerate(self._all_params()):
+            pname = p.name or f"param_{i}"
+            names[id(p)] = pname
+        for (pid, accname), v in self._accumulators.items():
+            if pid in names:
+                sd[f"{names[pid]}__{accname}"] = Tensor(v)
+        sd["@step_count"] = self._step_count
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state):
+        names = {}
+        for i, (_, p) in enumerate(self._all_params()):
+            pname = p.name or f"param_{i}"
+            names[pname] = p
+        self._step_count = int(state.get("@step_count", 0))
+        if "LR_Scheduler" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        for k, v in state.items():
+            if k in ("@step_count", "LR_Scheduler") or "__" not in k:
+                continue
+            pname, accname = k.rsplit("__", 1)
+            p = names.get(pname)
+            if p is not None:
+                arr = v.value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                self._accumulators[(id(p), accname)] = arr
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        p.value = _sgd_update(p.value, g.value, jnp.float32(lr))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        vel = self._acc(p, "velocity")
+        p.value, vel2 = _momentum_update(
+            p.value, vel, g.value, jnp.float32(lr),
+            jnp.float32(self._momentum), self._nesterov,
+        )
+        self._set_acc(p, "velocity", vel2)
+
+
+class Adam(Optimizer):
+    _decoupled = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+            wd = 0.0
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        p.value, m2, v2 = _adam_update(
+            p.value, m, v, g.value,
+            jnp.float32(lr), jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count),
+            jnp.float32(wd), self._decoupled,
+        )
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (paddle.optimizer.AdamW parity)."""
+
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._apply_decay_fun = apply_decay_param_fun
+
+    def _update_param(self, p, g, lr, group):
+        if self._apply_decay_fun is not None and not self._apply_decay_fun(
+            p.name or ""
+        ):
+            wd_backup = self._weight_decay
+            self._weight_decay = 0.0
+            try:
+                super()._update_param(p, g, lr, group)
+            finally:
+                self._weight_decay = wd_backup
+            return
+        super()._update_param(p, g, lr, group)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr, group):
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        p.value, m2, v2 = _lamb_update(
+            p.value, m, v, g.value,
+            jnp.float32(lr), jnp.float32(self._beta1), jnp.float32(self._beta2),
+            jnp.float32(self._eps), jnp.float32(self._step_count),
+            jnp.float32(wd),
+        )
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        acc = self._acc(
+            p, "moment",
+            init=jnp.full_like(p.value, self._init_acc, dtype=jnp.float32),
+        )
+        p.value, acc2 = _adagrad_update(
+            p.value, acc, g.value, jnp.float32(lr), jnp.float32(self._eps)
+        )
+        self._set_acc(p, "moment", acc2)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        ms = self._acc(p, "mean_square")
+        mom = self._acc(p, "momentum")
+        mg = self._acc(p, "mean_grad")
+        p.value, ms2, mom2, mg2 = _rmsprop_update(
+            p.value, ms, mom, g.value, jnp.float32(lr), jnp.float32(self._rho),
+            jnp.float32(self._eps), jnp.float32(self._momentum),
+            self._centered, mg,
+        )
+        self._set_acc(p, "mean_square", ms2)
+        self._set_acc(p, "momentum", mom2)
+        self._set_acc(p, "mean_grad", mg2)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps, self._rho = epsilon, rho
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        g32 = g.value.astype(jnp.float32)
+        avg_sq = self._acc(p, "avg_squared_grad")
+        avg_upd = self._acc(p, "avg_squared_update")
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * jnp.square(g32)
+        upd = jnp.sqrt(avg_upd + self._eps) / jnp.sqrt(avg_sq + self._eps) * g32
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * jnp.square(upd)
+        p.value = (p.value.astype(jnp.float32) - lr * upd).astype(p.value.dtype)
+        self._set_acc(p, "avg_squared_grad", avg_sq)
+        self._set_acc(p, "avg_squared_update", avg_upd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        g32 = g.value.astype(jnp.float32)
+        m = self._acc(p, "moment")
+        u = self._acc(p, "inf_norm")
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g32))
+        denom = 1 - self._beta1**self._step_count
+        p.value = (
+            p.value.astype(jnp.float32) - lr / denom * m / (u + self._eps)
+        ).astype(p.value.dtype)
+        self._set_acc(p, "moment", m)
+        self._set_acc(p, "inf_norm", u)

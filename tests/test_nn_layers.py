@@ -1,0 +1,238 @@
+"""nn layer tests — torch (CPU) as the numeric oracle for the heavy ops,
+mirroring the reference's OpTest numpy-oracle strategy (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+rng = np.random.default_rng(3)
+
+
+def _f32(*shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_linear_matches_torch():
+    x, w, b = _f32(4, 10), _f32(10, 6), _f32(6)
+    out = F.linear(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+    ref = torch.nn.functional.linear(
+        torch.tensor(x), torch.tensor(w.T), torch.tensor(b)
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1), (2, 1, 1, 1), (1, 2, 2, 1), (1, 1, 1, 2),
+])
+def test_conv2d_matches_torch(stride, padding, dilation, groups):
+    x = _f32(2, 4, 9, 9)
+    w = _f32(6, 4 // groups, 3, 3)
+    b = _f32(6)
+    out = F.conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+        stride=stride, padding=padding, dilation=dilation, groups=groups,
+    )
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b),
+        stride=stride, padding=padding, dilation=dilation, groups=groups,
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    x = _f32(2, 4, 5, 5)
+    w = _f32(4, 6, 3, 3)  # paddle/torch transpose layout: [in, out, kh, kw]
+    out = F.conv2d_transpose(
+        paddle.to_tensor(x), paddle.to_tensor(w), stride=2, padding=1,
+        output_padding=1,
+    )
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1, output_padding=1
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad():
+    x = paddle.to_tensor(_f32(1, 2, 5, 5))
+    w = paddle.to_tensor(_f32(3, 2, 3, 3))
+    x.stop_gradient = w.stop_gradient = False
+    out = F.conv2d(x, w, padding=1)
+    out.sum().backward()
+    tx = torch.tensor(x.numpy(), requires_grad=True)
+    tw = torch.tensor(w.numpy(), requires_grad=True)
+    torch.nn.functional.conv2d(tx, tw, padding=1).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), tx.grad.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(w.grad.numpy(), tw.grad.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_pools_match_torch():
+    x = _f32(2, 3, 8, 8)
+    out = F.max_pool2d(paddle.to_tensor(x), 2)
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(out.numpy(), ref)
+    out = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1)
+    ref = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1, count_include_pad=False
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    out = F.adaptive_avg_pool2d(paddle.to_tensor(x), 1)
+    ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_batch_norm_train_and_eval():
+    x = _f32(4, 3, 5, 5)
+    bn = nn.BatchNorm2D(3)
+    tbn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch momentum = 1-paddle
+    bn.train()
+    tbn.train()
+    out = bn(paddle.to_tensor(x))
+    ref = tbn(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+    # paddle running stats: r = 0.9*r + 0.1*batch, with BIASED batch var
+    # (torch applies Bessel correction to running_var — paddle does not)
+    batch_mean = x.mean(axis=(0, 2, 3))
+    batch_var = x.var(axis=(0, 2, 3))
+    np.testing.assert_allclose(
+        bn._mean.numpy(), 0.1 * batch_mean, rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        bn._variance.numpy(), 0.9 * 1.0 + 0.1 * batch_var, rtol=1e-4, atol=1e-5
+    )
+    bn.eval()
+    out = bn(paddle.to_tensor(x))
+    inv = 1.0 / np.sqrt((0.9 + 0.1 * batch_var) + 1e-5)
+    ref = (x - (0.1 * batch_mean).reshape(1, 3, 1, 1)) * inv.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_layer_norm_matches_torch():
+    x = _f32(4, 6, 8)
+    ln = nn.LayerNorm(8)
+    out = ln(paddle.to_tensor(x))
+    tln = torch.nn.LayerNorm(8)
+    ref = tln(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding_and_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 0, 3]]))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_cross_entropy_matches_torch():
+    logits = _f32(8, 5)
+    labels = rng.integers(0, 5, 8).astype(np.int64)
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    # ignore_index + weight
+    labels2 = labels.copy()
+    labels2[0] = -100
+    w = np.abs(_f32(5)) + 0.1
+    out = F.cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(labels2),
+        weight=paddle.to_tensor(w),
+    )
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels2), weight=torch.tensor(w)
+    ).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_lstm_matches_torch():
+    B, T, I, H = 3, 6, 5, 7
+    x = _f32(B, T, I)
+    lstm = nn.LSTM(I, H)
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    tl.weight_ih_l0.data = torch.tensor(lstm.weight_ih_l0.numpy())
+    tl.weight_hh_l0.data = torch.tensor(lstm.weight_hh_l0.numpy())
+    tl.bias_ih_l0.data = torch.tensor(lstm.bias_ih_l0.numpy())
+    tl.bias_hh_l0.data = torch.tensor(lstm.bias_hh_l0.numpy())
+    y, (h, c) = lstm(paddle.to_tensor(x))
+    ty, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_gru_matches_torch():
+    B, T, I, H = 2, 4, 3, 5
+    x = _f32(B, T, I)
+    gru = nn.GRU(I, H)
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    tg.weight_ih_l0.data = torch.tensor(gru.weight_ih_l0.numpy())
+    tg.weight_hh_l0.data = torch.tensor(gru.weight_hh_l0.numpy())
+    tg.bias_ih_l0.data = torch.tensor(gru.bias_ih_l0.numpy())
+    tg.bias_hh_l0.data = torch.tensor(gru.bias_hh_l0.numpy())
+    y, h = gru(paddle.to_tensor(x))
+    ty, th = tg(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_sdpa_matches_torch():
+    B, S, H, D = 2, 5, 2, 4
+    q, k, v = _f32(B, S, H, D), _f32(B, S, H, D), _f32(B, S, H, D)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True,
+    )
+    # torch layout is [B, H, S, D]
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q).transpose(1, 2), torch.tensor(k).transpose(1, 2),
+        torch.tensor(v).transpose(1, 2), is_causal=True,
+    ).transpose(1, 2).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_head_attention_shapes():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(_f32(2, 6, 16))
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_layer_system():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    sd = net.state_dict()
+    assert set(sd) == set(names)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(sd)
+    x = paddle.to_tensor(_f32(3, 4))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+    # train/eval propagation
+    net.eval()
+    assert all(not l.training for l in net.sublayers())
+    # hooks
+    calls = []
+    h = net.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    net(x)
+    assert calls
+    h.remove()
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    d = nn.Dropout(0.5)
+    d.train()
+    out = d(x)
+    frac = float((out.numpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    kept = out.numpy()[out.numpy() != 0]
+    np.testing.assert_allclose(kept, 2.0 * np.ones_like(kept))  # upscale
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())

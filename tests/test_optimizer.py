@@ -1,0 +1,171 @@
+"""Optimizer & LR scheduler tests — torch as numeric oracle."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+rng = np.random.default_rng(5)
+
+
+def _pair_models():
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    b = rng.standard_normal(3).astype(np.float32)
+    lin = nn.Linear(4, 3)
+    lin.weight.set_value(w)
+    lin.bias.set_value(b)
+    tlin = torch.nn.Linear(4, 3)
+    tlin.weight.data = torch.tensor(w.T)
+    tlin.bias.data = torch.tensor(b)
+    return lin, tlin
+
+
+def _run_pair(opt, topt, lin, tlin, steps=5):
+    x = rng.standard_normal((8, 4)).astype(np.float32)
+    for _ in range(steps):
+        loss = (lin(paddle.to_tensor(x)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        tloss = (tlin(torch.tensor(x)) ** 2).mean()
+        topt.zero_grad()
+        tloss.backward()
+        topt.step()
+    np.testing.assert_allclose(
+        lin.weight.numpy(), tlin.weight.detach().numpy().T, rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        lin.bias.numpy(), tlin.bias.detach().numpy(), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_sgd_matches_torch():
+    lin, tlin = _pair_models()
+    _run_pair(
+        paddle.optimizer.SGD(0.1, parameters=lin.parameters()),
+        torch.optim.SGD(tlin.parameters(), 0.1),
+        lin, tlin,
+    )
+
+
+def test_momentum_matches_torch():
+    lin, tlin = _pair_models()
+    _run_pair(
+        paddle.optimizer.Momentum(0.1, 0.9, parameters=lin.parameters()),
+        torch.optim.SGD(tlin.parameters(), 0.1, momentum=0.9),
+        lin, tlin,
+    )
+
+
+def test_adam_matches_torch():
+    lin, tlin = _pair_models()
+    _run_pair(
+        paddle.optimizer.Adam(0.01, parameters=lin.parameters()),
+        torch.optim.Adam(tlin.parameters(), 0.01),
+        lin, tlin,
+    )
+
+
+def test_adamw_matches_torch():
+    lin, tlin = _pair_models()
+    _run_pair(
+        paddle.optimizer.AdamW(0.01, parameters=lin.parameters(), weight_decay=0.05),
+        torch.optim.AdamW(tlin.parameters(), 0.01, weight_decay=0.05),
+        lin, tlin,
+    )
+
+
+def test_grad_clip_global_norm():
+    lin = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(
+        0.0, parameters=lin.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(0.1),
+    )
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32) * 100)
+    (lin(x) ** 2).mean().backward()
+    pre = np.sqrt(sum(np.sum(p.grad.numpy() ** 2) for p in lin.parameters()))
+    assert pre > 0.1
+    clipped = opt._grad_clip([(p, p.grad) for p in lin.parameters()])
+    post = np.sqrt(sum(np.sum(g.numpy() ** 2) for _, g in clipped))
+    np.testing.assert_allclose(post, 0.1, rtol=1e-4)
+
+
+def test_optimizer_state_roundtrip():
+    lin = nn.Linear(4, 3)
+    opt = paddle.optimizer.Adam(0.01, parameters=lin.parameters())
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    (lin(x) ** 2).mean().backward()
+    opt.step()
+    opt.clear_grad()
+    sd = opt.state_dict()
+    paddle.save(sd, "/tmp/opt.pdopt")
+    opt2 = paddle.optimizer.Adam(0.01, parameters=lin.parameters())
+    opt2.set_state_dict(paddle.load("/tmp/opt.pdopt"))
+    assert opt2._step_count == 1
+    k = (id(lin.weight), "moment1")
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators[k]), np.asarray(opt._accumulators[k])
+    )
+
+
+def test_lr_schedulers():
+    lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(round(lr.last_lr, 6))
+        lr.step()
+    assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(cos.last_lr - 1.0) < 1e-6
+    for _ in range(10):
+        cos.step()
+    assert cos.last_lr < 1e-6
+
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0,
+                                            end_lr=0.1)
+    seq = []
+    for _ in range(6):
+        seq.append(round(warm.last_lr, 4))
+        warm.step()
+    assert seq[:4] == [0.0, 0.025, 0.05, 0.075] and seq[4:] == [0.1, 0.1]
+
+
+def test_scheduler_drives_optimizer():
+    lin = nn.Linear(2, 2)
+    sched = paddle.optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+    opt = paddle.optimizer.SGD(sched, parameters=lin.parameters())
+    assert opt.get_lr() == 0.5
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_dataloader_and_samplers():
+    from paddle_tpu.io import (
+        DataLoader,
+        Dataset,
+        DistributedBatchSampler,
+        TensorDataset,
+    )
+
+    X = rng.standard_normal((20, 3)).astype(np.float32)
+    y = np.arange(20)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    dl = DataLoader(ds, batch_size=6, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == [6, 3]
+    assert batches[-1][0].shape == [2, 3]
+    # distributed sampler shards evenly with padding
+    s0 = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 10
+    assert set(i0) | set(i1) == set(range(20))
+    # prefetch workers produce same multiset
+    dl2 = DataLoader(ds, batch_size=6, num_workers=2)
+    got = sorted(int(v) for _, yb in dl2 for v in yb.numpy())
+    assert got == sorted(y.tolist())
