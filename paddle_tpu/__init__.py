@@ -116,3 +116,14 @@ from . import optimizer  # noqa: E402
 from . import io  # noqa: E402
 from . import distributed  # noqa: E402
 from .nn.layer.layers import ParamAttr  # noqa: E402
+from . import amp  # noqa: E402
+from . import metric  # noqa: E402
+from . import vision  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from .hapi import callbacks  # noqa: E402
+from . import static  # noqa: E402
+from . import jit  # noqa: E402
+from . import profiler  # noqa: E402
+from . import utils  # noqa: E402
+from .utils.flags import get_flags, set_flags  # noqa: E402

@@ -1,0 +1,120 @@
+"""Automatic mixed precision.
+
+Reference parity: python/paddle/amp/auto_cast.py (unverified, mount empty).
+TPU-first: the preferred low precision is bfloat16 (MXU-native; no loss
+scaling needed). The dispatch-level AMP hook rewrites float32 inputs of
+white-listed ops (matmul/conv — the MXU ops) to the low dtype, leaving
+numerically sensitive ops (softmax/norm/loss reductions) in float32 —
+the same O1 insertion point as the reference's generated dygraph functions.
+O2 additionally keeps master weights via ``decorate``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.dtypes import convert_dtype
+
+# ops that run in low precision under O1 (the MXU FLOP carriers)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "flash_attention", "scaled_dot_product_attention",
+}
+# ops forced to float32 (numerically sensitive)
+BLACK_LIST = {
+    "softmax_with_cross_entropy", "cross_entropy", "log_softmax", "softmax",
+    "layer_norm", "batch_norm_train", "batch_norm_infer", "rms_norm",
+    "logsumexp", "mean", "sum", "norm", "group_norm", "nll_loss",
+    "binary_cross_entropy", "bce_with_logits", "mse_loss", "l1_loss",
+    "kl_div", "exp", "log", "pow", "erf",
+}
+
+white_list = WHITE_LIST  # paddle exposes these names
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_STATE = _AmpState()
+
+
+def _amp_hook(op_name, vals):
+    if not _STATE.enabled:
+        return vals
+    name = op_name
+    low = _STATE.dtype
+    in_white = (
+        name in WHITE_LIST or name in _STATE.custom_white
+    ) and name not in _STATE.custom_black
+    in_black = name in BLACK_LIST or name in _STATE.custom_black
+    out = []
+    for v in vals:
+        if v is None or not hasattr(v, "dtype"):
+            out.append(v)
+            continue
+        if in_white and v.dtype == jnp.float32:
+            out.append(v.astype(low))
+        elif in_black and v.dtype == low:
+            out.append(v.astype(jnp.float32))
+        else:
+            out.append(v)
+    return out
+
+
+dispatch.set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (
+        _STATE.enabled, _STATE.dtype, _STATE.level,
+        _STATE.custom_white, _STATE.custom_black,
+    )
+    _STATE.enabled = bool(enable)
+    _STATE.dtype = jnp.dtype(convert_dtype(dtype))
+    _STATE.level = level
+    _STATE.custom_white = set(custom_white_list or ())
+    _STATE.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (
+            _STATE.enabled, _STATE.dtype, _STATE.level,
+            _STATE.custom_white, _STATE.custom_black,
+        ) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low dtype; optimizer math stays fp32
+    (the update kernels upcast internally — master-weight semantics)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+def is_auto_cast_enabled():
+    return _STATE.enabled
+
+
+def get_amp_dtype():
+    return _STATE.dtype

@@ -1,0 +1,115 @@
+"""Loss scaling.
+
+Reference parity: python/paddle/amp/grad_scaler.py (unverified, mount
+empty). On TPU bf16 training needs no loss scaling (full fp32 exponent
+range), so with the default bf16 dtype this is numerically a no-op that
+keeps the API contract; the dynamic-scaling machinery is still fully
+implemented for float16 parity runs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _grads_finite(self, optimizer):
+        for _, p in optimizer._all_params():
+            if p.grad is None:
+                continue
+            if not bool(jnp.all(jnp.isfinite(p.grad.value))):
+                return False
+        return True
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return  # guard: double-unscale would divide grads by scale twice
+        self._found_inf = not self._grads_finite(optimizer)
+        inv = 1.0 / self._scale
+        for _, p in optimizer._all_params():
+            if p.grad is not None:
+                p.grad = Tensor(p.grad.value * inv)
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """paddle parity: step() does NOT update the loss scale — call
+        update() afterwards (or use minimize(), which does both)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        self._unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": self._good_steps,
+            "decr_count": self._bad_steps,
+        }
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+AmpScaler = GradScaler

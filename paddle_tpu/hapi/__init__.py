@@ -1,0 +1,3 @@
+"""paddle.hapi — high-level training API (python/paddle/hapi/ parity)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

@@ -1,0 +1,252 @@
+"""to_static / jit.save / jit.load.
+
+See package docstring. A StaticFunction jits the wrapped Layer's forward as
+a pure function of (params, buffers, inputs); recompilation is keyed by
+input shapes/dtypes exactly like the reference's program cache.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core import tape
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..static import InputSpec
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _to_values(out):
+    """Structurally convert Tensors -> raw arrays (Tensor IS a pytree node,
+    so tree_map would rebuild Tensors instead of unwrapping them)."""
+    if isinstance(out, Tensor):
+        return out.value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_to_values(v) for v in out)
+    if isinstance(out, dict):
+        return {k: _to_values(v) for k, v in out.items()}
+    return out
+
+
+def _to_tensors(out):
+    if hasattr(out, "dtype") and hasattr(out, "shape"):
+        return Tensor(out)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_to_tensors(v) for v in out)
+    if isinstance(out, dict):
+        return {k: _to_tensors(v) for k, v in out.items()}
+    return out
+
+
+class StaticFunction:
+    """Callable wrapping a Layer or function with whole-program jax.jit."""
+
+    def __init__(self, fn, layer=None, input_spec=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        # one compiled program per train/eval mode: dropout/batch-norm
+        # behavior is baked at trace time, so the cache is keyed on it
+        self._jitted = {}
+
+    def _build(self, mode):
+        layer = self._layer
+        fn = self._fn
+
+        if layer is not None:
+            def pure(params, buffers, rng, *input_vals):
+                layer.load_functional_state(params, buffers)
+                if mode:
+                    layer.train()
+                else:
+                    layer.eval()
+                with tape.trace_scope(), tape.no_grad(), random_mod.key_scope(rng):
+                    out = fn(*(Tensor(v) for v in input_vals))
+                out_vals = _to_values(out)
+                new_buffers = {k: b.value for k, b in layer.named_buffers()}
+                return out_vals, new_buffers
+
+            self._jitted[mode] = jax.jit(pure)
+        else:
+            def pure(rng, *input_vals):
+                with tape.trace_scope(), tape.no_grad(), random_mod.key_scope(rng):
+                    out = fn(*(Tensor(v) for v in input_vals))
+                return _to_values(out)
+
+            self._jitted[mode] = jax.jit(pure)
+
+    def __call__(self, *inputs):
+        mode = bool(self._layer.training) if self._layer is not None else False
+        if mode not in self._jitted:
+            self._build(mode)
+        jitted = self._jitted[mode]
+        vals = [_unwrap(x) for x in inputs]
+        rng = random_mod.next_key()
+        if self._layer is not None:
+            params = {k: p.value for k, p in self._layer.named_parameters()}
+            buffers = {k: b.value for k, b in self._layer.named_buffers()}
+            out_vals, new_buffers = jitted(params, buffers, rng, *vals)
+            # restore concrete values (tracing left tracers inside the layer)
+            self._layer.load_functional_state(params, new_buffers)
+            if mode:
+                self._layer.train()
+            else:
+                self._layer.eval()
+        else:
+            out_vals = jitted(rng, *vals)
+        return _to_tensors(out_vals)
+
+    # paddle API parity
+    @property
+    def code(self):
+        return "<jax-traced; no translated source on TPU>"
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+
+def to_static(function=None, input_spec=None, full_graph=True, backend=None,
+              **kwargs):
+    """Decorator/wrapper: compile a Layer's forward or a function with XLA."""
+
+    def wrap(obj):
+        if isinstance(obj, Layer):
+            static = StaticFunction(
+                obj.forward, layer=obj, input_spec=input_spec
+            )
+            obj.forward = static
+            obj._static_forward = static
+            return obj
+        return StaticFunction(obj, layer=None, input_spec=input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer inference graph as StableHLO + params (jit.save parity).
+
+    Produces: path.json (meta), path.stablehlo (serialized jax.export
+    artifact), path.pdparams (state dict) — the TPU-native analog of the
+    reference's __model__ + params deployment bundle.
+    """
+    from ..framework.io import save as fsave
+
+    if isinstance(layer, StaticFunction):
+        fn, owner = layer._fn, layer._layer
+    elif isinstance(layer, Layer):
+        fn, owner = layer.forward, layer
+        if isinstance(fn, StaticFunction):
+            fn, owner = fn._fn, fn._layer
+    else:
+        fn, owner = layer, None
+
+    if input_spec is None and owner is not None:
+        raise ValueError("jit.save requires input_spec (shape contract)")
+    specs = [
+        s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+        for s in (input_spec or [])
+    ]
+    # None/-1 dims become symbolic so the exported StableHLO is
+    # batch-polymorphic (replaces the reference's -1 dims in ProgramDesc)
+    scope = jax.export.SymbolicScope()
+    examples = []
+    for si, s in enumerate(specs):
+        dim_strs = [
+            f"b{si}_{di}" if (d is None or d < 0) else str(d)
+            for di, d in enumerate(s.shape or [])
+        ]
+        shape = jax.export.symbolic_shape(
+            ",".join(dim_strs) if dim_strs else "", scope=scope
+        )
+        examples.append(jax.ShapeDtypeStruct(shape, s.dtype))
+
+    params = {k: p.value for k, p in owner.named_parameters()} if owner else {}
+    buffers = {k: b.value for k, b in owner.named_buffers()} if owner else {}
+
+    def pure(params, buffers, *input_vals):
+        if owner is not None:
+            owner.load_functional_state(params, buffers)
+        was_training = owner.training if owner is not None else False
+        if owner is not None:
+            owner.eval()
+        try:
+            with tape.trace_scope(), tape.no_grad():
+                out = fn(*(Tensor(v) for v in input_vals))
+        finally:
+            if owner is not None and was_training:
+                owner.train()
+        return _to_values(out)
+
+    exported = jax.export.export(jax.jit(pure))(params, buffers, *examples)
+    if owner is not None:
+        owner.load_functional_state(params, buffers)  # clear leaked tracers
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(blob)
+    fsave({"params": params, "buffers": buffers}, path + ".pdiparams")
+    meta = {
+        "input_specs": [
+            {"shape": s.shape, "dtype": np.dtype(s.dtype).name} for s in specs
+        ],
+        "format": "paddle_tpu.stablehlo.v1",
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    """A loaded inference program (jit.load result)."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+
+    def forward(self, *inputs):
+        vals = [_unwrap(x) for x in inputs]
+        out = self._exported.call(
+            self._state["params"], self._state["buffers"], *vals
+        )
+        return _to_tensors(out)
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+
+    with open(path + ".stablehlo", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = fload(path + ".pdiparams", return_numpy=False)
+
+    def _val(v):
+        import jax.numpy as jnp
+
+        return jnp.asarray(v.value if isinstance(v, Tensor) else v)
+
+    state = {
+        "params": {k: _val(v) for k, v in state["params"].items()},
+        "buffers": {k: _val(v) for k, v in state["buffers"].items()},
+    }
+    return TranslatedLayer(exported, state)

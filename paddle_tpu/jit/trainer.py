@@ -1,0 +1,223 @@
+"""CompiledTrainStep — the whole-step jitted trainer (the TPU perf path).
+
+Reference parity: this replaces the reference's executor+CINN "static graph
+training" mode (SURVEY.md §7 stage 4). One jax.jit covers forward, backward,
+gradient clipping, weight decay, and the optimizer update, with parameter
+and optimizer-state buffers donated — XLA fuses the lot and the host only
+dispatches one executable per step. Loss scaling / AMP run inside the trace.
+
+Works with the imperative Layer/Optimizer objects: parameters and optimizer
+accumulators are pulled into pytrees, the pure step runs, and the results
+are written back — so .state_dict(), checkpoints, and eager inspection all
+keep working between steps.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core import tape
+from ..core.tensor import Tensor
+from ..optimizer import optimizer as opt_mod
+
+
+def _unwrap(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+class CompiledTrainStep:
+    """Build once per (network, loss, optimizer); call with batches."""
+
+    SUPPORTED = (
+        opt_mod.AdamW,  # check subclasses before parents
+        opt_mod.Adam,
+        opt_mod.Lamb,
+        opt_mod.Momentum,
+        opt_mod.SGD,
+    )
+
+    def __init__(self, network, loss_fn, optimizer, amp_level=None,
+                 amp_dtype="bfloat16"):
+        self.network = network
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.amp_level = amp_level
+        self.amp_dtype = amp_dtype
+        self._kind = None
+        for cls in self.SUPPORTED:
+            if type(optimizer) is cls or isinstance(optimizer, cls):
+                self._kind = cls
+                break
+        if self._kind is None:
+            raise NotImplementedError(
+                f"CompiledTrainStep does not support {type(optimizer).__name__};"
+                " use the eager path"
+            )
+        self._step_fn = None
+        self._param_names = [k for k, _ in network.named_parameters()]
+
+    # ------------------------------------------------------------ opt state
+    def _gather_opt_state(self, params):
+        opt = self.optimizer
+        state = {}
+        if self._kind in (opt_mod.Adam, opt_mod.AdamW, opt_mod.Lamb):
+            for k, p in self.network.named_parameters():
+                state[k] = (
+                    opt._acc(p, "moment1"),
+                    opt._acc(p, "moment2"),
+                )
+        elif self._kind is opt_mod.Momentum:
+            for k, p in self.network.named_parameters():
+                state[k] = (opt._acc(p, "velocity"),)
+        else:  # SGD
+            for k in self._param_names:
+                state[k] = ()
+        return state
+
+    def _scatter_opt_state(self, state):
+        opt = self.optimizer
+        names = {k: p for k, p in self.network.named_parameters()}
+        for k, accs in state.items():
+            p = names[k]
+            if self._kind in (opt_mod.Adam, opt_mod.AdamW, opt_mod.Lamb):
+                opt._set_acc(p, "moment1", accs[0])
+                opt._set_acc(p, "moment2", accs[1])
+            elif self._kind is opt_mod.Momentum:
+                opt._set_acc(p, "velocity", accs[0])
+
+    # ----------------------------------------------------------- pure step
+    def _build(self):
+        network = self.network
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        kind = self._kind
+        amp_level = self.amp_level
+        amp_dtype = self.amp_dtype
+
+        clip = opt._grad_clip
+        from ..optimizer.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+        wd_coeffs = {}
+        for group, p in opt._all_params():
+            name = next(k for k, q in network.named_parameters() if q is p)
+            coeff, l1 = opt._decay_value(group, p)
+            wd_coeffs[name] = 0.0 if l1 == "l1" else float(coeff)
+
+        hyper = {}
+        if kind in (opt_mod.Adam, opt_mod.AdamW, opt_mod.Lamb):
+            hyper = dict(beta1=opt._beta1, beta2=opt._beta2, eps=opt._eps)
+        elif kind is opt_mod.Momentum:
+            hyper = dict(mu=opt._momentum, nesterov=opt._nesterov)
+
+        def loss_of(params, buffers, rng, inputs, labels):
+            network.load_functional_state(params, buffers)
+            if amp_level in ("O1", "O2"):
+                from ..amp import auto_cast
+
+                cm = auto_cast(True, level=amp_level, dtype=amp_dtype)
+            else:
+                import contextlib
+
+                cm = contextlib.nullcontext()
+            with tape.trace_scope(), tape.no_grad(), random_mod.key_scope(rng), cm:
+                network.train()
+                out = network(*(Tensor(v) for v in inputs))
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                loss = loss_fn(*(list(outs) + [Tensor(v) for v in labels]))
+            new_buffers = {k: b.value for k, b in network.named_buffers()}
+            out_vals = tuple(o.value for o in outs)
+            return loss.value.astype(jnp.float32), (new_buffers, out_vals)
+
+        def step(params, opt_state, buffers, lr, t, rng, inputs, labels):
+            (loss, (new_buffers, out_vals)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params, buffers, rng, inputs, labels)
+
+            # gradient clipping (global-norm path fused into the step)
+            if isinstance(clip, ClipGradByGlobalNorm):
+                sq = sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)
+                )
+                gnorm = jnp.sqrt(sq)
+                scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
+                )
+            elif isinstance(clip, ClipGradByNorm):
+                def _pn(g):
+                    n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                    s = jnp.where(n > clip.clip_norm, clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+                    return g * s
+
+                grads = jax.tree_util.tree_map(_pn, grads)
+            elif isinstance(clip, ClipGradByValue):
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, clip.min, clip.max), grads
+                )
+
+            new_params, new_state = {}, {}
+            for k in params:
+                p, g = params[k], grads[k]
+                wd = wd_coeffs.get(k, 0.0)
+                if kind is opt_mod.SGD:
+                    if wd:
+                        g = g + wd * p
+                    new_params[k] = opt_mod._sgd_update.__wrapped__(p, g, lr)
+                    new_state[k] = ()
+                elif kind is opt_mod.Momentum:
+                    if wd:
+                        g = g + wd * p
+                    (vel,) = opt_state[k]
+                    np_, v2 = opt_mod._momentum_update.__wrapped__(
+                        p, vel, g, lr, hyper["mu"], hyper["nesterov"]
+                    )
+                    new_params[k] = np_
+                    new_state[k] = (v2,)
+                elif kind in (opt_mod.Adam, opt_mod.AdamW):
+                    m, v = opt_state[k]
+                    decoupled = kind is opt_mod.AdamW
+                    np_, m2, v2 = opt_mod._adam_update.__wrapped__(
+                        p, m, v, g, lr, hyper["beta1"], hyper["beta2"],
+                        hyper["eps"], t, wd, decoupled,
+                    )
+                    new_params[k] = np_
+                    new_state[k] = (m2, v2)
+                else:  # Lamb
+                    m, v = opt_state[k]
+                    np_, m2, v2 = opt_mod._lamb_update.__wrapped__(
+                        p, m, v, g, lr, hyper["beta1"], hyper["beta2"],
+                        hyper["eps"], t, opt._lamb_wd,
+                    )
+                    new_params[k] = np_
+                    new_state[k] = (m2, v2)
+            return new_params, new_state, new_buffers, loss, out_vals
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ---------------------------------------------------------------- call
+    def __call__(self, inputs, labels):
+        if self._step_fn is None:
+            self._build()
+        params = {k: p.value for k, p in self.network.named_parameters()}
+        buffers = {k: b.value for k, b in self.network.named_buffers()}
+        opt_state = self._gather_opt_state(params)
+        self.optimizer._step_count += 1
+        lr = jnp.float32(self.optimizer.get_lr())
+        t = jnp.float32(self.optimizer._step_count)
+        rng = random_mod.next_key()
+        in_vals = tuple(_unwrap(x) for x in inputs)
+        lbl_vals = tuple(_unwrap(y) for y in labels)
+        new_params, new_state, new_buffers, loss, out_vals = self._step_fn(
+            params, opt_state, buffers, lr, t, rng, in_vals, lbl_vals
+        )
+        # write back: imperative objects stay the source of truth
+        lookup = dict(self.network.named_parameters())
+        for k, v in new_params.items():
+            lookup[k].value = v
+        self.network.load_functional_state(buffers=new_buffers)
+        self._scatter_opt_state(new_state)
+        return Tensor(loss), [Tensor(o) for o in out_vals]

@@ -1,0 +1,7 @@
+"""Fused TPU kernels (Pallas) behind the reference's fused-op API names.
+
+Reference parity: paddle/phi/kernels/fusion/gpu/* + flash_attn third-party
+lib (unverified, mount empty). Each module provides a Pallas TPU kernel and
+a composed-jnp fallback (CPU/CI); call sites pick automatically.
+"""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,76 @@
+"""Flash attention for TPU.
+
+Reference parity: phi FlashAttnKernel (paddle/phi/kernels/gpu/
+flash_attn_kernel.cu wrapping the flash-attention lib — unverified, mount
+empty). On TPU the equivalent is a Pallas blockwise-softmax kernel; jax
+ships a production-quality one (jax.experimental.pallas.ops.tpu.flash_attention)
+which we use when shapes allow, with a composed-jnp fallback otherwise.
+Layout contract matches paddle: q/k/v are [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _composed(q, k, v, *, causal, scale):
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_fa():
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention
+    except Exception:
+        return None
+
+
+def _pallas_ok(q, k, v):
+    if all(d.platform == "cpu" for d in jax.devices()):
+        return False
+    if _pallas_fa() is None:
+        return False
+    # pallas kernel wants seq multiples of its block sizes on BOTH q and kv
+    # sides and a supported head_dim; anything else falls back to composed
+    d = q.shape[-1]
+    return (
+        q.shape[1] % 128 == 0
+        and k.shape[1] % 128 == 0
+        and v.shape[1] == k.shape[1]
+        and d in (64, 128, 256)
+    )
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """q/k/v: [B, S, H, D] -> [B, S, H, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _pallas_ok(q, k, v):
+        fa = _pallas_fa()
+        # pallas kernel layout: [B, H, S, D]
+        out = fa(
+            jnp.swapaxes(q, 1, 2),
+            jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2),
+            causal=causal,
+            sm_scale=scale,
+        )
+        return jnp.swapaxes(out, 1, 2)
+    return _composed(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,2 @@
+"""paddle.metric parity (python/paddle/metric/metrics.py — unverified)."""
+from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy  # noqa: F401

@@ -1,0 +1,139 @@
+"""paddle.profiler over the jax/XPlane profiler.
+
+Reference parity: python/paddle/profiler/ + the CUPTI tracer
+(paddle/fluid/platform/profiler/ — unverified, mount empty). TPU redesign:
+device timelines come from the XLA/XPlane profiler (TensorBoard-viewable);
+``RecordEvent`` spans map onto jax.profiler.TraceAnnotation so user-code
+regions appear in the same trace. Summary tables are host-side timers.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import time
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"  # accepted for reference compat; maps to the accelerator
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom_device"
+
+
+class RecordEvent:
+    """Context manager/decorator span (paddle.profiler.RecordEvent parity)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        import jax
+
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def end(self):
+        if self._ann is not None:
+            _HOST_TIMES[self.name].append(time.perf_counter() - self._t0)
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+_HOST_TIMES: dict = collections.defaultdict(list)
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Simplified scheduler: returns the config; Profiler uses record count."""
+    return {
+        "closed": closed,
+        "ready": ready,
+        "record": record,
+        "repeat": repeat,
+        "skip_first": skip_first,
+    }
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        pass
+
+    # read by Profiler.start() BEFORE the trace begins
+    handler._export_dir = dir_name
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.targets = targets
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._export_dir = None
+        self._running = False
+        self._logdir = None
+
+    def start(self):
+        if not self.timer_only:
+            import jax
+
+            handler_dir = getattr(self.on_trace_ready, "_export_dir", None)
+            self._logdir = self._export_dir or handler_dir or "./profiler_log"
+            os.makedirs(self._logdir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._logdir)
+                self._running = True
+            except Exception:
+                self._running = False
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._running:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._running = False
+        self.elapsed = time.perf_counter() - self._t0
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        lines = ["host span summary (RecordEvent):"]
+        for name, times in sorted(_HOST_TIMES.items()):
+            total = sum(times) * 1000
+            lines.append(
+                f"  {name}: calls={len(times)} total={total:.2f}ms "
+                f"avg={total / max(len(times), 1):.3f}ms"
+            )
+        s = "\n".join(lines)
+        print(s)
+        return s
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("open the XPlane trace in TensorBoard instead")

@@ -1,0 +1,35 @@
+"""Minimal paddle.static surface.
+
+The reference's static-graph Program/Executor machinery (python/paddle/
+static/ — unverified, mount empty) is replaced wholesale by jax.jit
+(SURVEY.md §3.5): "static mode" == traced+compiled callables. What remains
+meaningful here is InputSpec (shape/dtype contracts for jit.save/to_static)
+and no-op guards for API compatibility.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={np.dtype(self.dtype).name}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def example(self, batch=1):
+        """A zero example array matching this spec (None dims -> batch)."""
+        import jax.numpy as jnp
+
+        shape = [batch if (s is None or s < 0) else s for s in (self.shape or [])]
+        return jnp.zeros(shape, self.dtype)

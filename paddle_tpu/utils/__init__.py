@@ -1,0 +1,26 @@
+"""paddle.utils parity surface + framework utilities."""
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required") from e
+
+
+def run_check():
+    """paddle.utils.run_check parity: sanity-check the install + device."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.ones([2, 2])
+    y = paddle.matmul(x, x)
+    assert np.allclose(y.numpy(), 2 * np.ones((2, 2)))
+    dev = paddle.get_device()
+    print(f"paddle_tpu is installed successfully! device={dev}")
+    return True

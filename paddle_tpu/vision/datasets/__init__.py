@@ -1,0 +1,3 @@
+from .folder import DatasetFolder, ImageFolder  # noqa: F401
+from .mnist import MNIST, FashionMNIST  # noqa: F401
+from .cifar import Cifar10, Cifar100  # noqa: F401

@@ -1,0 +1,81 @@
+"""Cifar10/100 with offline synthetic fallback (see mnist.py rationale).
+Reference parity: python/paddle/vision/datasets/cifar.py (unverified)."""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+import warnings
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle/dataset/cifar")
+
+
+def _synthetic(n, num_classes, sample_seed):
+    tmpl_rng = np.random.RandomState(12345)  # shared across train/test
+    templates = tmpl_rng.rand(num_classes, 32, 32, 3) * 255
+    rng = np.random.RandomState(sample_seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    noise = rng.rand(n, 32, 32, 3) * 64
+    images = np.clip(templates[labels] * 0.75 + noise, 0, 255).astype(np.uint8)
+    return images, labels
+
+
+class Cifar10(Dataset):
+    _num_classes = 10
+    _archive = "cifar-10-python.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.transform = transform
+        data_file = data_file or os.path.join(_CACHE, self._archive)
+        if os.path.exists(data_file):
+            self.images, self.labels = self._load_archive(data_file, mode)
+        else:
+            warnings.warn(
+                f"{type(self).__name__}: {data_file} not found and no "
+                "network egress — using deterministic synthetic stand-in."
+            )
+            n = 10000 if mode == "train" else 2000
+            self.images, self.labels = _synthetic(
+                n, self._num_classes, sample_seed=42 + (mode == "test")
+            )
+
+    def _load_archive(self, path, mode):
+        images, labels = [], []
+        want = "data_batch" if mode == "train" else "test_batch"
+        if self._num_classes == 100:
+            want = "train" if mode == "train" else "test"
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if want in member.name:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"])
+                    key = b"labels" if b"labels" in d else b"fine_labels"
+                    labels.extend(d[key])
+        arr = np.concatenate(images).reshape(-1, 3, 32, 32)
+        return (
+            np.transpose(arr, (0, 2, 3, 1)).astype(np.uint8),
+            np.asarray(labels, dtype=np.int64),
+        )
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32), (2, 0, 1))
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _num_classes = 100
+    _archive = "cifar-100-python.tar.gz"

@@ -1,0 +1,264 @@
+"""Vision transforms (numpy-native, PIL-tolerant).
+
+Reference parity: python/paddle/vision/transforms/ (unverified, mount
+empty). Transforms are host-side preprocessing: they stay in numpy (PIL
+accepted and converted) so the DataLoader worker pool can run them off the
+accelerator's critical path; only the final batch crosses to device.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+def _to_numpy(img):
+    if isinstance(img, np.ndarray):
+        return img
+    try:  # PIL image
+        return np.asarray(img)
+    except Exception:
+        raise TypeError(f"unsupported image type {type(img)}")
+
+
+def _resize_np(img, size):
+    """Nearest+bilinear resize via jax.image on host numpy (HWC or HW)."""
+    import jax
+
+    h, w = (size, size) if isinstance(size, int) else size
+    arr = _to_numpy(img)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    out = np.asarray(
+        jax.image.resize(
+            arr.astype(np.float32), (h, w, arr.shape[2]), method="linear"
+        )
+    )
+    if np.issubdtype(_to_numpy(img).dtype, np.integer):
+        out = np.clip(np.round(out), 0, 255).astype(_to_numpy(img).dtype)
+    return out[:, :, 0] if squeeze else out
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = _to_numpy(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if np.issubdtype(arr.dtype, np.integer):
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(
+            mean if isinstance(mean, (list, tuple)) else [mean], np.float32
+        )
+        self.std = np.asarray(
+            std if isinstance(std, (list, tuple)) else [std], np.float32
+        )
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if isinstance(self.size, int):
+            h, w = arr.shape[:2]
+            if h < w:
+                size = (self.size, int(w * self.size / h))
+            else:
+                size = (int(h * self.size / w), self.size)
+        else:
+            size = self.size
+        return _resize_np(arr, size)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, numbers.Number):
+                p = (p, p, p, p)
+            pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pads)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else size
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if cw <= w and ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return _resize_np(arr[i : i + ch, j : j + cw], self.size)
+        return _resize_np(arr, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _to_numpy(img)[:, ::-1].copy()
+        return _to_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _to_numpy(img)[::-1].copy()
+        return _to_numpy(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding
+        if isinstance(p, numbers.Number):
+            p = (p, p, p, p)
+        elif len(p) == 2:
+            p = (p[0], p[1], p[0], p[1])
+        self.padding = p
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        p = self.padding
+        pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, pads, constant_values=self.fill)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        arr = _to_numpy(img).astype(np.float32)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * factor, 0, 255).astype(_to_numpy(img).dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        arr = _to_numpy(img).astype(np.float32)
+        factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * factor + mean, 0, 255).astype(
+            _to_numpy(img).dtype
+        )

