@@ -1,11 +1,77 @@
-"""paddle.distributed namespace — populated across build stages (SURVEY §7).
+"""paddle.distributed namespace.
 
-Currently: env contract (rank/world size). Comm API, fleet, launch, and the
-parallel wrappers land with the distributed foundation stage.
+Reference parity: python/paddle/distributed/__init__.py (unverified, mount
+empty). The comm API is ProcessGroupICI-backed (XLA collectives over
+ICI/DCN); fleet/topology build the hybrid jax mesh; the compiled parallel
+path lives in paddle_tpu.parallel.
 """
+from . import fleet  # noqa: F401
+from .communication import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    gather,
+    get_group,
+    is_initialized,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
 from .env import (  # noqa: F401
     get_current_endpoint,
-    get_rank,
     get_trainer_endpoints,
-    get_world_size,
 )
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from .process_group import ProcessGroup, ProcessGroupICI  # noqa: F401
+
+# spawn-style helper (reference paddle.distributed.spawn)
+
+
+def _spawn_entry(env, func, args):
+    """Module-level so the 'spawn' start method can pickle it."""
+    import os
+
+    os.environ.update(env)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    import multiprocessing as mp
+
+    master = options.get("master", "127.0.0.1:49201")
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_MASTER": master,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                f"127.0.0.1:{49210 + i}" for i in range(nprocs)
+            ),
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{49210 + rank}",
+        }
+        p = ctx.Process(
+            target=_spawn_entry, args=(env, func, args), daemon=daemon
+        )
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs

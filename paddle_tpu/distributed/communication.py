@@ -1,0 +1,138 @@
+"""paddle.distributed.* communication API.
+
+Reference parity: python/paddle/distributed/communication/ (unverified,
+mount empty): module-level collective functions + group management, backed
+by ProcessGroupICI.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import env as dist_env
+from .process_group import ProcessGroup, ProcessGroupICI, ReduceOp, Task  # noqa: F401
+
+_GROUPS: dict = {}
+_NEXT_ID = [0]
+
+
+def _world_group():
+    if "world" not in _GROUPS:
+        _GROUPS["world"] = ProcessGroup(
+            list(range(dist_env.get_world_size())), pg_id=0
+        )
+    return _GROUPS["world"]
+
+
+def new_group(ranks=None, backend="ici", timeout=None):
+    _NEXT_ID[0] += 1
+    g = ProcessGroup(
+        ranks if ranks is not None else list(range(dist_env.get_world_size())),
+        pg_id=_NEXT_ID[0],
+        backend=backend,
+    )
+    _GROUPS[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid, _world_group())
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _GROUPS.clear()
+    else:
+        _GROUPS.pop(group.id, None)
+
+
+def _g(group):
+    return group if group is not None else _world_group()
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _g(group).all_reduce(tensor, op, sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    return _g(group).all_gather(tensor_list, tensor, sync_op)
+
+
+def all_gather_object(object_list, obj, group=None):
+    import pickle
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    g = _g(group)
+    if g.nranks == 1:
+        object_list.append(obj)
+        return
+    # variable length: pad to max via a length-prefix allgather
+    from jax.experimental import multihost_utils
+
+    ln = multihost_utils.process_allgather(
+        jnp.asarray([payload.size]), tiled=False
+    )
+    maxlen = int(np.max(np.asarray(ln)))
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: payload.size] = payload
+    data = multihost_utils.process_allgather(jnp.asarray(padded), tiled=False)
+    for r in g.ranks:
+        n = int(np.asarray(ln)[r][0])
+        object_list.append(pickle.loads(bytes(np.asarray(data[r])[:n])))
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    g = _g(group)
+    return g.broadcast(tensor, g.get_group_rank(src), sync_op)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _g(group)
+    return g.reduce(tensor, g.get_group_rank(dst), op, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _g(group).reduce_scatter(tensor, tensor_list, op, sync_op)
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return _g(group).alltoall(out_tensor_list, in_tensor_list, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _g(group)
+    return g.scatter(tensor, tensor_list, g.get_group_rank(src), sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = _g(group)
+    tmp = []
+    g.all_gather(tmp, tensor, sync_op)
+    if g.rank == g.get_group_rank(dst) and gather_list is not None:
+        gather_list.extend(tmp)
+    return Task([t.value for t in tmp])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    return _g(group).send(tensor, dst, sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return _g(group).recv(tensor, src, sync_op)
+
+
+def barrier(group=None):
+    return _g(group).barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor.value, "block_until_ready"):
+        tensor.value.block_until_ready()
+
+
+def is_initialized():
+    from .parallel import _PARALLEL_ENV
+
+    return _PARALLEL_ENV["initialized"]

@@ -1,0 +1,23 @@
+"""paddle.distributed.fleet parity surface."""
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .fleet import Fleet, HybridParallelOptimizer, fleet  # noqa: F401
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+
+
+def __getattr__(name):
+    # lazy: meta_parallel / recompute land with the hybrid stage but the
+    # names must resolve for reference imports
+    if name in ("meta_parallel", "recompute", "utils"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

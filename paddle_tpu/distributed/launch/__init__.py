@@ -1,0 +1,10 @@
+"""paddle.distributed.launch — the multi-process launcher.
+
+Reference parity: python/paddle/distributed/launch/ (unverified, mount
+empty): ``python -m paddle_tpu.distributed.launch --nnodes ... train.py``
+spawns one worker process per host slot, exporting the PADDLE_TRAINER_*
+env contract. On TPU one process per HOST (not per chip) is the jax model;
+``--nproc_per_node`` defaults to 1 accordingly, and the coordinator address
+feeds jax.distributed.initialize.
+"""
+from .main import launch, main  # noqa: F401

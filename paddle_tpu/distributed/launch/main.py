@@ -1,0 +1,156 @@
+"""Launcher implementation.
+
+Reference parity: python/paddle/distributed/launch/main.py + the
+CollectiveController (controllers/collective.py — unverified, mount
+empty): builds the pod, exports PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS
+/ PADDLE_MASTER, spawns children, tails logs, tears the pod down on a
+child crash, and (elastic) restarts from checkpoint.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (one worker per host slot)",
+    )
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes, or range lo:hi for elastic")
+    p.add_argument("--nproc_per_node", type=int,
+                   default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")),
+                   help="worker processes per node (TPU: 1 per host)")
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="coordinator ip:port")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--ips", type=str, default="",
+                   help="comma-separated node hostnames/IPs, node 0 first "
+                        "(defaults to the master host for every node)")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--gpus", "--devices", dest="devices", type=str, default="")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--max_restart", type=int,
+                   default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", "0")))
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(args, nnodes):
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    master = args.master or "127.0.0.1:49175"
+    master_host = master.split(":")[0]
+    base_port = int(master.split(":")[1]) if ":" in master else 49175
+    hosts = (
+        [h.strip() for h in args.ips.split(",")]
+        if args.ips
+        else [master_host] * nnodes
+    )
+    if len(hosts) != nnodes:
+        raise SystemExit(
+            f"--ips lists {len(hosts)} hosts but --nnodes is {nnodes}"
+        )
+    endpoints = []
+    for n in range(nnodes):
+        for i in range(nproc):
+            endpoints.append(f"{hosts[n]}:{base_port + n * nproc + i}")
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    for local_rank in range(nproc):
+        rank = args.node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": master,
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "w")
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        procs.append(
+            (subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT),
+             logf, log_path)
+        )
+    return procs
+
+
+def _watch(procs):
+    """Reference controller behavior: any child crash tears down the pod."""
+    try:
+        while True:
+            alive = 0
+            for proc, _, log_path in procs:
+                code = proc.poll()
+                if code is None:
+                    alive += 1
+                elif code != 0:
+                    sys.stderr.write(
+                        f"worker failed (exit {code}); see {log_path}; "
+                        "terminating pod\n"
+                    )
+                    _kill(procs)
+                    return code
+            if alive == 0:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        _kill(procs)
+        return 130
+
+
+def _kill(procs):
+    for proc, _, _ in procs:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    deadline = time.time() + 5
+    for proc, logf, _ in procs:
+        try:
+            proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        logf.close()
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if ":" in args.nnodes:
+        lo, _, hi = args.nnodes.partition(":")
+        nnodes = int(lo)
+        restarts = args.max_restart or 3
+    else:
+        nnodes = int(args.nnodes)
+        restarts = args.max_restart
+    attempt = 0
+    while True:
+        procs = _spawn(args, nnodes)
+        code = _watch(procs)
+        if code == 0 or code == 130 or attempt >= restarts:
+            # 130 = operator Ctrl-C: never auto-restart a deliberate stop
+            return code
+        attempt += 1
+        sys.stderr.write(
+            f"elastic restart {attempt}/{restarts} (resume from checkpoint)\n"
+        )
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""ProcessGroupICI — eager collective API over XLA collectives.
+
+Reference parity: ProcessGroup/ProcessGroupNCCL (paddle/fluid/distributed/
+collective/process_group_nccl.cc — unverified, mount empty). North-star
+(BASELINE.json): "replace ProcessGroupNCCL with a ProcessGroupICI so
+Fleet's collectives ride the pod interconnect."
+
+TPU-first semantics: inside compiled parallel programs collectives are
+mesh-axis ops (paddle_tpu.parallel.collectives) — that is the hot path.
+This class provides the *eager* paddle.distributed.* contract:
+
+- multi-process (one process per host, jax.distributed initialized): eager
+  collectives run as tiny jitted programs over a process-spanning mesh via
+  jax.make_array_from_process_local_data — XLA executes them over ICI/DCN.
+- single-process: world_size==1 group ops are identity (paddle behavior
+  for a 1-rank group).
+
+Async Task handles are returned for API parity; jax dispatch is already
+async, so wait() is a block-until-ready.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    AVG = "mean"
+    PROD = "prod"
+
+
+class Task:
+    def __init__(self, values):
+        self._values = values
+
+    def wait(self):
+        for v in self._values:
+            if hasattr(v, "block_until_ready"):
+                v.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        return self.wait()
+
+
+class ProcessGroup:
+    """A set of ranks. rank==-1 means this process is not a member."""
+
+    def __init__(self, ranks, pg_id=0, backend="ici"):
+        from . import env as dist_env
+
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.id = pg_id
+        self.backend = backend
+        me = dist_env.get_rank()
+        self.rank = self.ranks.index(me) if me in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) if global_rank in self.ranks else -1
+
+    # -------------------------------------------------------- collectives
+    def _member_mesh(self):
+        """A 1-axis mesh over this group's processes' addressable devices."""
+        devs = []
+        for r in self.ranks:
+            devs.extend(
+                d for d in jax.devices() if d.process_index == r
+            )
+        import numpy as _np
+
+        from jax.sharding import Mesh
+
+        return Mesh(_np.array(devs), axis_names=("pg",))
+
+    def _cross_process(self, local_value, reducer):
+        """Run ``reducer`` over per-process values; returns this rank's out."""
+        if self.nranks == 1:
+            return local_value
+        if self.rank < 0:
+            raise RuntimeError(
+                "collective called on a process that is not a member of "
+                f"group {self.id} (paddle semantics: only members call)"
+            )
+        from . import env as dist_env
+
+        if self.nranks != dist_env.get_world_size():
+            # process_allgather is a WORLD collective; a strict subgroup
+            # would deadlock waiting on non-members. Subgroup eager
+            # collectives are expressed as mesh-axis collectives on TPU.
+            raise NotImplementedError(
+                "eager collectives over a strict process subgroup are not "
+                "supported on TPU; use mesh-axis collectives "
+                "(paddle_tpu.parallel.collectives) inside the compiled step, "
+                "or a world-spanning group"
+            )
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(local_value, tiled=False)
+        sub = gathered[np.asarray(self.ranks)]
+        return reducer(sub)
+
+    def _check_member(self, group_rank, what):
+        if group_rank < 0 or group_rank >= self.nranks:
+            raise ValueError(
+                f"{what} rank is not a member of process group {self.id} "
+                f"(ranks={self.ranks})"
+            )
+
+    def all_reduce(self, tensor, op=ReduceOp.SUM, sync_op=True):
+        red = {
+            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
+            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
+            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
+            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
+            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
+        }[op]
+        out = self._cross_process(tensor.value, red)
+        tensor.value = out
+        return Task([out])
+
+    def all_gather(self, tensor_or_list, tensor=None, sync_op=True):
+        if isinstance(tensor_or_list, list):
+            out_list, src = tensor_or_list, tensor
+            if self.nranks == 1:
+                out_list.append(Tensor(src.value))
+                return Task([src.value])
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(src.value, tiled=False)
+            for r in self.ranks:
+                out_list.append(Tensor(jnp.asarray(gathered[r])))
+            return Task([gathered])
+        raise TypeError("all_gather expects (out_list, tensor)")
+
+    def broadcast(self, tensor, src=0, sync_op=True):
+        self._check_member(src, "src")
+        if self.nranks == 1:
+            return Task([tensor.value])
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(tensor.value, tiled=False)
+        tensor.value = jnp.asarray(gathered[self.ranks[src]])
+        return Task([tensor.value])
+
+    def reduce(self, tensor, dst=0, op=ReduceOp.SUM, sync_op=True):
+        self._check_member(dst, "dst")
+        return self.all_reduce(tensor, op)
+
+    def reduce_scatter(self, tensor, tensor_list, op=ReduceOp.SUM, sync_op=True):
+        if self.nranks == 1:
+            tensor.value = tensor_list[0].value
+            return Task([tensor.value])
+        stacked = jnp.stack([t.value for t in tensor_list])
+        red = self._cross_process(stacked, lambda s: jnp.sum(s, axis=0))
+        tensor.value = red[self.rank]
+        return Task([tensor.value])
+
+    def alltoall(self, out_tensor_list, in_tensor_list, sync_op=True):
+        if self.nranks == 1:
+            for o, i in zip(out_tensor_list, in_tensor_list):
+                o._replace_with(Tensor(i.value))
+            if not out_tensor_list:
+                out_tensor_list.extend(Tensor(i.value) for i in in_tensor_list)
+            return Task([t.value for t in in_tensor_list])
+        from jax.experimental import multihost_utils
+
+        stacked = jnp.stack([t.value for t in in_tensor_list])
+        gathered = multihost_utils.process_allgather(stacked, tiled=False)
+        outs = [jnp.asarray(gathered[r][self.rank]) for r in self.ranks]
+        del out_tensor_list[:]
+        out_tensor_list.extend(Tensor(o) for o in outs)
+        return Task(outs)
+
+    def scatter(self, tensor, tensor_list=None, src=0, sync_op=True):
+        self._check_member(src, "src")
+        if self.nranks == 1:
+            if tensor_list:
+                tensor.value = tensor_list[0].value
+            return Task([tensor.value])
+        from jax.experimental import multihost_utils
+
+        if self.rank == src and tensor_list:
+            stacked = jnp.stack([t.value for t in tensor_list])
+        else:
+            stacked = jnp.zeros(
+                (self.nranks,) + tuple(tensor.shape), tensor.value.dtype
+            )
+        gathered = multihost_utils.process_allgather(stacked, tiled=False)
+        tensor.value = jnp.asarray(gathered[self.ranks[src]][self.rank])
+        return Task([tensor.value])
+
+    def barrier(self, device_id=None):
+        if self.nranks == 1:
+            return Task([])
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"pg_{self.id}_barrier")
+        return Task([])
+
+    def send(self, tensor, dst=0, sync_op=True):
+        raise NotImplementedError(
+            "eager p2p send/recv is not exposed on TPU; pipeline stages use "
+            "compiled ppermute (paddle_tpu.parallel.collectives.ppermute)"
+        )
+
+    recv = send
+
+
+ProcessGroupICI = ProcessGroup

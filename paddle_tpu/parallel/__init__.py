@@ -1,0 +1,18 @@
+"""paddle_tpu.parallel — the TPU-native parallelism substrate.
+
+This is the layer the reference does NOT have: where Paddle hand-schedules
+NCCL (SURVEY.md §2.2), paddle_tpu expresses every parallelism axis as a
+jax.sharding.Mesh dimension and lets XLA/SPMD insert collectives over
+ICI/DCN. Everything in paddle_tpu.distributed (the paddle-parity API) is a
+veneer over this module.
+"""
+from .mesh import (  # noqa: F401
+    axis_index,
+    axis_size,
+    get_mesh,
+    global_mesh_shape,
+    init_mesh,
+    mesh_defined,
+    set_mesh,
+)
+from . import collectives  # noqa: F401

@@ -1,0 +1,121 @@
+"""Collective primitives over mesh axes.
+
+Reference parity: the C++ ProcessGroup collective set (SURVEY.md §2.2) —
+but expressed the TPU way: these are *traceable* functions used inside
+shard_map'd / jitted parallel programs, compiled by XLA into ICI
+collectives. The eager ProcessGroupICI (distributed/process_group.py) calls
+the same primitives through cached jitted executables.
+
+Two families:
+- in-trace (lax.*) wrappers: psum/pmean/all_gather/reduce_scatter/
+  all_to_all/ppermute/broadcast_in_axis — usable inside shard_map bodies.
+- host-level helpers building jitted shard_map executables for one-shot
+  eager collectives on sharded global arrays.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import get_mesh
+
+# ------------------------------------------------------------ in-trace ops
+
+
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name):
+    return jax.lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name):
+    return jax.lax.pmin(x, axis_name)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_axis=0):
+    return jax.lax.psum_scatter(
+        x, axis_name, scatter_dimension=scatter_axis, tiled=True
+    )
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled,
+    )
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def broadcast_from(x, axis_name, src=0):
+    """Everyone gets rank-src's value (inside shard_map)."""
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+# ---------------------------------------------- eager executables (cached)
+
+
+@functools.lru_cache(maxsize=256)
+def _allreduce_exec(mesh_id, axis, op, shape, dtype):
+    mesh = get_mesh()
+    reducer = {"sum": psum, "mean": pmean, "max": pmax, "min": pmin}[op]
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+    )
+    def f(x):
+        return reducer(x, axis)
+
+    return f
+
+
+def eager_all_reduce(global_array, axis, op="sum"):
+    """All-reduce a global array whose leading dim is sharded over ``axis``.
+
+    Each "rank" (mesh coordinate on axis) owns one slice along dim 0;
+    afterwards every slice holds the reduction — eager ProcessGroup
+    semantics expressed on a sharded array.
+    """
+    mesh = get_mesh()
+    f = _allreduce_exec(
+        id(mesh), axis, op, tuple(global_array.shape), str(global_array.dtype)
+    )
+    return f(global_array)
+
+
+def shard_batch(arr, axis="dp", mesh=None):
+    """Place a host batch onto the mesh sharded along dim 0 (input path)."""
+    mesh = mesh or get_mesh()
+    spec = [None] * arr.ndim
+    spec[0] = axis
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(arr, mesh=None):
+    mesh = mesh or get_mesh()
+    return jax.device_put(arr, NamedSharding(mesh, P()))

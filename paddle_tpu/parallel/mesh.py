@@ -1,0 +1,85 @@
+"""Global device mesh management.
+
+The hybrid topology (dp/sharding/pp/sep/mp/ep — SURVEY.md §2.3) is ONE
+jax.sharding.Mesh whose axis order follows the reference's
+CommunicateTopology convention: outermost-first [dp, pp, sharding, sep, mp]
+(+ ep folded over dp×sharding for MoE). Mesh construction is DCN-aware:
+when multiple slices/processes exist, the outermost axis maps across hosts
+(DCN) and inner axes stay on ICI — jax's device order already enumerates
+ICI-adjacent devices contiguously, so splitting outer-first achieves this.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_LOCK = threading.Lock()
+_STATE = {"mesh": None}
+
+# canonical axis order, outermost first — MUST match the order fleet's
+# CommunicateTopology builds (reference: python/paddle/distributed/fleet/
+# base/topology.py — unverified)
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def init_mesh(axes=None, devices=None):
+    """Create + install the global mesh.
+
+    axes: dict axis_name -> degree (product must equal device count; a
+    single -1 degree is inferred). Default: {'dp': n_devices}.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if axes is None:
+        axes = {"dp": n}
+    names, degrees = [], []
+    for k, v in axes.items():
+        names.append(k)
+        degrees.append(int(v))
+    if -1 in degrees:
+        known = int(np.prod([d for d in degrees if d != -1]))
+        degrees[degrees.index(-1)] = n // known
+    total = int(np.prod(degrees))
+    if total != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, degrees))} need {total} devices, "
+            f"have {n}"
+        )
+    arr = np.array(devs).reshape(degrees)
+    mesh = Mesh(arr, axis_names=tuple(names))
+    set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh):
+    with _LOCK:
+        _STATE["mesh"] = mesh
+
+
+def get_mesh() -> Mesh:
+    m = _STATE["mesh"]
+    if m is None:
+        m = init_mesh()
+    return m
+
+
+def mesh_defined() -> bool:
+    return _STATE["mesh"] is not None
+
+
+def global_mesh_shape() -> dict:
+    m = get_mesh()
+    return dict(zip(m.axis_names, m.devices.shape))
+
+
+def axis_size(name: str) -> int:
+    return global_mesh_shape().get(name, 1)
+
+
+def axis_index(name: str):
+    """Inside shard_map: this device's coordinate along axis ``name``."""
+    return jax.lax.axis_index(name)
