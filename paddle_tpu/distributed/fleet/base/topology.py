@@ -106,7 +106,8 @@ class HybridCommunicateGroup:
                 if min(self.global_rank, world - 1) in ranks:
                     tag = f"{name}:{','.join(map(str, ranks))}".encode()
                     self._groups[name] = ProcessGroup(
-                        ranks, pg_id=zlib.crc32(tag) % 100000
+                        ranks, pg_id=zlib.crc32(tag) % 100000,
+                        mesh_axis=name,
                     )
                     break
         self._coord = dict(zip(topology.get_hybrid_group_names(), coord))

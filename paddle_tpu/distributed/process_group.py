@@ -7,23 +7,36 @@ Fleet's collectives ride the pod interconnect."
 
 TPU-first semantics: inside compiled parallel programs collectives are
 mesh-axis ops (paddle_tpu.parallel.collectives) — that is the hot path.
-This class provides the *eager* paddle.distributed.* contract:
+This class provides the *eager* paddle.distributed.* contract across three
+group flavours:
 
-- multi-process (one process per host, jax.distributed initialized): eager
-  collectives run as tiny jitted programs over a process-spanning mesh via
-  jax.make_array_from_process_local_data — XLA executes them over ICI/DCN.
-- single-process: world_size==1 group ops are identity (paddle behavior
-  for a 1-rank group).
+1. **SPMD axis groups** (single OS process, group tied to a mesh axis —
+   what HybridCommunicateGroup creates): if the tensor's array is sharded
+   along the group's axis, the collective runs as a cached jitted
+   shard_map executable over that axis (a real XLA ICI collective, with
+   the per-rank shape semantics of the reference: all_reduce of an
+   axis-sharded [n*k,…] array yields the [k,…] reduction replicated along
+   the axis). If the array is *replicated* along the axis, every virtual
+   rank holds the same value and the collective is computed in closed
+   form (sum → n·x, max/min/avg → x, gather → n copies, …).
+2. **Multi-process world groups**: eager collectives over
+   multihost_utils.process_allgather (XLA over ICI/DCN).
+3. **Multi-process strict subgroups**: jitted collectives over a mesh
+   spanning only the member processes' devices — every member process
+   calls, non-members stay out, so no world-collective deadlock.
 
 Async Task handles are returned for API parity; jax dispatch is already
 async, so wait() is a block-until-ready.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.tensor import Tensor
 
@@ -34,6 +47,14 @@ class ReduceOp:
     MIN = "min"
     AVG = "mean"
     PROD = "prod"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.AVG: jax.lax.pmean,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
 
 
 class Task:
@@ -53,42 +74,174 @@ class Task:
         return self.wait()
 
 
-class ProcessGroup:
-    """A set of ranks. rank==-1 means this process is not a member."""
+def _spec_of(arr):
+    """PartitionSpec of a jax array (empty spec if unsharded/unknown)."""
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return P()
 
-    def __init__(self, ranks, pg_id=0, backend="ici"):
+def _axis_dim(arr, axis_name):
+    """Which array dim is sharded over ``axis_name`` (None if replicated)."""
+    spec = _spec_of(arr)
+    for d, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis_name in [n for n in names if n is not None]:
+            return d
+    return None
+
+
+@functools.lru_cache(maxsize=512)
+def _axis_exec(mesh_epoch_key, axis, kind, in_dim, op, nranks):
+    """Cached jitted shard_map executable for one (axis, collective) shape
+    family. ``in_dim`` = array dim sharded over ``axis`` on input (None =
+    replicated input)."""
+    from ..parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+
+    def in_spec(dim):
+        if dim is None:
+            return P()
+        s = [None] * (dim + 1)
+        s[dim] = axis
+        return P(*s)
+
+    if kind == "all_reduce":
+        # per-rank shard -> reduced value replicated along axis
+        fn = lambda x: _REDUCERS[op](x, axis)
+        in_s, out_s = in_spec(in_dim), P()
+    elif kind == "all_gather":
+        # per-rank shard -> [nranks, shard...] stack, replicated
+        fn = lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)
+        in_s, out_s = in_spec(in_dim), P()
+    elif kind == "broadcast":
+        def fn(x, src_idx):
+            idx = jax.lax.axis_index(axis)
+            masked = jnp.where(idx == src_idx, x, jnp.zeros_like(x))
+            return jax.lax.psum(masked, axis)
+
+        in_s, out_s = (in_spec(in_dim), P()), P()
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    shmapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_s, out_specs=out_s, check_vma=False
+    )
+    return jax.jit(shmapped)
+
+
+class ProcessGroup:
+    """A set of ranks. rank==-1 means this process is not a member.
+
+    ``mesh_axis``: for SPMD axis groups (single-process hybrid topology),
+    the global-mesh axis this group reduces over; ranks are then virtual
+    chip coordinates, not process indices.
+    """
+
+    def __init__(self, ranks, pg_id=0, backend="ici", mesh_axis=None):
         from . import env as dist_env
 
         self.ranks = list(ranks)
         self.nranks = len(self.ranks)
         self.id = pg_id
         self.backend = backend
+        self.mesh_axis = mesh_axis
         me = dist_env.get_rank()
         self.rank = self.ranks.index(me) if me in self.ranks else -1
+        if mesh_axis is not None and self.rank < 0:
+            # virtual chip-rank groups in single-process SPMD: this process
+            # drives rank 0 of every axis group it constructs
+            self.rank = 0
+        # pending eager p2p messages (single-process PP parity path)
+        self._p2p_box = {}
 
     @property
     def world_size(self):
         return self.nranks
 
+    @property
+    def process_ids(self):
+        return self.ranks
+
     def get_group_rank(self, global_rank):
         return self.ranks.index(global_rank) if global_rank in self.ranks else -1
 
-    # -------------------------------------------------------- collectives
-    def _member_mesh(self):
-        """A 1-axis mesh over this group's processes' addressable devices."""
-        devs = []
-        for r in self.ranks:
-            devs.extend(
-                d for d in jax.devices() if d.process_index == r
-            )
-        import numpy as _np
+    # ----------------------------------------------------------- mode query
+    def _is_spmd_axis_group(self):
+        from . import env as dist_env
 
-        from jax.sharding import Mesh
+        return self.mesh_axis is not None and dist_env.get_world_size() == 1
 
-        return Mesh(_np.array(devs), axis_names=("pg",))
+    def _axis_run(self, kind, arr, op="sum", extra=None):
+        """Run a collective over the group's mesh axis on a global array."""
+        from ..parallel.mesh import mesh_epoch
 
-    def _cross_process(self, local_value, reducer):
-        """Run ``reducer`` over per-process values; returns this rank's out."""
+        axis = self.mesh_axis
+        dim = _axis_dim(arr, axis)
+        if kind == "all_reduce" and op not in _REDUCERS:
+            # no lax prod collective: gather then reduce locally
+            stacked = _axis_exec(
+                mesh_epoch(), axis, "all_gather", dim, "sum", self.nranks
+            )(arr)
+            return jnp.prod(stacked, axis=0)
+        f = _axis_exec(mesh_epoch(), axis, kind, dim, op, self.nranks)
+        if extra is not None:
+            return f(arr, extra)
+        return f(arr)
+
+    def _member_mesh(self, ranks=None):
+        """A 1-axis mesh with ONE device per member process.
+
+        The eager cross-process path intentionally uses a single
+        representative device per process so the gathered array has
+        exactly one entry per group rank (multi-chip hosts would
+        otherwise yield per-device duplicates); results are host values,
+        so the remaining chips are not involved.
+        """
+        members = self.ranks if ranks is None else ranks
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[r] for r in members]
+        return Mesh(np.array(devs), axis_names=("pg",))
+
+    def _subgroup_gather(self, local_value, ranks=None):
+        """Gather per-member-process values over the member mesh. Every
+        member process calls this; non-members never enter. Returns an
+        np.ndarray with one entry per member (group-rank order)."""
+        mesh = self._member_mesh(ranks)
+        n = len(mesh.devices)
+        x = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("pg")),
+            np.asarray(local_value)[None],
+            (n,) + tuple(np.shape(local_value)),
+        )
+        shmapped = jax.shard_map(
+            lambda v: jax.lax.all_gather(
+                jnp.squeeze(v, 0), "pg", axis=0, tiled=False
+            )[None],
+            mesh=mesh, in_specs=P("pg"), out_specs=P("pg"),
+            check_vma=False,
+        )
+        out = jax.jit(shmapped)(x)
+        return np.asarray(out.addressable_shards[0].data[0])
+
+    def _subgroup_reduce(self, local_value, op):
+        """Strict-subgroup reduce = member-mesh gather + local reduce
+        (uniform support for every ReduceOp, including PROD)."""
+        gathered = self._subgroup_gather(local_value)
+        red = {
+            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
+            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
+            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
+            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
+            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
+        }[op]
+        return jnp.asarray(red(jnp.asarray(gathered)))
+
+    def _cross_process(self, local_value, reducer, op=ReduceOp.SUM):
+        """Reduce per-process values; returns this rank's result."""
         if self.nranks == 1:
             return local_value
         if self.rank < 0:
@@ -99,15 +252,7 @@ class ProcessGroup:
         from . import env as dist_env
 
         if self.nranks != dist_env.get_world_size():
-            # process_allgather is a WORLD collective; a strict subgroup
-            # would deadlock waiting on non-members. Subgroup eager
-            # collectives are expressed as mesh-axis collectives on TPU.
-            raise NotImplementedError(
-                "eager collectives over a strict process subgroup are not "
-                "supported on TPU; use mesh-axis collectives "
-                "(paddle_tpu.parallel.collectives) inside the compiled step, "
-                "or a world-spanning group"
-            )
+            return self._subgroup_reduce(local_value, op)
         from jax.experimental import multihost_utils
 
         gathered = multihost_utils.process_allgather(local_value, tiled=False)
@@ -121,7 +266,26 @@ class ProcessGroup:
                 f"(ranks={self.ranks})"
             )
 
+    # -------------------------------------------------------- collectives
     def all_reduce(self, tensor, op=ReduceOp.SUM, sync_op=True):
+        if self.nranks == 1:
+            return Task([tensor.value])
+        if self._is_spmd_axis_group():
+            if _axis_dim(tensor.value, self.mesh_axis) is not None:
+                out = self._axis_run("all_reduce", tensor.value, op=op)
+            else:
+                # replicated along the axis: every virtual rank holds the
+                # same value -> closed form
+                v = tensor.value
+                out = {
+                    ReduceOp.SUM: lambda: v * self.nranks,
+                    ReduceOp.AVG: lambda: v,
+                    ReduceOp.MAX: lambda: v,
+                    ReduceOp.MIN: lambda: v,
+                    ReduceOp.PROD: lambda: v**self.nranks,
+                }[op]()
+            tensor.value = out
+            return Task([out])
         red = {
             ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
             ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
@@ -129,27 +293,59 @@ class ProcessGroup:
             ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
             ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
         }[op]
-        out = self._cross_process(tensor.value, red)
+        out = self._cross_process(tensor.value, red, op)
         tensor.value = out
         return Task([out])
 
     def all_gather(self, tensor_or_list, tensor=None, sync_op=True):
-        if isinstance(tensor_or_list, list):
-            out_list, src = tensor_or_list, tensor
-            if self.nranks == 1:
-                out_list.append(Tensor(src.value))
-                return Task([src.value])
-            from jax.experimental import multihost_utils
+        if not isinstance(tensor_or_list, list):
+            raise TypeError("all_gather expects (out_list, tensor)")
+        out_list, src = tensor_or_list, tensor
+        if self.nranks == 1:
+            out_list.append(Tensor(src.value))
+            return Task([src.value])
+        if self._is_spmd_axis_group():
+            dim = _axis_dim(src.value, self.mesh_axis)
+            if dim is not None:
+                stacked = self._axis_run("all_gather", src.value)
+                outs = [jnp.asarray(stacked[i]) for i in range(self.nranks)]
+            else:
+                outs = [src.value for _ in range(self.nranks)]
+            out_list.extend(Tensor(o) for o in outs)
+            return Task(outs)
+        from . import env as dist_env
 
-            gathered = multihost_utils.process_allgather(src.value, tiled=False)
-            for r in self.ranks:
-                out_list.append(Tensor(jnp.asarray(gathered[r])))
+        if self.nranks != dist_env.get_world_size():
+            gathered = self._subgroup_gather(src.value)
+            for i in range(self.nranks):
+                out_list.append(Tensor(jnp.asarray(gathered[i])))
             return Task([gathered])
-        raise TypeError("all_gather expects (out_list, tensor)")
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(src.value, tiled=False)
+        for r in self.ranks:
+            out_list.append(Tensor(jnp.asarray(gathered[r])))
+        return Task([gathered])
 
     def broadcast(self, tensor, src=0, sync_op=True):
         self._check_member(src, "src")
         if self.nranks == 1:
+            return Task([tensor.value])
+        if self._is_spmd_axis_group():
+            dim = _axis_dim(tensor.value, self.mesh_axis)
+            if dim is not None:
+                out = self._axis_run(
+                    "broadcast", tensor.value,
+                    extra=jnp.asarray(src, jnp.int32),
+                )
+                tensor.value = out
+            # replicated: already equals src's value on every virtual rank
+            return Task([tensor.value])
+        from . import env as dist_env
+
+        if self.nranks != dist_env.get_world_size():
+            gathered = self._subgroup_gather(tensor.value)
+            tensor.value = jnp.asarray(gathered[src])
             return Task([tensor.value])
         from jax.experimental import multihost_utils
 
@@ -165,25 +361,71 @@ class ProcessGroup:
         if self.nranks == 1:
             tensor.value = tensor_list[0].value
             return Task([tensor.value])
+        if self._is_spmd_axis_group():
+            self._reject_axis_sharded(tensor_list, "reduce_scatter")
+            # every virtual rank holds the same stacked inputs (replicated
+            # view); rank r's output = reduce over nranks identical copies
+            # of slice r
+            stacked = jnp.stack([t.value for t in tensor_list])
+            red = {
+                ReduceOp.SUM: lambda: stacked * self.nranks,
+                ReduceOp.AVG: lambda: stacked,
+                ReduceOp.MAX: lambda: stacked,
+                ReduceOp.MIN: lambda: stacked,
+                ReduceOp.PROD: lambda: stacked**self.nranks,
+            }[op]()
+            tensor.value = red[self.rank]
+            return Task([tensor.value])
         stacked = jnp.stack([t.value for t in tensor_list])
-        red = self._cross_process(stacked, lambda s: jnp.sum(s, axis=0))
+        reducer = {
+            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
+            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
+            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
+            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
+            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
+        }[op]
+        red = self._cross_process(stacked, reducer, op)
         tensor.value = red[self.rank]
         return Task([tensor.value])
 
+    def _emit_outputs(self, out_tensor_list, outs):
+        """Fill out_tensor_list with ``outs`` (jax arrays), updating any
+        caller-held pre-allocated Tensors in place (paddle aliasing)."""
+        if len(out_tensor_list) == len(outs):
+            for t, o in zip(out_tensor_list, outs):
+                t.value = o
+        else:
+            del out_tensor_list[:]
+            out_tensor_list.extend(Tensor(o) for o in outs)
+
     def alltoall(self, out_tensor_list, in_tensor_list, sync_op=True):
         if self.nranks == 1:
-            for o, i in zip(out_tensor_list, in_tensor_list):
-                o._replace_with(Tensor(i.value))
-            if not out_tensor_list:
-                out_tensor_list.extend(Tensor(i.value) for i in in_tensor_list)
+            self._emit_outputs(
+                out_tensor_list, [i.value for i in in_tensor_list]
+            )
             return Task([t.value for t in in_tensor_list])
-        from jax.experimental import multihost_utils
+        if self._is_spmd_axis_group():
+            self._reject_axis_sharded(in_tensor_list, "alltoall")
+            # replicated single-process view: out[j] = rank j's
+            # in[self.rank]; replicas share the list, so every output is
+            # in_tensor_list[self.rank]
+            self._emit_outputs(
+                out_tensor_list,
+                [in_tensor_list[self.rank].value] * self.nranks,
+            )
+            return Task([t.value for t in out_tensor_list])
+        from . import env as dist_env
 
         stacked = jnp.stack([t.value for t in in_tensor_list])
-        gathered = multihost_utils.process_allgather(stacked, tiled=False)
-        outs = [jnp.asarray(gathered[r][self.rank]) for r in self.ranks]
-        del out_tensor_list[:]
-        out_tensor_list.extend(Tensor(o) for o in outs)
+        if self.nranks != dist_env.get_world_size():
+            gathered = self._subgroup_gather(stacked)
+            outs = [jnp.asarray(gathered[i][self.rank]) for i in range(self.nranks)]
+        else:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(stacked, tiled=False)
+            outs = [jnp.asarray(gathered[r][self.rank]) for r in self.ranks]
+        self._emit_outputs(out_tensor_list, outs)
         return Task(outs)
 
     def scatter(self, tensor, tensor_list=None, src=0, sync_op=True):
@@ -192,7 +434,13 @@ class ProcessGroup:
             if tensor_list:
                 tensor.value = tensor_list[0].value
             return Task([tensor.value])
-        from jax.experimental import multihost_utils
+        if self._is_spmd_axis_group():
+            # replicated view: src's list is our list
+            if tensor_list:
+                self._reject_axis_sharded(tensor_list, "scatter")
+                tensor.value = tensor_list[self.rank].value
+            return Task([tensor.value])
+        from . import env as dist_env
 
         if self.rank == src and tensor_list:
             stacked = jnp.stack([t.value for t in tensor_list])
@@ -200,25 +448,83 @@ class ProcessGroup:
             stacked = jnp.zeros(
                 (self.nranks,) + tuple(tensor.shape), tensor.value.dtype
             )
+        if self.nranks != dist_env.get_world_size():
+            gathered = self._subgroup_gather(stacked)
+            tensor.value = jnp.asarray(gathered[src][self.rank])
+            return Task([tensor.value])
+        from jax.experimental import multihost_utils
+
         gathered = multihost_utils.process_allgather(stacked, tiled=False)
         tensor.value = jnp.asarray(gathered[self.ranks[src]][self.rank])
         return Task([tensor.value])
 
+    def _reject_axis_sharded(self, tensors, what):
+        """Eager list-based collectives on SPMD axis groups operate on the
+        replicated per-rank view; an input sharded along the group axis
+        means the caller wants the compiled form — fail loudly instead of
+        silently applying replica semantics."""
+        for t in tensors:
+            if _axis_dim(t.value, self.mesh_axis) is not None:
+                raise NotImplementedError(
+                    f"eager {what} over axis-sharded inputs is not defined "
+                    "for the single-process replicated view; use the "
+                    "compiled mesh collectives "
+                    "(paddle_tpu.parallel.collectives) inside the step"
+                )
+
     def barrier(self, device_id=None):
-        if self.nranks == 1:
+        from . import env as dist_env
+
+        if self.nranks == 1 or dist_env.get_world_size() == 1:
+            return Task([])
+        if self.nranks != dist_env.get_world_size():
+            # subgroup barrier: a tiny member-mesh collective (only
+            # members call -> no world-collective deadlock)
+            self._subgroup_gather(np.zeros((), np.int32))
             return Task([])
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"pg_{self.id}_barrier")
         return Task([])
 
+    # --------------------------------------------------------------- p2p
     def send(self, tensor, dst=0, sync_op=True):
-        raise NotImplementedError(
-            "eager p2p send/recv is not exposed on TPU; pipeline stages use "
-            "compiled ppermute (paddle_tpu.parallel.collectives.ppermute)"
-        )
+        """Eager p2p. Single-process (SPMD/virtual ranks): mailbox with
+        paddle pairing semantics — the PP hot path is compiled ppermute;
+        this is the API-parity/debug path. Multi-process: pairwise
+        collective over a 2-device mesh spanning ONLY the endpoints (both
+        endpoints call; other group members are not involved)."""
+        self._check_member(dst, "dst")
+        from . import env as dist_env
 
-    recv = send
+        if dist_env.get_world_size() == 1:
+            self._p2p_box.setdefault((self.rank, dst), []).append(
+                jnp.asarray(tensor.value)
+            )
+            return Task([tensor.value])
+        pair = sorted([self.ranks[self.rank], self.ranks[dst]])
+        self._subgroup_gather(tensor.value, ranks=pair)
+        return Task([tensor.value])
+
+    def recv(self, tensor, src=0, sync_op=True):
+        self._check_member(src, "src")
+        from . import env as dist_env
+
+        if dist_env.get_world_size() == 1:
+            box = self._p2p_box.get((src, self.rank))
+            if not box:
+                raise RuntimeError(
+                    f"recv(src={src}) with no matching send in group "
+                    f"{self.id}; in single-process SPMD, eager p2p is a "
+                    "same-process mailbox (compiled pipelines use ppermute)"
+                )
+            tensor.value = box.pop(0)
+            return Task([tensor.value])
+        src_proc = self.ranks[src]
+        pair = sorted([self.ranks[self.rank], src_proc])
+        gathered = self._subgroup_gather(tensor.value, ranks=pair)
+        tensor.value = jnp.asarray(gathered[pair.index(src_proc)])
+        return Task([tensor.value])
 
 
 ProcessGroupICI = ProcessGroup

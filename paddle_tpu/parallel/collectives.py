@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import get_mesh
+from .mesh import get_mesh, mesh_epoch
 
 # ------------------------------------------------------------ in-trace ops
 
@@ -77,7 +77,7 @@ def broadcast_from(x, axis_name, src=0):
 
 
 @functools.lru_cache(maxsize=256)
-def _allreduce_exec(mesh_id, axis, op, shape, dtype):
+def _allreduce_exec(mesh_epoch_key, axis, op, shape, dtype):
     mesh = get_mesh()
     reducer = {"sum": psum, "mean": pmean, "max": pmax, "min": pmin}[op]
 
@@ -103,7 +103,8 @@ def eager_all_reduce(global_array, axis, op="sum"):
     """
     mesh = get_mesh()
     f = _allreduce_exec(
-        id(mesh), axis, op, tuple(global_array.shape), str(global_array.dtype)
+        mesh_epoch(), axis, op,
+        tuple(global_array.shape), str(global_array.dtype),
     )
     return f(global_array)
 

@@ -18,7 +18,9 @@ import jax
 from jax.sharding import Mesh
 
 _LOCK = threading.Lock()
-_STATE = {"mesh": None}
+# epoch increments on every set_mesh so executable caches keyed on it can
+# never alias a recycled id() of a GC'd mesh
+_STATE = {"mesh": None, "epoch": 0}
 
 # canonical axis order, outermost first — MUST match the order fleet's
 # CommunicateTopology builds (reference: python/paddle/distributed/fleet/
@@ -58,6 +60,12 @@ def init_mesh(axes=None, devices=None):
 def set_mesh(mesh):
     with _LOCK:
         _STATE["mesh"] = mesh
+        _STATE["epoch"] += 1
+
+
+def mesh_epoch() -> int:
+    """Stable identity for executable caches (bumped by every set_mesh)."""
+    return _STATE["epoch"]
 
 
 def get_mesh() -> Mesh:
