@@ -1,0 +1,16 @@
+"""meta_parallel — hybrid-parallel model wrappers and parallel layers.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+(unverified, mount empty). TP layers are GSPMD sharding-constraint
+layers; PP arrives as PipelineLayer + schedules.
+"""
+from .parallel_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RNGStatesTracker,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+    shard_constraint,
+)

@@ -1,0 +1,216 @@
+"""Tensor-parallel (mp) layers — GSPMD sharding-constraint style.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/mp_layers.py (unverified, mount empty):
+VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+ParallelCrossEntropy with the same constructor surface.
+
+TPU-first redesign: instead of per-rank weight shards plus hand-written
+NCCL collectives, each layer holds the *global* weight placed with a
+NamedSharding over the hybrid mesh's ``mp`` axis, and stamps sharding
+constraints on activations. XLA's SPMD partitioner then derives the exact
+Megatron collective pattern (identity/allreduce pairs, masked vocab
+lookup + psum, distributed softmax) — see paddle_tpu/parallel/tp_ops.py
+for the equivalent explicit shard_map form, tested to match.
+
+Initialization uses the *full* logical weight (same RNG stream as the
+single-device model), so mp-sharded training is bit-comparable to gold —
+this replaces the reference's per-rank RNG tracker init dance.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....core import dispatch
+from .....core.tensor import Tensor
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from .....parallel import mesh as mesh_mod
+
+
+def _mp_axis(mp_group):
+    if mp_group is not None and getattr(mp_group, "mesh_axis", None):
+        return mp_group.mesh_axis
+    return "mp"
+
+
+def _mp_degree(axis):
+    return mesh_mod.global_mesh_shape().get(axis, 1)
+
+
+def _wsc(x, *, spec, epoch):
+    mesh = mesh_mod.get_mesh()
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*spec))
+    )
+
+
+def shard_constraint(t, *spec):
+    """Tape-aware with_sharding_constraint on a Tensor (autograd flows:
+    the VJP of a sharding constraint is the same constraint on the
+    cotangent, which jax.vjp derives automatically)."""
+    return dispatch.apply(
+        "shard_constraint", _wsc, (t,),
+        {"spec": tuple(spec), "epoch": mesh_mod.mesh_epoch()},
+    )
+
+
+def _place(param, *spec):
+    """Shard a freshly initialized full parameter over the global mesh."""
+    if param is None:
+        return None
+    mesh = mesh_mod.get_mesh()
+    param.value = jax.device_put(
+        param.value, NamedSharding(mesh, P(*spec))
+    )
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over the mp axis.
+
+    Weight: [num_embeddings, embedding_dim] with NamedSharding P('mp', None)
+    — each mp rank stores vocab/mp rows. The lookup partitions to the
+    masked-local-gather + psum pattern.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self._world_size = _mp_degree(self._axis)
+        if num_embeddings % max(self._world_size, 1) != 0:
+            raise ValueError(
+                f"num_embeddings {num_embeddings} must divide mp degree "
+                f"{self._world_size}"
+            )
+        self.weight = _place(
+            self.create_parameter(
+                [num_embeddings, embedding_dim], attr=weight_attr,
+                default_initializer=I.XavierUniform(),
+            ),
+            self._axis, None,
+        )
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return shard_constraint(out, *([None] * (len(out.shape) - 1)))
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the output dim sharded over mp.
+
+    Weight: [in, out] P(None, 'mp'); bias: [out] P('mp'). Forward input is
+    (logically) replicated over mp; output stays out-sharded unless
+    ``gather_output``.
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self._world_size = _mp_degree(self._axis)
+        self.gather_output = gather_output
+        if out_features % max(self._world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} must divide mp degree "
+                f"{self._world_size}"
+            )
+        self.weight = _place(
+            self.create_parameter(
+                [in_features, out_features], attr=weight_attr,
+                default_initializer=I.XavierUniform(
+                    fan_in=in_features, fan_out=out_features
+                ),
+            ),
+            None, self._axis,
+        )
+        self.bias = None
+        if has_bias is None or has_bias:
+            self.bias = _place(
+                self.create_parameter(
+                    [out_features], is_bias=True,
+                ),
+                self._axis,
+            )
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        lead = [None] * (len(y.shape) - 1)
+        if self.gather_output:
+            return shard_constraint(y, *lead)
+        return shard_constraint(y, *lead, self._axis)
+
+
+class RowParallelLinear(Layer):
+    """Linear with the input dim sharded over mp.
+
+    Weight: [in, out] P('mp', None); bias [out] replicated (added after
+    the reduce). With ``input_is_parallel`` the incoming activation is
+    already sharded on its last dim (the ColumnParallel output); otherwise
+    XLA scatters it. Output is replicated over mp (partial sums reduced).
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self._world_size = _mp_degree(self._axis)
+        self.input_is_parallel = input_is_parallel
+        if in_features % max(self._world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} must divide mp degree "
+                f"{self._world_size}"
+            )
+        self.weight = _place(
+            self.create_parameter(
+                [in_features, out_features], attr=weight_attr,
+                default_initializer=I.XavierUniform(
+                    fan_in=in_features, fan_out=out_features
+                ),
+            ),
+            self._axis, None,
+        )
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_constraint(
+                x, *([None] * (len(x.shape) - 1)), self._axis
+            )
+        y = F.linear(x, self.weight)
+        y = shard_constraint(y, *([None] * (len(y.shape) - 1)))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross entropy over vocab-sharded logits.
+
+    The logits keep their P(..., 'mp') sharding through log-softmax; XLA
+    partitions the max/sum-exp reductions across the mp axis (the
+    distributed-softmax pattern of the reference's ParallelCrossEntropy);
+    paddle_tpu.parallel.tp_ops.vocab_parallel_cross_entropy is the
+    explicit equivalent.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = shard_constraint(
+            input, *([None] * (len(input.shape) - 1)), self._axis
+        )
+        return F.cross_entropy(
+            logits, label, reduction="none",
+            ignore_index=self.ignore_index,
+        )

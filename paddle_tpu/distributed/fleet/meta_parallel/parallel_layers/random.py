@@ -1,0 +1,102 @@
+"""RNGStatesTracker — per-parallel-axis RNG streams.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/random.py (unverified, mount empty): named RNG states so
+dropout inside TP regions is identical within an mp group and distinct
+across it (SURVEY.md §7 hard part #7).
+
+JAX redesign: streams are key *derivations*, not mutable cuRAND states.
+``rng_state(name)`` installs a key_scope whose base key folds together
+(ambient step key if inside a compiled step, the stream's seed, a
+per-entry counter). Multi-process ranks fold their mp rank into the seed
+at ``model_parallel_random_seed`` time; in single-process SPMD the mask
+is generated globally and sharded, which is the same distribution.
+"""
+from __future__ import annotations
+
+import contextlib
+import zlib
+
+import jax
+
+from .....core import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.seeds_ = set()
+        self.states_ = {}
+        self._entry_counts = {}
+
+    def reset(self):
+        self.seeds_ = set()
+        self.states_ = {}
+        self._entry_counts = {}
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = int(seed)
+        self._entry_counts[name] = 0
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        seed = self.states_[name]
+        tag = zlib.crc32(name.encode())
+        scope = random_mod._STATE.scope
+        if scope is not None:
+            # inside a compiled step: derive from the ambient step key so
+            # each step gets fresh masks without retracing
+            base = jax.random.fold_in(
+                jax.random.fold_in(scope[0], tag), seed
+            )
+        else:
+            n = self._entry_counts[name]
+            self._entry_counts[name] = n + 1
+            base = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(seed), tag), n
+            )
+        with random_mod.key_scope(base):
+            yield
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as py_random
+
+    if seed is None:
+        seed = py_random.randint(0, 2**31 - 1)
+    from ... import fleet as fleet_mod
+
+    mp_rank = 0
+    hcg = getattr(fleet_mod.fleet, "_hcg", None)
+    if hcg is not None:
+        mp_rank = hcg.get_model_parallel_rank()
+    global_seed = seed
+    local_seed = seed + 1024 + mp_rank
+    _TRACKER.reset()
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    random_mod.seed(global_seed)
+
+
+def determinate_seed(name):  # paddle-compat helper
+    return zlib.crc32(name.encode()) % (2**31)

@@ -56,6 +56,15 @@ _REDUCERS = {
     ReduceOp.MIN: jax.lax.pmin,
 }
 
+# host-side reducers over a stacked leading axis (one entry per rank)
+_JNP_REDUCERS = {
+    ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
+    ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
+    ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
+    ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
+    ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
+}
+
 
 class Task:
     def __init__(self, values):
@@ -81,47 +90,66 @@ def _spec_of(arr):
         return sh.spec
     return P()
 
+
+def _entry_names(entry):
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+def _spec_key(arr, ndim):
+    """Hashable full-spec tuple padded to the array's rank."""
+    spec = tuple(_spec_of(arr))
+    spec = spec + (None,) * (ndim - len(spec))
+    return tuple(
+        tuple(_entry_names(e)) if _entry_names(e) else None for e in spec
+    )
+
+
 def _axis_dim(arr, axis_name):
     """Which array dim is sharded over ``axis_name`` (None if replicated)."""
-    spec = _spec_of(arr)
-    for d, entry in enumerate(spec):
-        names = entry if isinstance(entry, tuple) else (entry,)
-        if axis_name in [n for n in names if n is not None]:
+    for d, entry in enumerate(_spec_of(arr)):
+        if axis_name in _entry_names(entry):
             return d
     return None
 
 
+def _drop_axis(spec_key, axis):
+    """The spec with ``axis`` removed (what the output keeps sharded)."""
+    out = []
+    for e in spec_key:
+        names = tuple(n for n in (e or ()) if n != axis)
+        out.append(names if names else None)
+    return tuple(out)
+
+
 @functools.lru_cache(maxsize=512)
-def _axis_exec(mesh_epoch_key, axis, kind, in_dim, op, nranks):
-    """Cached jitted shard_map executable for one (axis, collective) shape
-    family. ``in_dim`` = array dim sharded over ``axis`` on input (None =
-    replicated input)."""
+def _axis_exec(mesh_epoch_key, axis, kind, spec_key, op, nranks):
+    """Cached jitted shard_map executable for one (axis, collective, full
+    input spec) family. The input keeps its complete sharding — other mesh
+    axes stay sharded in the output; only ``axis`` is reduced/gathered."""
     from ..parallel.mesh import get_mesh
 
     mesh = get_mesh()
-
-    def in_spec(dim):
-        if dim is None:
-            return P()
-        s = [None] * (dim + 1)
-        s[dim] = axis
-        return P(*s)
+    in_s = P(*spec_key)
+    keep = P(*_drop_axis(spec_key, axis))
 
     if kind == "all_reduce":
         # per-rank shard -> reduced value replicated along axis
         fn = lambda x: _REDUCERS[op](x, axis)
-        in_s, out_s = in_spec(in_dim), P()
+        out_s = keep
     elif kind == "all_gather":
-        # per-rank shard -> [nranks, shard...] stack, replicated
+        # per-rank shard -> [nranks, shard...] stack, replicated over axis
         fn = lambda x: jax.lax.all_gather(x, axis, axis=0, tiled=False)
-        in_s, out_s = in_spec(in_dim), P()
+        out_s = P(*((None,) + tuple(_drop_axis(spec_key, axis))))
     elif kind == "broadcast":
         def fn(x, src_idx):
             idx = jax.lax.axis_index(axis)
             masked = jnp.where(idx == src_idx, x, jnp.zeros_like(x))
             return jax.lax.psum(masked, axis)
 
-        in_s, out_s = (in_spec(in_dim), P()), P()
+        in_s = (in_s, P())
+        out_s = keep
     else:  # pragma: no cover
         raise ValueError(kind)
 
@@ -149,9 +177,15 @@ class ProcessGroup:
         self.mesh_axis = mesh_axis
         me = dist_env.get_rank()
         self.rank = self.ranks.index(me) if me in self.ranks else -1
-        if mesh_axis is not None and self.rank < 0:
+        if (
+            mesh_axis is not None
+            and self.rank < 0
+            and dist_env.get_world_size() == 1
+        ):
             # virtual chip-rank groups in single-process SPMD: this process
-            # drives rank 0 of every axis group it constructs
+            # drives rank 0 of every axis group it constructs. (In a
+            # multi-process world a non-member must stay rank -1 so the
+            # only-members-call guard still fires.)
             self.rank = 0
         # pending eager p2p messages (single-process PP parity path)
         self._p2p_box = {}
@@ -178,14 +212,15 @@ class ProcessGroup:
         from ..parallel.mesh import mesh_epoch
 
         axis = self.mesh_axis
-        dim = _axis_dim(arr, axis)
+        spec_key = _spec_key(arr, arr.ndim)
         if kind == "all_reduce" and op not in _REDUCERS:
             # no lax prod collective: gather then reduce locally
             stacked = _axis_exec(
-                mesh_epoch(), axis, "all_gather", dim, "sum", self.nranks
+                mesh_epoch(), axis, "all_gather", spec_key, "sum",
+                self.nranks,
             )(arr)
             return jnp.prod(stacked, axis=0)
-        f = _axis_exec(mesh_epoch(), axis, kind, dim, op, self.nranks)
+        f = _axis_exec(mesh_epoch(), axis, kind, spec_key, op, self.nranks)
         if extra is not None:
             return f(arr, extra)
         return f(arr)
@@ -231,14 +266,7 @@ class ProcessGroup:
         """Strict-subgroup reduce = member-mesh gather + local reduce
         (uniform support for every ReduceOp, including PROD)."""
         gathered = self._subgroup_gather(local_value)
-        red = {
-            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
-            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
-            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
-            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
-            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
-        }[op]
-        return jnp.asarray(red(jnp.asarray(gathered)))
+        return jnp.asarray(_JNP_REDUCERS[op](jnp.asarray(gathered)))
 
     def _cross_process(self, local_value, reducer, op=ReduceOp.SUM):
         """Reduce per-process values; returns this rank's result."""
@@ -286,14 +314,7 @@ class ProcessGroup:
                 }[op]()
             tensor.value = out
             return Task([out])
-        red = {
-            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
-            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
-            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
-            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
-            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
-        }[op]
-        out = self._cross_process(tensor.value, red, op)
+        out = self._cross_process(tensor.value, _JNP_REDUCERS[op], op)
         tensor.value = out
         return Task([out])
 
@@ -377,14 +398,7 @@ class ProcessGroup:
             tensor.value = red[self.rank]
             return Task([tensor.value])
         stacked = jnp.stack([t.value for t in tensor_list])
-        reducer = {
-            ReduceOp.SUM: lambda s: jnp.sum(s, axis=0),
-            ReduceOp.AVG: lambda s: jnp.mean(s, axis=0),
-            ReduceOp.MAX: lambda s: jnp.max(s, axis=0),
-            ReduceOp.MIN: lambda s: jnp.min(s, axis=0),
-            ReduceOp.PROD: lambda s: jnp.prod(s, axis=0),
-        }[op]
-        red = self._cross_process(stacked, reducer, op)
+        red = self._cross_process(stacked, _JNP_REDUCERS[op], op)
         tensor.value = red[self.rank]
         return Task([tensor.value])
 

@@ -110,6 +110,24 @@ class TestSpmdAxisGroups:
         # rank 0 view: sum over 4 identical replicas of chunk 0 = 0*4
         np.testing.assert_allclose(np.asarray(out.numpy()), 0.0)
 
+    def test_tuple_axis_sharding_preserved(self, hcg):
+        # dim 0 sharded over BOTH dp and mp: the mp reduction must stay
+        # within each dp row and keep the dp sharding (regression: the
+        # spec used to be rebuilt with only the group axis, silently
+        # resharding dp-major -> mp-major)
+        mpg = hcg.get_model_parallel_group()
+        x = jax.device_put(
+            jnp.arange(8.0), NamedSharding(hcg.mesh, P(("dp", "mp")))
+        )
+        t = Tensor(x)
+        mpg.all_reduce(t)
+        out = np.asarray(t.numpy())
+        # dp row 0 shards [0],[1],[2],[3] -> 6; dp row 1 -> 22
+        assert out.shape == (2,)
+        np.testing.assert_allclose(out, [6.0, 22.0])
+        spec = t.value.sharding.spec
+        assert tuple(spec)[0] in ("dp", ("dp",))
+
     def test_p2p_mailbox(self):
         g = ProcessGroup([0, 1], pg_id=91, mesh_axis="pp")
         g.send(Tensor(jnp.ones((2,)) * 3), dst=1)
@@ -169,7 +187,16 @@ _WORKER = textwrap.dedent(
 )
 
 
-def _spawn_procs(n, port):
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_procs(n, port=None):
+    port = port or _free_port()
     script = _WORKER.replace("__REPO__", REPO)
     path = os.path.join("/tmp", f"pg_mp_worker_{port}.py")
     with open(path, "w") as f:
@@ -201,7 +228,7 @@ def _spawn_procs(n, port):
 
 class TestMultiProcess:
     def test_two_process_world_collectives(self):
-        _spawn_procs(2, 13011)
+        _spawn_procs(2)
 
     def test_four_process_strict_subgroup(self):
-        _spawn_procs(4, 13013)
+        _spawn_procs(4)
