@@ -109,6 +109,9 @@ def _engine(root_pairs, retain_graph, accumulate_fn):
         in_cts = node.vjp_fn(out_ct)
         if not isinstance(in_cts, (tuple, list)):
             in_cts = (in_cts,)
+        from ..core.dispatch import check_nan_inf
+
+        check_nan_inf(f"{node.name}_grad", in_cts)
         if len(in_cts) != len(node.inputs):
             raise RuntimeError(
                 f"GradNode<{node.name}> returned {len(in_cts)} grads for "

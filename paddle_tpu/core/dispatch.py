@@ -40,6 +40,39 @@ def set_amp_hook(fn):
     _AMP_HOOK[0] = fn
 
 
+def _nan_report(op_name, ok):
+    if not bool(ok):
+        raise RuntimeError(
+            f"FLAGS_check_nan_inf: operator [{op_name}] output contains "
+            "NaN or Inf"
+        )
+
+
+def check_nan_inf(op_name, vals):
+    """FLAGS_check_nan_inf sweep (reference:
+    paddle/fluid/framework/details/nan_inf_utils_detail.* — unverified).
+
+    Eager arrays: hard raise naming the op. Traced values: a
+    jax.debug.callback carries the finiteness bit to the host, which
+    raises when the compiled step executes (surfaces as an
+    XlaRuntimeError wrapping this message)."""
+    from ..utils import flags as flags_mod
+
+    if not flags_mod.flag("FLAGS_check_nan_inf"):
+        return
+    for v in vals:
+        dt = getattr(v, "dtype", None)
+        if dt is None or dt == jax.dtypes.float0:
+            continue
+        if not jnp.issubdtype(dt, jnp.floating):
+            continue
+        ok = jnp.all(jnp.isfinite(v))
+        if isinstance(ok, jax.core.Tracer):
+            jax.debug.callback(_nan_report, op_name, ok)
+        elif not bool(ok):
+            _nan_report(op_name, False)
+
+
 def _freeze(v):
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
@@ -103,6 +136,7 @@ def apply(name, fn, args, kw=None, cache=True, nondiff=False):
             out = fn(*vals, **kw)
         else:
             out = _jitted(fn, kw)(*vals)
+        check_nan_inf(name, out if isinstance(out, (tuple, list)) else (out,))
         return _wrap_outputs(out, stop_gradient=True)
 
     # --- autograd path: vjp over the differentiable tensor args only
@@ -120,6 +154,7 @@ def apply(name, fn, args, kw=None, cache=True, nondiff=False):
 
     is_multi = isinstance(out, (tuple, list))
     outs = tuple(out) if is_multi else (out,)
+    check_nan_inf(name, outs)
     out_meta = [(o.shape, o.dtype) for o in outs]
 
     node = tape_mod.GradNode(name, vjp_fn, diff_tensors, out_meta, multi=is_multi)

@@ -83,8 +83,8 @@ class VocabParallelEmbedding(Layer):
         self._world_size = _mp_degree(self._axis)
         if num_embeddings % max(self._world_size, 1) != 0:
             raise ValueError(
-                f"num_embeddings {num_embeddings} must divide mp degree "
-                f"{self._world_size}"
+                f"num_embeddings {num_embeddings} must be divisible by the "
+                f"mp degree {self._world_size}"
             )
         self.weight = _place(
             self.create_parameter(
@@ -116,8 +116,8 @@ class ColumnParallelLinear(Layer):
         self.gather_output = gather_output
         if out_features % max(self._world_size, 1) != 0:
             raise ValueError(
-                f"out_features {out_features} must divide mp degree "
-                f"{self._world_size}"
+                f"out_features {out_features} must be divisible by the "
+                f"mp degree {self._world_size}"
             )
         self.weight = _place(
             self.create_parameter(
@@ -163,8 +163,8 @@ class RowParallelLinear(Layer):
         self.input_is_parallel = input_is_parallel
         if in_features % max(self._world_size, 1) != 0:
             raise ValueError(
-                f"in_features {in_features} must divide mp degree "
-                f"{self._world_size}"
+                f"in_features {in_features} must be divisible by the "
+                f"mp degree {self._world_size}"
             )
         self.weight = _place(
             self.create_parameter(

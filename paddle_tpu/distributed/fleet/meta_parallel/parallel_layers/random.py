@@ -40,6 +40,10 @@ class RNGStatesTracker:
 
     def set_states_tracker(self, states):
         self.states_ = dict(states)
+        self.seeds_ = set(self.states_.values())
+        self._entry_counts = {
+            k: self._entry_counts.get(k, 0) for k in self.states_
+        }
 
     def add(self, name, seed):
         if seed in self.seeds_:
@@ -56,16 +60,19 @@ class RNGStatesTracker:
             raise ValueError(f"state {name} does not exist")
         seed = self.states_[name]
         tag = zlib.crc32(name.encode())
+        # per-entry counter: distinct call sites (traced once each) and
+        # distinct eager entries get distinct streams
+        n = self._entry_counts[name]
+        self._entry_counts[name] = n + 1
         scope = random_mod._STATE.scope
         if scope is not None:
             # inside a compiled step: derive from the ambient step key so
             # each step gets fresh masks without retracing
             base = jax.random.fold_in(
-                jax.random.fold_in(scope[0], tag), seed
+                jax.random.fold_in(jax.random.fold_in(scope[0], tag), seed),
+                n,
             )
         else:
-            n = self._entry_counts[name]
-            self._entry_counts[name] = n + 1
             base = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(seed), tag), n
             )

@@ -1,0 +1,2 @@
+"""Recompute package (reference: …/fleet/recompute/)."""
+from .recompute import recompute, recompute_sequential  # noqa: F401

@@ -61,12 +61,24 @@ def recompute(function, *args, **kwargs):
             if rng_key is not None
             else contextlib.nullcontext()
         )
-        with tape.trace_scope(), tape.no_grad(), km:
+        # snapshot so the layer's concrete values are restored after the
+        # traced run — pure() executes under jax.vjp/checkpoint traces,
+        # and leaving tracers in parameters would poison every later use
+        # of the layer. (Consequence: buffer updates, e.g. BatchNorm
+        # running stats, are dropped inside recomputed blocks.)
+        if isinstance(function, Layer):
+            orig_p = {k: p.value for k, p in params}
+            orig_b = {k: b.value for k, b in function.named_buffers()}
+        try:
+            with tape.trace_scope(), tape.no_grad(), km:
+                if isinstance(function, Layer):
+                    function.load_functional_state(
+                        dict(zip((k for k, _ in params), pvals)), buffers
+                    )
+                out = function(*call_args)
+        finally:
             if isinstance(function, Layer):
-                function.load_functional_state(
-                    dict(zip((k for k, _ in params), pvals)), buffers
-                )
-            out = function(*call_args)
+                function.load_functional_state(orig_p, orig_b)
         if isinstance(out, (list, tuple)):
             return tuple(
                 o.value if isinstance(o, Tensor) else o for o in out

@@ -1,0 +1,6 @@
+"""fleet.utils parity surface (reference: …/fleet/utils/__init__.py).
+
+``paddle.distributed.fleet.utils.recompute`` is the documented public
+path for activation recomputation.
+"""
+from ..recompute.recompute import recompute, recompute_sequential  # noqa: F401

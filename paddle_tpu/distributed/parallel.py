@@ -132,6 +132,10 @@ class DataParallel(Layer):
             p for p in self._layers.parameters() if not p.stop_gradient
         ]
 
+    # fused-buffer cap per collective, mirroring the reference reducer's
+    # comm_buffer_size_MB default (reducer.cc — unverified, mount empty)
+    _COMM_BUCKET_BYTES = 25 * 1024 * 1024
+
     def sync_gradients(self):
         if dist_env.get_world_size() <= 1:
             return
@@ -139,7 +143,25 @@ class DataParallel(Layer):
         params = [p for p in self._dp_params if p.grad is not None]
         if not params:
             return
-        # single fused buffer: flatten -> one allreduce(avg) -> unflatten
+        # bucket by dtype (no silent promotion on concat; grads come back
+        # in their own dtype) and by size (bounds peak fused-buffer memory)
+        buckets: dict = {}
+        for p in params:
+            buckets.setdefault(str(p.grad.value.dtype), []).append(p)
+        for _, plist in buckets.items():
+            chunk, chunk_bytes = [], 0
+            for p in plist:
+                nbytes = p.grad.size * p.grad.value.dtype.itemsize
+                if chunk and chunk_bytes + nbytes > self._COMM_BUCKET_BYTES:
+                    self._reduce_bucket(group, chunk)
+                    chunk, chunk_bytes = [], 0
+                chunk.append(p)
+                chunk_bytes += nbytes
+            if chunk:
+                self._reduce_bucket(group, chunk)
+
+    @staticmethod
+    def _reduce_bucket(group, params):
         flat = jnp.concatenate([p.grad.value.reshape(-1) for p in params])
         t = Tensor(flat)
         group.all_reduce(t, op="mean")

@@ -1,10 +1,11 @@
 """paddle.save / paddle.load.
 
 Reference parity: python/paddle/framework/io.py (unverified, mount empty).
-Format: pickle with Tensors converted to numpy arrays tagged so load can
-rebuild Tensors — interchange-compatible with state dicts of numpy arrays
-(and therefore loadable by/loadable-from the reference's unpickled state
-dicts for parity testing).
+Format: pickle containing ONLY stdlib + numpy types — every Tensor is
+converted to a plain np.ndarray on save, so files are unpicklable by any
+framework (including the reference, whose state-dict pickles are likewise
+numpy-valued) without importing paddle_tpu. Load wraps ndarrays back into
+Tensors unless ``return_numpy``.
 """
 from __future__ import annotations
 
@@ -17,7 +18,7 @@ from ..core.tensor import Parameter, Tensor
 
 
 class _TensorPayload:
-    """Pickle-stable tag for tensors (stores numpy + metadata)."""
+    """Legacy tag retained so pickles written by earlier versions load."""
 
     def __init__(self, array, stop_gradient=True, is_parameter=False, name=None):
         self.array = array
@@ -27,31 +28,25 @@ class _TensorPayload:
 
 
 def _pack(obj):
-    if isinstance(obj, Parameter):
-        return _TensorPayload(obj.numpy(), obj.stop_gradient, True, obj.name)
-    if isinstance(obj, Tensor):
-        return _TensorPayload(obj.numpy(), obj.stop_gradient, False, obj.name)
+    if isinstance(obj, Tensor):  # Parameter is a Tensor subclass
+        return np.asarray(obj.numpy())
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         packed = [_pack(v) for v in obj]
-        return type(obj)(packed) if not isinstance(obj, tuple) else tuple(packed)
+        return packed if isinstance(obj, list) else tuple(packed)
     return obj
 
 
 def _unpack(obj, return_numpy=False):
-    if isinstance(obj, _TensorPayload):
+    if isinstance(obj, _TensorPayload):  # legacy files
+        obj = obj.array
+    if isinstance(obj, np.ndarray):
         if return_numpy:
-            return obj.array
+            return obj
         import jax.numpy as jnp
 
-        if obj.is_parameter:
-            t = Parameter(jnp.asarray(obj.array), name=obj.name)
-            t.stop_gradient = obj.stop_gradient
-            return t
-        t = Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
-                   name=obj.name)
-        return t
+        return Tensor(jnp.asarray(obj))
     if isinstance(obj, dict):
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, list):

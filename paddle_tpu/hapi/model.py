@@ -50,6 +50,8 @@ class Model:
         self._jit_step = None
         self._jit_enabled = False
         self._accumulating = False
+        self._accumulate_grad_batches = 1
+        self._pending_accum = False
         self._inputs_spec = _to_list(inputs) if inputs is not None else None
         self._labels_spec = _to_list(labels) if labels is not None else None
 
@@ -102,10 +104,17 @@ class Model:
                        level=self._amp_level or "O1"):
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels)
-        loss.backward()
+        if self._accumulating:
+            # average (not sum) over the accumulation window
+            (loss / float(self._accumulate_grad_batches)).backward()
+        else:
+            loss.backward()
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
+            self._pending_accum = False
+        elif self._accumulating:
+            self._pending_accum = True
         metrics = []
         for m in self._metrics:
             m_in = m.compute(*(_to_list(outputs) + labels))
@@ -190,6 +199,8 @@ class Model:
         )
         self.stop_training = False
         self._accumulating = accumulate_grad_batches > 1
+        self._accumulate_grad_batches = max(1, accumulate_grad_batches)
+        self._pending_accum = False
         cbks.on_train_begin()
         it = 0
         for epoch in range(epochs):
@@ -210,12 +221,22 @@ class Model:
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                     break
+            if self._pending_accum:
+                # flush a trailing partial accumulation window so its
+                # grads don't leak into the next epoch's first update
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                self._pending_accum = False
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)
             if self.stop_training:
                 break
         cbks.on_train_end(logs)
+        # accumulation is a per-fit setting; a later direct train_batch()
+        # must not inherit the 1/N loss scaling
+        self._accumulating = False
+        self._accumulate_grad_batches = 1
         return self
 
     def _metrics_name(self):

@@ -100,11 +100,33 @@ class CompiledTrainStep:
         clip = opt._grad_clip
         from ..optimizer.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 
-        wd_coeffs = {}
+        # id -> structured name, built once (7B-scale param trees: O(n))
+        name_of = {id(p): k for k, p in network.named_parameters()}
+        wd_coeffs, lr_mults = {}, {}
+        decay_fun = getattr(opt, "_apply_decay_fun", None)
         for group, p in opt._all_params():
-            name = next(k for k, q in network.named_parameters() if q is p)
-            coeff, l1 = opt._decay_value(group, p)
-            wd_coeffs[name] = 0.0 if l1 == "l1" else float(coeff)
+            name = name_of[id(p)]
+            if decay_fun is not None and not decay_fun(p.name or ""):
+                # eager AdamW parity: the exclusion only suppresses the
+                # optimizer-level weight_decay; a per-param regularizer or
+                # group-level weight_decay still applies
+                wd_backup = opt._weight_decay
+                opt._weight_decay = 0.0
+                try:
+                    coeff, l1 = opt._decay_value(group, p)
+                finally:
+                    opt._weight_decay = wd_backup
+            else:
+                coeff, l1 = opt._decay_value(group, p)
+            if l1 == "l1":
+                raise NotImplementedError(
+                    "CompiledTrainStep does not support L1Decay "
+                    f"(parameter {name!r}); use the eager optimizer path"
+                )
+            wd_coeffs[name] = float(coeff)
+            lr_mults[name] = float(
+                group.get("learning_rate", 1.0)
+            ) * float(p.optimize_attr.get("learning_rate", 1.0))
 
         hyper = {}
         if kind in (opt_mod.Adam, opt_mod.AdamW, opt_mod.Lamb):
@@ -163,17 +185,18 @@ class CompiledTrainStep:
             for k in params:
                 p, g = params[k], grads[k]
                 wd = wd_coeffs.get(k, 0.0)
+                plr = lr * lr_mults.get(k, 1.0)
                 if kind is opt_mod.SGD:
                     if wd:
                         g = g + wd * p
-                    new_params[k] = opt_mod._sgd_update.__wrapped__(p, g, lr)
+                    new_params[k] = opt_mod._sgd_update.__wrapped__(p, g, plr)
                     new_state[k] = ()
                 elif kind is opt_mod.Momentum:
                     if wd:
                         g = g + wd * p
                     (vel,) = opt_state[k]
                     np_, v2 = opt_mod._momentum_update.__wrapped__(
-                        p, vel, g, lr, hyper["mu"], hyper["nesterov"]
+                        p, vel, g, plr, hyper["mu"], hyper["nesterov"]
                     )
                     new_params[k] = np_
                     new_state[k] = (v2,)
@@ -181,7 +204,7 @@ class CompiledTrainStep:
                     m, v = opt_state[k]
                     decoupled = kind is opt_mod.AdamW
                     np_, m2, v2 = opt_mod._adam_update.__wrapped__(
-                        p, m, v, g, lr, hyper["beta1"], hyper["beta2"],
+                        p, m, v, g, plr, hyper["beta1"], hyper["beta2"],
                         hyper["eps"], t, wd, decoupled,
                     )
                     new_params[k] = np_
@@ -189,7 +212,7 @@ class CompiledTrainStep:
                 else:  # Lamb
                     m, v = opt_state[k]
                     np_, m2, v2 = opt_mod._lamb_update.__wrapped__(
-                        p, m, v, g, lr, hyper["beta1"], hyper["beta2"],
+                        p, m, v, g, plr, hyper["beta1"], hyper["beta2"],
                         hyper["eps"], t, opt._lamb_wd,
                     )
                     new_params[k] = np_

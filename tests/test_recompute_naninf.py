@@ -1,0 +1,233 @@
+"""Recompute (activation checkpointing) + FLAGS_check_nan_inf.
+
+Reference parity: python/paddle/distributed/fleet/recompute/recompute.py
+and the nan_inf_utils_detail sweep behind FLAGS_check_nan_inf (unverified,
+mount empty). VERDICT r1 items #9 (recompute absent, flag decorative).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.recompute import (
+    recompute,
+    recompute_sequential,
+)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 8)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+def _grads(net, use_recompute, x_np):
+    paddle.seed(0)
+    x = Tensor(jnp.asarray(x_np), stop_gradient=False)
+    h = recompute(net, x) if use_recompute else net(x)
+    loss = (h * h).mean()
+    loss.backward()
+    out = {k: np.asarray(p.grad.numpy()) for k, p in net.named_parameters()}
+    out["__x__"] = np.asarray(x.grad.numpy())
+    out["__loss__"] = float(loss.numpy())
+    net.clear_gradients()
+    return out
+
+
+class TestRecompute:
+    def test_eager_parity(self):
+        paddle.seed(7)
+        net = Block()
+        x_np = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        gold = _grads(net, False, x_np)
+        rc = _grads(net, True, x_np)
+        for k in gold:
+            np.testing.assert_allclose(
+                rc[k], gold[k], rtol=1e-5, atol=1e-6, err_msg=str(k)
+            )
+
+    def test_compiled_step_parity(self):
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+
+        x_np = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        y_np = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+
+        losses = {}
+        for use_rc in (False, True):
+            paddle.seed(3)
+            net = Block()
+
+            class Wrapper(nn.Layer):
+                def __init__(self, inner):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, x):
+                    if use_rc:
+                        return recompute(self.inner, x)
+                    return self.inner(x)
+
+            w = Wrapper(net)
+            opt = paddle.optimizer.AdamW(1e-2, parameters=w.parameters())
+            step = CompiledTrainStep(w, nn.MSELoss(), opt)
+            ls = []
+            for _ in range(3):
+                loss, _ = step(
+                    [Tensor(jnp.asarray(x_np))], [Tensor(jnp.asarray(y_np))]
+                )
+                ls.append(float(loss.numpy()))
+            losses[use_rc] = ls
+        np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+    def test_sequential_segments(self):
+        paddle.seed(11)
+        net = nn.Sequential(
+            nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 16), nn.GELU(),
+            nn.Linear(16, 8),
+        )
+        x_np = np.random.RandomState(3).randn(2, 8).astype(np.float32)
+
+        x = Tensor(jnp.asarray(x_np), stop_gradient=False)
+        gold = net(x)
+        gl = (gold * gold).mean()
+        gl.backward()
+        gold_grad = np.asarray(net[0].weight.grad.numpy())
+        net.clear_gradients()
+
+        x2 = Tensor(jnp.asarray(x_np), stop_gradient=False)
+        out = recompute_sequential({"segments": 2}, net, x2)
+        l2 = (out * out).mean()
+        l2.backward()
+        np.testing.assert_allclose(
+            float(l2.numpy()), float(gl.numpy()), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(net[0].weight.grad.numpy()), gold_grad,
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_dropout_rng_preserved(self):
+        # with preserve_rng_state the rematerialized forward must see the
+        # same dropout mask: grads of x through dropout match the mask
+        # applied in forward
+        paddle.seed(5)
+
+        class Drop(nn.Layer):
+            def forward(self, x):
+                return F.dropout(x, p=0.5, training=True)
+
+        d = Drop()
+        x = Tensor(jnp.ones((1000,)), stop_gradient=False)
+        out = recompute(d, x)
+        kept_fwd = np.asarray(out.numpy()) > 0
+        out.sum().backward()
+        kept_bwd = np.asarray(x.grad.numpy()) > 0
+        np.testing.assert_array_equal(kept_fwd, kept_bwd)
+
+
+class TestCheckNanInf:
+    def setup_method(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+
+    def teardown_method(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_eager_forward_trips(self):
+        t = Tensor(jnp.asarray([1.0, -1.0]))
+        with pytest.raises(RuntimeError, match="NaN or Inf"):
+            t.log()
+
+    def test_eager_backward_trips(self):
+        x = Tensor(jnp.asarray([0.0, 4.0]), stop_gradient=False)
+        y = x.sqrt().sum()
+        with pytest.raises(RuntimeError, match="_grad"):
+            y.backward()
+
+    def test_disabled_no_trip(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        t = Tensor(jnp.asarray([-1.0]))
+        out = t.log()
+        assert not np.isfinite(np.asarray(out.numpy())[0])
+
+    def test_compiled_step_trips(self):
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+
+        paddle.seed(0)
+        net = Block()
+        opt = paddle.optimizer.SGD(1e-2, parameters=net.parameters())
+        step = CompiledTrainStep(net, nn.MSELoss(), opt)
+        bad = np.full((2, 8), np.nan, np.float32)
+        with pytest.raises(Exception, match="NaN or Inf"):
+            loss, _ = step(
+                [Tensor(jnp.asarray(bad))],
+                [Tensor(jnp.zeros((2, 8), jnp.float32))],
+            )
+            loss.numpy().block_until_ready()
+
+    def test_flag_roundtrip(self):
+        assert paddle.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"
+        ] is True
+
+
+class TestRecomputeLayerHygiene:
+    def test_layer_reusable_after_recompute(self):
+        """Regression: recompute used to leave tracers in layer params."""
+        paddle.seed(0)
+        net = Block()
+        x = Tensor(jnp.ones((2, 8)), stop_gradient=False)
+        out = recompute(net, x)
+        out.mean().backward()
+        # params are still concrete and the layer still works eagerly
+        w = np.asarray(net.fc1.weight.numpy())
+        assert np.isfinite(w).all()
+        y = net(Tensor(jnp.ones((2, 8))))
+        assert np.isfinite(np.asarray(y.numpy())).all()
+        # second recompute step also works
+        net.clear_gradients()
+        out2 = recompute(net, Tensor(jnp.ones((2, 8)), stop_gradient=False))
+        out2.mean().backward()
+        assert net.fc1.weight.grad is not None
+
+
+class TestTrackerStreams:
+    def test_distinct_masks_inside_key_scope(self):
+        """Regression: traced-branch rng_state entries shared one key."""
+        from paddle_tpu.core import random as random_mod
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            RNGStatesTracker,
+        )
+
+        tr = RNGStatesTracker()
+        tr.add("model_parallel_rng", 9)
+        ks = []
+        with random_mod.key_scope(jax.random.key(0)):
+            for _ in range(2):
+                with tr.rng_state("model_parallel_rng"):
+                    ks.append(np.asarray(jax.random.key_data(
+                        random_mod.next_key()
+                    )))
+        assert not np.array_equal(ks[0], ks[1])
+
+    def test_set_states_tracker_restores_eager_path(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            RNGStatesTracker,
+        )
+
+        tr = RNGStatesTracker()
+        tr.add("model_parallel_rng", 9)
+        tr2 = RNGStatesTracker()
+        tr2.set_states_tracker(tr.get_states_tracker())
+        with tr2.rng_state("model_parallel_rng"):
+            pass  # must not KeyError
+        with pytest.raises(ValueError):
+            tr2.add("other", 9)  # seed collision still detected
