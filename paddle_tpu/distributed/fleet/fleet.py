@@ -123,12 +123,35 @@ class Fleet:
         self._last_model = wrapped
         return wrapped
 
+    def _install_sharding_placements(self, optimizer, model):
+        """DygraphShardingOptimizer semantics (ZeRO-1 over the sharding
+        axis): optimizer state placed sharded."""
+        from ..sharding.group_sharded import install_stage1_placements
+
+        install_stage1_placements(
+            optimizer, model.named_parameters(),
+            axis=self._hcg.sharding_axis(), mesh=self._hcg.mesh,
+        )
+
     def distributed_optimizer(self, optimizer, strategy=None):
         assert self._initialized, "call fleet.init first"
-        return HybridParallelOptimizer(
+        if (
+            self._hcg is not None
+            and self._hcg.get_sharding_parallel_world_size() > 1
+        ):
+            if self._last_model is not None:
+                self._install_sharding_placements(optimizer, self._last_model)
+            else:
+                # reference ordering allows distributed_optimizer before
+                # distributed_model; finish the install when the model
+                # arrives
+                self._pending_sharding_opts.append(optimizer)
+        wrapped = HybridParallelOptimizer(
             optimizer, self._hcg, strategy or self._strategy,
             model=self._last_model,
         )
+        self._pending_opt_wrappers.append(wrapped)
+        return wrapped
 
     # ------------------------------------------------------------- save/load
     def save_persistables(self, executor=None, dirname=None, main_program=None):

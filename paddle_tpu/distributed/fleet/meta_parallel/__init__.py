@@ -6,11 +6,34 @@ layers; PP arrives as PipelineLayer + schedules.
 """
 from .parallel_layers import (  # noqa: F401
     ColumnParallelLinear,
+    LayerDesc,
     ParallelCrossEntropy,
+    PipelineLayer,
     RNGStatesTracker,
     RowParallelLinear,
+    SharedLayerDesc,
     VocabParallelEmbedding,
     get_rng_state_tracker,
     model_parallel_random_seed,
     shard_constraint,
 )
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+
+
+def wrap_hybrid_model(model, hcg, strategy=None):
+    """fleet.distributed_model for hybrid topologies.
+
+    TP layers already carry their mp shardings; PP models (PipelineLayer)
+    get the pipeline engine; everything else gets DP gradient sync over
+    the dp axis when dp_degree > 1 (XLA handles the rest of the axes
+    inside the compiled step).
+    """
+    from .pipeline_parallel import PipelineLayer, PipelineParallel
+
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        from ...parallel import DataParallel
+
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    return model

@@ -6,6 +6,11 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
     shard_constraint,
 )
+from .pp_layers import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    SharedLayerDesc,
+)
 from .random import (  # noqa: F401
     RNGStatesTracker,
     get_rng_state_tracker,

@@ -1,0 +1,193 @@
+"""PipelineLayer — stage segmentation of a layer stack.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py (unverified, mount empty): LayerDesc /
+SharedLayerDesc descriptions, segmentation by uniform count or by layer
+class ("layer:ClassName"), recompute_interval, shared-weight stages.
+
+TPU redesign: in single-process SPMD every stage is built in this
+process; the stage structure drives (a) the 1F1B microbatch schedule in
+PipelineParallel and (b) the stacked-stage shard_map pipeline in
+paddle_tpu.parallel.pipeline for the compiled path. On multi-process
+meshes each process still owns all stage definitions (weights are sharded
+arrays), matching the SPMD execution model.
+"""
+from __future__ import annotations
+
+import re
+
+from .....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not (isinstance(layer_func, type) and issubclass(layer_func, Layer)) \
+                and not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer subclass or callable")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        name = getattr(self.layer_func, "__name__", str(self.layer_func))
+        return f"LayerDesc({name})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose weights are shared between stages (e.g. embedding and
+    output head). All occurrences with the same ``key`` resolve to ONE
+    built layer instance, so sharing is by construction (no grad-sync
+    dance needed: the tape accumulates both paths into the same params)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        if num_virtual_pipeline_stages not in (None, 1):
+            raise NotImplementedError(
+                "interleaved virtual pipeline stages: use the compiled "
+                "stacked-stage pipeline (paddle_tpu.parallel.pipeline)"
+            )
+        self._descs = list(layers)
+        self._topology = topology
+        if num_stages is None:
+            if topology is None:
+                raise ValueError("need num_stages or topology")
+            num_stages = topology.get_dim("pp")
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._recompute_interval = int(recompute_interval)
+        self.seg_method = seg_method
+
+        # build layers (shared descs dedupe by key)
+        shared = {}
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in shared:
+                    shared[d.layer_name] = d.build_layer()
+                built.append((d, shared[d.layer_name]))
+            elif isinstance(d, LayerDesc):
+                built.append((d, d.build_layer()))
+            elif isinstance(d, Layer):
+                built.append((None, d))
+            elif callable(d):
+                built.append((None, d))
+            else:
+                raise TypeError(f"cannot build pipeline item {d!r}")
+        self._items = built
+
+        # register parameters (each built layer once)
+        seen = set()
+        for i, (_, l) in enumerate(built):
+            if isinstance(l, Layer) and id(l) not in seen:
+                seen.add(id(l))
+                self.add_sublayer(str(i), l)
+
+        self._stage_bounds = self._segment()
+
+    # -------------------------------------------------------- segmentation
+    def _segment(self):
+        n = len(self._items)
+        s = self._num_stages
+        if n < s:
+            raise ValueError(f"{n} layers cannot fill {s} stages")
+        if self.seg_method.startswith("layer:"):
+            cls_name = self.seg_method.split(":", 1)[1]
+            marks = [
+                i for i, (_, l) in enumerate(self._items)
+                if type(l).__name__ == cls_name
+            ]
+            if len(marks) < s:
+                raise ValueError(
+                    f"seg_method {self.seg_method!r}: only {len(marks)} "
+                    f"{cls_name} layers for {s} stages"
+                )
+            # distribute marked layers evenly; each later stage starts at
+            # a marked layer, stage 0 absorbs any unmarked prefix
+            per, rem = divmod(len(marks), s)
+            bounds = [0]
+            cum = 0
+            for st in range(s - 1):
+                cum += per + (1 if st < rem else 0)
+                bounds.append(marks[cum])
+            bounds.append(n)
+            return bounds
+        # uniform by count
+        per = n // s
+        rem = n % s
+        bounds = [0]
+        for st in range(s):
+            bounds.append(bounds[-1] + per + (1 if st < rem else 0))
+        return bounds
+
+    # ----------------------------------------------------------- execution
+    @property
+    def num_stages(self):
+        return self._num_stages
+
+    def get_num_virtual_stages(self):
+        return 1
+
+    def stage_items(self, stage):
+        lo, hi = self._stage_bounds[stage], self._stage_bounds[stage + 1]
+        return [l for _, l in self._items[lo:hi]]
+
+    def _run_item(self, desc_layer, x):
+        d, l = desc_layer
+        if isinstance(d, SharedLayerDesc) and d.forward_func is not None:
+            return d.forward_func(l, *(x if isinstance(x, tuple) else (x,)))
+        if isinstance(x, tuple):
+            return l(*x)
+        return l(x)
+
+    def run_stage(self, x, stage, training=True):
+        """Run one stage's chunk (optionally recomputed)."""
+        lo, hi = self._stage_bounds[stage], self._stage_bounds[stage + 1]
+        items = self._items[lo:hi]
+        if training and self._recompute_interval > 0:
+            from ...recompute import recompute as rc
+
+            runner = self._run_item
+            i = 0
+            while i < len(items):
+                chunk = items[i : i + self._recompute_interval]
+                i += self._recompute_interval
+
+                # a Layer wrapper (not a bare closure) so recompute()
+                # tracks the chunk's parameters as grad inputs
+                class _Chunk(Layer):
+                    def __init__(self, its):
+                        super().__init__()
+                        self._its = its
+                        for j, (_, l) in enumerate(its):
+                            if isinstance(l, Layer):
+                                self.add_sublayer(str(j), l)
+
+                    def forward(self, v):
+                        for it in self._its:
+                            v = runner(it, v)
+                        return v
+
+                x = rc(_Chunk(chunk), x)
+            return x
+        for it in items:
+            x = self._run_item(it, x)
+        return x
+
+    def forward(self, x):
+        for stage in range(self._num_stages):
+            x = self.run_stage(x, stage, training=self.training)
+        return x

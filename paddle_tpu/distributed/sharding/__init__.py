@@ -1,0 +1,9 @@
+"""paddle.distributed.sharding parity surface."""
+from .group_sharded import (  # noqa: F401
+    GroupShardedOptimizerStage2,
+    build_placements,
+    group_sharded_parallel,
+    install_stage1_placements,
+    save_group_sharded_model,
+    shard_spec_for,
+)

@@ -153,10 +153,26 @@ class CompiledTrainStep:
             out_vals = tuple(o.value for o in outs)
             return loss.value.astype(jnp.float32), (new_buffers, out_vals)
 
+        # ZeRO stage-2/3 (group_sharded): constrain grads to the sharded
+        # layout; XLA realizes the reduce-scatter + sharded-update pattern
+        grad_placements = getattr(opt, "_grad_placements", None) or {}
+
         def step(params, opt_state, buffers, lr, t, rng, inputs, labels):
             (loss, (new_buffers, out_vals)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(params, buffers, rng, inputs, labels)
+
+            if grad_placements:
+                grads = {
+                    k: (
+                        jax.lax.with_sharding_constraint(
+                            g, grad_placements[k]
+                        )
+                        if k in grad_placements
+                        else g
+                    )
+                    for k, g in grads.items()
+                }
 
             # gradient clipping (global-norm path fused into the step)
             if isinstance(clip, ClipGradByGlobalNorm):
@@ -219,7 +235,26 @@ class CompiledTrainStep:
                     new_state[k] = (m2, v2)
             return new_params, new_state, new_buffers, loss, out_vals
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._step = step
+
+    def _finalize_jit(self, params, opt_state, buffers):
+        """Pin output shardings to the input placements so sharded
+        optimizer state / FSDP params STAY sharded across steps (ZeRO
+        stages are placement invariants, not one-shot placements)."""
+        out_shardings = (
+            {k: v.sharding for k, v in params.items()},
+            {
+                k: tuple(a.sharding for a in accs)
+                for k, accs in opt_state.items()
+            },
+            {k: v.sharding for k, v in buffers.items()},
+            None,
+            None,
+        )
+        self._step_fn = jax.jit(
+            self._step, donate_argnums=(0, 1, 2),
+            out_shardings=out_shardings,
+        )
 
     # ---------------------------------------------------------------- call
     def __call__(self, inputs, labels):
@@ -228,6 +263,8 @@ class CompiledTrainStep:
         params = {k: p.value for k, p in self.network.named_parameters()}
         buffers = {k: b.value for k, b in self.network.named_buffers()}
         opt_state = self._gather_opt_state(params)
+        if self._step_fn is None:
+            self._finalize_jit(params, opt_state, buffers)
         self.optimizer._step_count += 1
         lr = jnp.float32(self.optimizer.get_lr())
         t = jnp.float32(self.optimizer._step_count)

@@ -202,9 +202,20 @@ class Optimizer:
     def _acc(self, p, name, init=None):
         key = (id(p), name)
         if key not in self._accumulators:
-            self._accumulators[key] = (
-                jnp.zeros_like(p.value, dtype=jnp.float32) if init is None else init
+            v = (
+                jnp.zeros_like(p.value, dtype=jnp.float32)
+                if init is None
+                else init
             )
+            # ZeRO stage-1+: group_sharded/DygraphShardingOptimizer install
+            # per-param placements so optimizer state is stored sharded
+            # over the sharding axis
+            sh = getattr(self, "_acc_placements", {}).get(id(p))
+            if sh is not None and getattr(v, "ndim", 0) > 0:
+                import jax as _jax
+
+                v = _jax.device_put(v, sh)
+            self._accumulators[key] = v
         return self._accumulators[key]
 
     def _set_acc(self, p, name, value):

@@ -1,0 +1,107 @@
+"""Compiled SPMD pipeline parallelism: microbatch schedule over the pp
+mesh axis with ppermute activation rotation.
+
+Reference parity: the 1F1B/GPipe schedules of
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py and the
+p2p machinery of pp_utils/p2p_communication.py (unverified, mount empty) —
+re-expressed the TPU way (SURVEY.md §7 hard part #2): stage weights are
+STACKED with the leading dim sharded over ``pp`` (stage s's chunk lives on
+pp rank s), and one jitted program runs the whole microbatch schedule:
+
+  tick t: every stage applies its block-chunk to its current activation,
+  then the activations rotate one stage forward via lax.ppermute. Stage 0
+  injects microbatch t; the last stage's outputs are collected. XLA's
+  autodiff reverses the schedule (reverse ppermutes) for the backward
+  pass, yielding the pipelined backward wave of the reference's 1F1B
+  without hand-written p2p.
+
+The eager/API engine (fleet.meta_parallel.PipelineParallel) drives the
+same schedule imperatively; this module is the compiled perf path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim
+    (shard dim 0 over the pp axis when placing)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params
+    )
+
+
+def pipeline_apply(block_fn, chunk_params, h_mb, axis_name="pp",
+                   num_stages=None):
+    """Run the microbatch pipeline INSIDE a shard_map over ``axis_name``.
+
+    block_fn(one_block_params, x) -> x
+    chunk_params: local slice, leaves [1, blocks_per_stage, ...] (the
+        shard_map in_spec puts the stage dim first; squeezed here)
+    h_mb: [M, ...microbatch...] activations entering stage 0 (replicated
+        over the pp axis)
+    Returns [M, ...] outputs of the LAST stage, replicated over pp.
+    """
+    S = num_stages
+    M = h_mb.shape[0]
+    s = jax.lax.axis_index(axis_name)
+    chunk = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), chunk_params)
+
+    def chunk_apply(x):
+        def body(h, blk):
+            return block_fn(blk, h), None
+
+        h, _ = jax.lax.scan(body, x, chunk)
+        return h
+
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(recv, t):
+        x0 = h_mb[jnp.minimum(t, M - 1)]
+        x_in = jnp.where(s == 0, x0, recv)
+        y = chunk_apply(x_in)
+        send = jax.lax.ppermute(y, axis_name, perm) if perm else y
+        return send, y
+
+    _, ys = jax.lax.scan(
+        tick, jnp.zeros(h_mb.shape[1:], h_mb.dtype),
+        jnp.arange(M + S - 1),
+    )
+    outs = ys[S - 1 :]
+    # only the last stage holds real outputs; raw psum replicates them.
+    # NOTE: under unchecked shard_map, a replicated out_spec's transpose
+    # hands each device ct/n — and psum's transpose (psum) sums those n
+    # pieces back to the full ct, so the pair is exactly grad-correct.
+    # (Do NOT swap in an identity-bwd allreduce here; that halves grads.)
+    mask = (s == S - 1).astype(outs.dtype)
+    return jax.lax.psum(outs * mask, axis_name)
+
+
+def make_pipeline_fn(block_fn, num_stages, mesh, axis_name="pp",
+                     extra_in_specs=None):
+    """Build a jittable fn(stacked_params, h_mb) -> outs where
+    stacked_params leaves are [num_stages, blocks_per_stage, ...] sharded
+    over ``axis_name`` on dim 0, h_mb is [M, ...] (replicated over pp; may
+    carry other-axis shardings via ``extra_in_specs``)."""
+    from jax.sharding import PartitionSpec as P
+
+    h_spec = extra_in_specs if extra_in_specs is not None else P()
+
+    def fn(stacked_params, h_mb):
+        body = lambda cp, h: pipeline_apply(
+            block_fn, cp, h, axis_name=axis_name, num_stages=num_stages
+        )
+        spec_params = jax.tree_util.tree_map(
+            lambda _: P(axis_name), stacked_params
+        )
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec_params, h_spec),
+            out_specs=h_spec,
+            check_vma=False,
+        )(stacked_params, h_mb)
+
+    return fn

@@ -1,0 +1,135 @@
+"""ZeRO stages 1-3 (group_sharded_parallel) parity + placement tests.
+
+Reference parity target: test/collective/fleet/dygraph_group_sharded_*.py
+(unverified, mount empty): each stage must match the unsharded gold run,
+and the state it claims to shard must actually be stored sharded.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.jit.trainer import CompiledTrainStep
+
+DEGREE = 8
+IN, HID, OUT, B = 16, 64, 8, 8
+
+
+@pytest.fixture(scope="module")
+def hcg():
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [1, 1, DEGREE, 1, 1]
+    )
+    return HybridCommunicateGroup(topo)
+
+
+def _net():
+    paddle.seed(42)
+    return nn.Sequential(
+        nn.Linear(IN, HID), nn.GELU(), nn.Linear(HID, HID), nn.GELU(),
+        nn.Linear(HID, OUT),
+    )
+
+
+def _run(level, hcg, steps=3):
+    net = _net()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    if level is not None:
+        net, opt, _ = group_sharded_parallel(net, opt, level)
+    step = CompiledTrainStep(net, nn.MSELoss(), opt)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        x = jnp.asarray(rng.randn(B, IN).astype(np.float32))
+        y = jnp.asarray(rng.randn(B, OUT).astype(np.float32))
+        loss, _ = step([Tensor(x)], [Tensor(y)])
+        losses.append(float(np.asarray(loss.numpy())))
+    final = {
+        k: np.asarray(p.numpy()) for k, p in net.named_parameters()
+    }
+    return losses, final, net, opt
+
+
+class TestGroupSharded:
+    def test_stage1_parity_and_placement(self, hcg):
+        gold_losses, gold_params, _, _ = _run(None, hcg)
+        losses, params, net, opt = _run("os", hcg)
+        np.testing.assert_allclose(losses, gold_losses, rtol=2e-5)
+        for k in gold_params:
+            np.testing.assert_allclose(
+                params[k], gold_params[k], rtol=1e-4, atol=1e-6, err_msg=k
+            )
+        # moments are actually stored sharded: local shard of the first
+        # Linear weight moment is 1/DEGREE of the full first dim
+        p0 = dict(net.named_parameters())["0.weight"]
+        m1 = opt._acc(p0, "moment1")
+        local = m1.addressable_shards[0].data.shape
+        assert local[0] == IN // DEGREE, local
+        # params remain replicated in stage 1
+        assert p0.value.addressable_shards[0].data.shape == (IN, HID)
+
+    def test_stage2_parity_and_grad_placements(self, hcg):
+        gold_losses, gold_params, _, _ = _run(None, hcg)
+        losses, params, net, opt = _run("os_g", hcg)
+        np.testing.assert_allclose(losses, gold_losses, rtol=2e-5)
+        for k in gold_params:
+            np.testing.assert_allclose(
+                params[k], gold_params[k], rtol=1e-4, atol=1e-6, err_msg=k
+            )
+        assert opt._grad_placements  # consumed by CompiledTrainStep
+
+    def test_stage3_parity_and_fsdp_storage(self, hcg):
+        gold_losses, gold_params, _, _ = _run(None, hcg)
+        losses, params, net, opt = _run("p_g_os", hcg)
+        np.testing.assert_allclose(losses, gold_losses, rtol=2e-5)
+        for k in gold_params:
+            np.testing.assert_allclose(
+                params[k], gold_params[k], rtol=1e-4, atol=1e-6, err_msg=k
+            )
+        # parameter storage itself sharded (FSDP) and STAYS sharded after
+        # the compiled steps (out_shardings pinning)
+        p0 = dict(net.named_parameters())["0.weight"]
+        assert p0.value.addressable_shards[0].data.shape == (
+            IN // DEGREE, HID,
+        )
+        m1 = opt._acc(p0, "moment1")
+        assert m1.addressable_shards[0].data.shape == (IN // DEGREE, HID)
+
+    def test_small_params_replicate(self, hcg):
+        from paddle_tpu.distributed.sharding import shard_spec_for
+
+        # dims smaller than the degree replicate rather than crash
+        assert tuple(shard_spec_for((3,), "sharding", 8)) == ()
+        assert tuple(shard_spec_for((3, 16), "sharding", 8))[1] == "sharding"
+
+    def test_bad_level_raises(self, hcg):
+        net = _net()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        with pytest.raises(ValueError, match="level"):
+            group_sharded_parallel(net, opt, "zz")
+
+    def test_fleet_sharding_degree_installs_placements(self, hcg):
+        import paddle_tpu.distributed.fleet as fleet_pkg
+        from paddle_tpu.distributed.fleet import fleet as fleet_singleton
+
+        strategy = fleet_pkg.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": DEGREE,
+        }
+        fleet_singleton._initialized = False
+        fleet_pkg.init(is_collective=True, strategy=strategy)
+        net = _net()
+        model = fleet_pkg.distributed_model(net)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        opt = fleet_pkg.distributed_optimizer(opt)
+        assert getattr(opt._inner, "_acc_placements", None)
