@@ -57,6 +57,10 @@ class Fleet:
         self._topology = None
         self._initialized = False
         self._last_model = None
+        # reference ordering allows distributed_optimizer before
+        # distributed_model; these queues are drained when the model arrives
+        self._pending_opt_wrappers = []
+        self._pending_sharding_opts = []
 
     # ---------------------------------------------------------------- init
     def init(self, role_maker=None, is_collective=True, strategy=None,
@@ -84,6 +88,10 @@ class Fleet:
         )
         self._hcg = HybridCommunicateGroup(self._topology)
         self._initialized = True
+        # a fresh topology invalidates bindings from a previous job
+        self._last_model = None
+        self._pending_opt_wrappers = []
+        self._pending_sharding_opts = []
         return self
 
     def get_hybrid_communicate_group(self):
@@ -121,17 +129,48 @@ class Fleet:
 
             wrapped = wrap_hybrid_model(model, hcg, self._strategy)
         self._last_model = wrapped
+        for opt in self._pending_sharding_opts:
+            self._install_sharding_placements(opt, wrapped)
+        self._pending_sharding_opts.clear()
+        for hp_opt in self._pending_opt_wrappers:
+            if hp_opt._model is None:
+                hp_opt._model = wrapped
+        self._pending_opt_wrappers.clear()
         return wrapped
 
     def _install_sharding_placements(self, optimizer, model):
         """DygraphShardingOptimizer semantics (ZeRO-1 over the sharding
-        axis): optimizer state placed sharded."""
+        axis): optimizer state placed sharded. Params/buffers must live
+        on the same device set (mesh-replicated), or eager updates mix
+        single-device params with mesh-sharded accumulators."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
         from ..sharding.group_sharded import install_stage1_placements
 
+        mesh = self._hcg.mesh
         install_stage1_placements(
             optimizer, model.named_parameters(),
-            axis=self._hcg.sharding_axis(), mesh=self._hcg.mesh,
+            axis=self._hcg.sharding_axis(), mesh=mesh,
         )
+        replicated = NamedSharding(mesh, PartitionSpec())
+
+        def _lift(t):
+            # leaves already carrying a multi-device placement (TP weights
+            # sharded by mp_layers, FSDP storage) keep it; only lift
+            # single-device leaves onto the mesh
+            v = t.value
+            if getattr(v, "ndim", None) is None:
+                return
+            s = getattr(v, "sharding", None)
+            if isinstance(s, NamedSharding) and s.mesh.size > 1:
+                return
+            t.value = _jax.device_put(v, replicated)
+
+        for _, p in model.named_parameters():
+            _lift(p)
+        for _, b in model.named_buffers():
+            _lift(b)
 
     def distributed_optimizer(self, optimizer, strategy=None):
         assert self._initialized, "call fleet.init first"
@@ -150,7 +189,8 @@ class Fleet:
             optimizer, self._hcg, strategy or self._strategy,
             model=self._last_model,
         )
-        self._pending_opt_wrappers.append(wrapped)
+        if self._last_model is None:
+            self._pending_opt_wrappers.append(wrapped)
         return wrapped
 
     # ------------------------------------------------------------- save/load

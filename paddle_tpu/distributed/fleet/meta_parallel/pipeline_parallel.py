@@ -138,6 +138,10 @@ class PipelineParallel(Layer):
             total += fire_backward()
 
         if scaler is not None:
+            # capture the scale the losses were actually multiplied by
+            # BEFORE update() grows/shrinks it, or the reported loss is
+            # wrong by the incr/decr ratio on adjustment steps
+            scale_used = float(scaler._scale)
             scaler.step(optimizer)
             scaler.update()
         else:
@@ -148,7 +152,7 @@ class PipelineParallel(Layer):
         # total is the mean loss over the global batch (losses were
         # pre-scaled by 1/acc); unscale report if a scaler is active
         if scaler is not None:
-            total = total / float(scaler._scale)
+            total = total / scale_used
         return Tensor(np.float32(total))
 
     def eval_batch(self, data, compute_loss=True):

@@ -201,6 +201,23 @@ class ProcessGroup:
     def get_group_rank(self, global_rank):
         return self.ranks.index(global_rank) if global_rank in self.ranks else -1
 
+    def set_virtual_rank(self, rank):
+        """Pick which virtual member this process acts as for eager p2p
+        in single-process SPMD groups (where every virtual rank is driven
+        by one process pinned to rank 0). Needed only to disambiguate
+        recv() when one src has pending sends to several dsts."""
+        from . import env as dist_env
+
+        if dist_env.get_world_size() != 1:
+            raise RuntimeError(
+                "set_virtual_rank applies only to single-process SPMD "
+                "groups; in a multi-process world the rank is the "
+                "process identity and must not be reassigned"
+            )
+        if rank < 0 or rank >= self.nranks:
+            raise ValueError(f"virtual rank {rank} out of range 0..{self.nranks - 1}")
+        self.rank = rank
+
     # ----------------------------------------------------------- mode query
     def _is_spmd_axis_group(self):
         from . import env as dist_env
@@ -525,7 +542,25 @@ class ProcessGroup:
         from . import env as dist_env
 
         if dist_env.get_world_size() == 1:
+            # Pair on the (src, dst) the callers named. With SPMD virtual
+            # ranks the receiver's own rank is pinned to 0, so fall back
+            # to the unique non-empty (src, *) box when (src, self.rank)
+            # is empty; use set_virtual_rank() to disambiguate fan-out.
             box = self._p2p_box.get((src, self.rank))
+            if not box:
+                candidates = [
+                    (k, b) for k, b in self._p2p_box.items()
+                    if k[0] == src and b
+                ]
+                if len(candidates) == 1:
+                    box = candidates[0][1]
+                elif len(candidates) > 1:
+                    raise RuntimeError(
+                        f"recv(src={src}) is ambiguous in group {self.id}: "
+                        f"pending sends to dsts "
+                        f"{sorted(k[1] for k, _ in candidates)}; call "
+                        "group.set_virtual_rank(dst) before recv to pick one"
+                    )
             if not box:
                 raise RuntimeError(
                     f"recv(src={src}) with no matching send in group "

@@ -113,8 +113,10 @@ class Model:
             self._optimizer.step()
             self._optimizer.clear_grad()
             self._pending_accum = False
+            self._accum_count = 0
         elif self._accumulating:
             self._pending_accum = True
+            self._accum_count = getattr(self, "_accum_count", 0) + 1
         metrics = []
         for m in self._metrics:
             m_in = m.compute(*(_to_list(outputs) + labels))
@@ -201,6 +203,7 @@ class Model:
         self._accumulating = accumulate_grad_batches > 1
         self._accumulate_grad_batches = max(1, accumulate_grad_batches)
         self._pending_accum = False
+        self._accum_count = 0
         cbks.on_train_begin()
         it = 0
         for epoch in range(epochs):
@@ -223,10 +226,20 @@ class Model:
                     break
             if self._pending_accum:
                 # flush a trailing partial accumulation window so its
-                # grads don't leak into the next epoch's first update
+                # grads don't leak into the next epoch's first update.
+                # Losses were scaled by 1/N but only k<N batches landed;
+                # rescale grads by N/k so the flush is a true average.
+                k = max(1, getattr(self, "_accum_count", 1))
+                n = self._accumulate_grad_batches
+                if k < n:
+                    rescale = float(n) / float(k)
+                    for p in self.network.parameters():
+                        if p.grad is not None:
+                            p.grad.value = p.grad.value * rescale
                 self._optimizer.step()
                 self._optimizer.clear_grad()
                 self._pending_accum = False
+                self._accum_count = 0
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 self._run_eval(eval_loader, cbks)

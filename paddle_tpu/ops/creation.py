@@ -23,10 +23,44 @@ def _device():
 
 
 def _place(arr):
-    """Put a freshly created array on the current device (eager only)."""
+    """Put a freshly created array on the current device (eager only).
+
+    Single-process SPMD: when a multi-device mesh is installed, the mesh
+    IS the current device — eager arrays are placed mesh-replicated so
+    they compose with mesh-placed params/optimizer state (ZeRO, TP)
+    without per-op device juggling."""
     if tape_mod.in_trace():
         return arr
+    s = _spmd_replicated_sharding()
+    if s is not None:
+        return jax.device_put(arr, s)
     return jax.device_put(arr, _device())
+
+
+_REPL_CACHE = {"epoch": -1, "sharding": None}
+
+
+def _spmd_replicated_sharding():
+    """Replicated NamedSharding over the active mesh (cached per mesh
+    epoch — this sits on the eager creation hot path), or None when no
+    multi-device mesh is active / in a multi-process world."""
+    from ..parallel import mesh as mesh_mod
+
+    epoch = mesh_mod._STATE["epoch"]
+    if _REPL_CACHE["epoch"] == epoch:
+        return _REPL_CACHE["sharding"]
+    sharding = None
+    mesh = mesh_mod._STATE["mesh"]
+    if mesh is not None and mesh.size > 1:
+        from ..distributed.env import get_world_size
+
+        if get_world_size() == 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec())
+    _REPL_CACHE["epoch"] = epoch
+    _REPL_CACHE["sharding"] = sharding
+    return sharding
 
 
 def _shape(shape):

@@ -133,3 +133,58 @@ class TestGroupSharded:
         opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
         opt = fleet_pkg.distributed_optimizer(opt)
         assert getattr(opt._inner, "_acc_placements", None)
+
+    def test_fleet_optimizer_before_model_ordering(self, hcg):
+        """Reference allows distributed_optimizer before distributed_model;
+        the queued install must drain when the model arrives, and the
+        eager ZeRO-1 step must actually run (round-2 regression: crash +
+        silently skipped placements)."""
+        import paddle_tpu.distributed.fleet as fleet_pkg
+        from paddle_tpu.distributed.fleet import fleet as fleet_singleton
+
+        strategy = fleet_pkg.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": DEGREE,
+        }
+        fleet_singleton._initialized = False
+        fleet_pkg.init(is_collective=True, strategy=strategy)
+        net = _net()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        dopt = fleet_pkg.distributed_optimizer(opt)  # BEFORE the model
+        model = fleet_pkg.distributed_model(net)
+        assert getattr(opt, "_acc_placements", None), "queued install lost"
+        assert dopt._model is model, "pending wrapper never got the model"
+        rng = np.random.RandomState(0)
+        x = Tensor(jnp.asarray(rng.randn(B, IN).astype(np.float32)))
+        y = Tensor(jnp.asarray(rng.randn(B, OUT).astype(np.float32)))
+        losses = []
+        for _ in range(3):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            dopt.step()
+            dopt.clear_grad()
+            losses.append(float(np.asarray(loss.numpy())))
+        assert losses[-1] < losses[0]
+        # the moment is genuinely stored sharded over the axis
+        p0 = dict(net.named_parameters())["0.weight"]
+        m1 = opt._acc(p0, "moment1")
+        assert m1.addressable_shards[0].data.shape[0] == IN // DEGREE
+
+    def test_compiled_step_no_single_device_pinning(self, hcg):
+        """Round-2 regression guard: on inputs with no multi-device
+        NamedShardings the trainer must jit WITHOUT output pinning (the
+        blanket pin cost 70x on a real chip and broke mesh runs)."""
+        net = _net()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        step = CompiledTrainStep(net, nn.MSELoss(), opt)
+        dev0 = jax.devices()[0]
+        params = {
+            k: jax.device_put(p.value, dev0)
+            for k, p in net.named_parameters()
+        }
+        step._build()
+        opt_state = {k: () for k in params}
+        step._finalize_jit(params, opt_state, {})
+        # single-device placements are not "explicit" -> base step, unpinned
+        assert step._step_fn.__wrapped__ is step._step
