@@ -1,11 +1,15 @@
 """Benchmark entry: prints ONE JSON line with the headline metric.
 
-Current flagship bench (upgraded per round as larger models land):
-jitted whole-step training throughput on the biggest model the current
-build supports. Target metric family per BASELINE.json: tokens (samples)
-/sec/chip vs A100 MFU parity. ``vs_baseline`` is measured-MFU / 0.40 (a
-40% MFU A100 Fleet assumption — no published reference numbers exist;
-BASELINE.md records the provenance gap).
+Flagship bench: whole-step compiled training throughput of a Llama-shaped
+decoder (RMSNorm + rope + causal flash attention + SwiGLU — the BASELINE
+config #4 model family) at the largest single-chip-fitting size, bf16
+compute (AMP O2). ``vs_baseline`` is measured-MFU / 0.40 (a 40%-MFU A100
+Fleet assumption — no published reference numbers exist; BASELINE.md
+records the provenance gap). FLOPs use the standard 6N + attention
+accounting (models/llama.py:flops_per_token).
+
+Run with --profile to additionally write a jax profiler trace to
+./bench_trace (inspect with tensorboard / xprof).
 """
 from __future__ import annotations
 
@@ -17,72 +21,73 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def main():
+def main(profile=False):
     import numpy as np
 
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    # transformer LM block stack ~ the shape of the eventual llama bench
-    B, S, H, L, V = (8, 512, 512, 8, 32000) if on_tpu else (2, 128, 128, 2, 1000)
-
-    class TinyLM(nn.Layer):
-        def __init__(self):
-            super().__init__()
-            self.emb = nn.Embedding(V, H)
-            enc = nn.TransformerEncoderLayer(
-                d_model=H, nhead=8, dim_feedforward=4 * H, dropout=0.0,
-                activation="gelu", normalize_before=True,
-            )
-            self.encoder = nn.TransformerEncoder(enc, L)
-            self.head = nn.Linear(H, V)
-
-        def forward(self, ids):
-            return self.head(self.encoder(self.emb(ids)))
+    if on_tpu:
+        # largest comfortable single-chip (v5e 16G HBM) config:
+        # ~330M params -> 5.3GB fp32 params+adam, plus bf16 activations
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=16, num_attention_heads=16,
+            max_position_embeddings=1024,
+        )
+        B, S, iters = 8, 1024, 30
+    else:
+        cfg = LlamaConfig.tiny()
+        B, S, iters = 2, 64, 3
 
     paddle.seed(0)
-    net = TinyLM()
+    net = LlamaForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
 
     def loss_fn(logits, labels):
-        import paddle_tpu.nn.functional as F
-
         return F.cross_entropy(
-            logits.reshape([-1, V]), labels.reshape([-1])
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])
         )
 
-    step = CompiledTrainStep(net, loss_fn, opt, amp_level="O1" if on_tpu else None)
+    step = CompiledTrainStep(
+        net, loss_fn, opt, amp_level="O2" if on_tpu else None,
+        amp_dtype="bfloat16",
+    )
 
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(0, V, (B, S)))
-    labels = jnp.asarray(rng.randint(0, V, (B, S)))
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
 
-    # warmup (compile)
-    loss, _ = step([Tensor(ids)], [Tensor(labels)])
+    # warmup (compile + 2 steady steps)
+    for _ in range(3):
+        loss, _ = step([Tensor(ids)], [Tensor(labels)])
     float(np.asarray(loss.numpy()))
 
-    iters = 20 if on_tpu else 5
+    if profile:
+        jax.profiler.start_trace("bench_trace")
+
     t0 = time.perf_counter()
     for _ in range(iters):
         loss, _ = step([Tensor(ids)], [Tensor(labels)])
-    float(np.asarray(loss.numpy()))  # sync
+    float(np.asarray(loss.numpy()))  # device sync
     dt = time.perf_counter() - t0
 
+    if profile:
+        jax.profiler.stop_trace()
+
     tokens_per_sec = B * S * iters / dt
-    n_params = sum(p.size for p in net.parameters())
-    # 6*N*T FLOPs/token approximation (fwd+bwd)
-    flops_per_step = 6 * n_params * B * S
-    achieved = flops_per_step * iters / dt
+    achieved = net.flops_per_token(S) * B * S * iters / dt
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU placeholder
     mfu = achieved / peak
     print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip_tinylm",
+        "metric": "train_tokens_per_sec_per_chip_llama330m",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
@@ -90,4 +95,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main(profile="--profile" in sys.argv)

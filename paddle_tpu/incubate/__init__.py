@@ -1,0 +1,11 @@
+"""paddle.incubate parity namespace.
+
+Reference parity: python/paddle/incubate/ (unverified, mount empty) — the
+staging ground for fused-op APIs and experimental distributed models. The
+TPU build backs these with Pallas kernels (paddle_tpu/kernels/) on TPU and
+composed jnp elsewhere; XLA fusion makes the composed paths one kernel in
+compiled steps either way, so both tiers are "fused" in the sense that
+matters (no extra HBM round trips).
+"""
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.incubate.nn parity: fused layers + functional namespace."""
+from . import functional  # noqa: F401
+from .layer import FusedFeedForward, FusedMultiHeadAttention  # noqa: F401

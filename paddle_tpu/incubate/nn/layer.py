@@ -1,0 +1,171 @@
+"""Fused transformer layers (paddle.incubate.nn parity).
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward — unverified, mount empty).
+On TPU "fused" means: the whole block is expressed as a handful of large
+ops (qkv as one gemm, flash attention, gemm+epilogue) that XLA/Pallas fuse
+— matching the intent of the reference's cublasLt/fmha fusions.
+"""
+from __future__ import annotations
+
+import math
+
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN multi-head self-attention with a single QKV gemm and
+    flash attention (paddle.incubate.nn.FusedMultiHeadAttention parity)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if need_weights:
+            raise NotImplementedError("need_weights=True is not supported")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self._dropout_rate = dropout_rate
+        self._attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        # single fused QKV weight, reference layout [3, H, dim, dim/H] kept
+        # flat here: [dim, 3*dim]
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=qkv_weight_attr
+        )
+        self.qkv_bias = self.create_parameter(
+            [3 * embed_dim], attr=qkv_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr
+        )
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=I.Constant(1.0),
+        )
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=I.Constant(1.0),
+        )
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        from . import functional as IF
+
+        residual = query
+        x = query
+        if self.normalize_before:
+            x = F.layer_norm(x, (self.embed_dim,), weight=self.pre_ln_scale,
+                             bias=self.pre_ln_bias, epsilon=self._epsilon)
+        B, S = int(x.shape[0]), int(x.shape[1])
+        qkv = IF.fused_linear(x, self.qkv_weight, self.qkv_bias)
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = (
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        )
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self._attn_dropout_rate, training=self.training,
+        )
+        out = out.reshape([B, S, self.embed_dim])
+        out = IF.fused_linear(out, self.linear_weight, self.linear_bias)
+        out = IF.fused_dropout_add(out, residual, p=self._dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self.embed_dim,), weight=self.ln_scale,
+                               bias=self.ln_bias, epsilon=self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Pre/post-LN MLP block (paddle.incubate.nn.FusedFeedForward parity)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self._d_model = d_model
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = (
+            dropout_rate if act_dropout_rate is None else act_dropout_rate
+        )
+        self._act = activation
+        self._epsilon = epsilon
+        self.normalize_before = normalize_before
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr
+        )
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr
+        )
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr,
+            default_initializer=I.Constant(1.0),
+        )
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr,
+            default_initializer=I.Constant(1.0),
+        )
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0),
+        )
+
+    def forward(self, src, cache=None):
+        from . import functional as IF
+
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, (self._d_model,), weight=self.ln1_scale,
+                             bias=self.ln1_bias, epsilon=self._epsilon)
+        h = IF.fused_linear_activation(
+            x, self.linear1_weight, self.linear1_bias,
+            activation=self._act if self._act in ("gelu", "relu") else "none",
+        )
+        h = F.dropout(h, p=self._act_dropout_rate, training=self.training)
+        h = IF.fused_linear(h, self.linear2_weight, self.linear2_bias)
+        out = IF.fused_dropout_add(h, residual, p=self._dropout_rate,
+                                   training=self.training)
+        if not self.normalize_before:
+            out = F.layer_norm(out, (self._d_model,), weight=self.ln2_scale,
+                               bias=self.ln2_bias, epsilon=self._epsilon)
+        return out

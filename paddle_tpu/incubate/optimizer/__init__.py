@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer parity: fused / multi-tensor optimizers.
+
+The multi-tensor fused Adam path (reference: fused_adam_kernel.cu +
+paddle.optimizer use_multi_tensor) lives in paddle_tpu/kernels/fused_adam.py
+and is wired into paddle_tpu.optimizer.Adam/AdamW via use_multi_tensor=True:
+one jitted whole-tree update per step instead of one dispatch per parameter.
+"""
+from ...kernels.fused_adam import fused_adam_update  # noqa: F401

@@ -5,3 +5,6 @@ lib (unverified, mount empty). Each module provides a Pallas TPU kernel and
 a composed-jnp fallback (CPU/CI); call sites pick automatically.
 """
 from . import flash_attention  # noqa: F401
+from . import fused_adam  # noqa: F401
+from . import rms_norm  # noqa: F401
+from . import rope  # noqa: F401

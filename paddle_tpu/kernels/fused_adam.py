@@ -25,13 +25,15 @@ def fused_adam_update(params, m, v, grads, lr, beta1, beta2, eps, t,
     def upd(p, m_, v_, g):
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        if not decoupled and weight_decay:
+        # weight_decay is traced (may change per bucket/step); wd=0 is an
+        # arithmetic no-op so no branch is needed. `decoupled` is static.
+        if not decoupled:
             g32 = g32 + weight_decay * p32
         m2 = beta1 * m_ + (1 - beta1) * g32
         v2 = beta2 * v_ + (1 - beta2) * jnp.square(g32)
         mhat = m2 / (1 - jnp.power(beta1, t))
         vhat = v2 / (1 - jnp.power(beta2, t))
-        if decoupled and weight_decay:
+        if decoupled:
             p32 = p32 * (1 - lr * weight_decay)
         return (p32 - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype), m2, v2
 

@@ -20,8 +20,8 @@ def _interpret():
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
-    x = x_ref[:].astype(jnp.float32)  # [1, S, H, D]
-    cos = cos_ref[:].astype(jnp.float32)  # [1, S, 1, D/2]
+    x = x_ref[:].astype(jnp.float32)  # [1, S_blk, H, D]
+    cos = cos_ref[:].astype(jnp.float32)  # [1, S_blk, 1, D/2]
     sin = sin_ref[:].astype(jnp.float32)
     d2 = x.shape[-1] // 2
     x1 = x[..., :d2]
@@ -31,17 +31,30 @@ def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):
     o_ref[:] = jnp.concatenate([o1, o2], axis=-1).astype(o_ref.dtype)
 
 
+def _seq_block(s, h, d):
+    """Largest seq tile whose f32 working set (~7 temporaries of
+    [sb, H, D]) stays well inside scoped VMEM: cap one temp at 2MB.
+    Pallas TPU needs the last two block dims whole, so tiling is over
+    (batch, seq) only."""
+    cap = (512 * 1024) // (4 * h * d)
+    for b in (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if b <= max(cap, 1) and s % b == 0:
+            return b
+    return 1
+
+
 def _rope_apply(x, cos, sin):
     b, s, h, d = x.shape
+    sb = _seq_block(s, h, d)
     out = pl.pallas_call(
         _rope_kernel,
-        grid=(b,),
+        grid=(b, s // sb),
         in_specs=[
-            pl.BlockSpec((1, s, h, d), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((1, s, 1, d // 2), lambda i: (0, 0, 0, 0)),
-            pl.BlockSpec((1, s, 1, d // 2), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, sb, h, d), lambda i, k: (i, k, 0, 0)),
+            pl.BlockSpec((1, sb, 1, d // 2), lambda i, k: (0, k, 0, 0)),
+            pl.BlockSpec((1, sb, 1, d // 2), lambda i, k: (0, k, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, s, h, d), lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, sb, h, d), lambda i, k: (i, k, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype),
         interpret=_interpret(),
     )(x, cos, sin)

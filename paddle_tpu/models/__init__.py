@@ -1,0 +1,9 @@
+"""Flagship LLM model families (TPU-first).
+
+The reference keeps its LLM zoo in the PaddleNLP ecosystem on top of the
+core framework; this package ships the framework-native equivalents used
+by the acceptance configs (BASELINE.json #3-#5): a Llama-family decoder
+built on the fused-op API (RMSNorm/rope/flash-attention/SwiGLU), sized by
+config, single-chip or hybrid-parallel via fleet.
+"""
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,185 @@
+"""Llama-family decoder (the flagship model for BASELINE config #4).
+
+Reference parity: the Fleet hybrid-parallel Llama-2 path (BASELINE.json
+"configs" #4; the model itself lives in PaddleNLP's llama modeling on top
+of core ops — unverified, mount empty). TPU-first design:
+
+- pre-norm RMSNorm -> fused Pallas kernel on TPU (kernels/rms_norm.py)
+- rotary embeddings -> fused Pallas rope (kernels/rope.py) via
+  incubate.nn.functional.fused_rotary_position_embedding
+- causal attention -> flash attention (kernels/flash_attention.py) through
+  F.scaled_dot_product_attention, with grouped-query attention (GQA)
+- SwiGLU MLP -> incubate.nn.functional.swiglu (one split gemm)
+- everything shape-static and bf16-friendly so the whole step compiles
+  onto the MXU as a handful of fused loops.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..incubate.nn import functional as IF
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int | None = None  # GQA; None -> MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(
+            vocab_size=1000, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128,
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**kw):
+        base = dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32,
+            max_position_embeddings=4096,
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.cfg = config
+        h, d = config.hidden_size, config.head_dim
+        self.q_proj = nn.Linear(h, config.num_attention_heads * d, bias_attr=False)
+        self.k_proj = nn.Linear(h, config.kv_heads * d, bias_attr=False)
+        self.v_proj = nn.Linear(h, config.kv_heads * d, bias_attr=False)
+        self.o_proj = nn.Linear(config.num_attention_heads * d, h, bias_attr=False)
+
+    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None):
+        cfg = self.cfg
+        B, S = int(x.shape[0]), int(x.shape[1])
+        q = self.q_proj(x).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
+        k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        q, k, _ = IF.fused_rotary_position_embedding(
+            q, k, None, sin=rope_sin, cos=rope_cos,
+            rotary_emb_base=cfg.rope_theta,
+        )
+        if cfg.kv_heads != cfg.num_attention_heads:
+            rep = cfg.num_attention_heads // cfg.kv_heads
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            training=self.training,
+        )
+        return self.o_proj(out.reshape([B, S, -1]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ffn = config.hidden_size, config.intermediate_size
+        # gate+up as ONE gemm; swiglu splits (llama fused-gate pattern)
+        self.gate_up_proj = nn.Linear(h, 2 * ffn, bias_attr=False)
+        self.down_proj = nn.Linear(ffn, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(IF.swiglu(self.gate_up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps
+        )
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps
+        )
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None):
+        h = x + self.self_attn(
+            self.input_layernorm(x), rope_cos, rope_sin, attn_mask
+        )
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)]
+        )
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        cfg = self.config
+        S = int(input_ids.shape[1])
+        from ..kernels.rope import build_rope_cache
+
+        cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
+        cos_t, sin_t = Tensor(cos), Tensor(sin)
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            h = layer(h, cos_t, sin_t, attn_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(
+                config.hidden_size, config.vocab_size, bias_attr=False
+            )
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.model(input_ids, attn_mask)
+        if self.lm_head is None:
+            return F.linear(h, self.model.embed_tokens.weight.t())
+        return self.lm_head(h)
+
+    def num_params(self):
+        return sum(int(p.size) for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """Training FLOPs/token: 6*N + attention quadratic term
+        (12*L*H*S per token with H=hidden, standard PaLM appendix
+        accounting)."""
+        cfg = self.config
+        return (
+            6 * self.num_params()
+            + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        )

@@ -122,8 +122,28 @@ def _rms_norm(x, w, b, *, eps, begin_axis):
     return out
 
 
+def _fused_rms_available(x, weight, bias, begin_axis):
+    """Pallas fused path: TPU, last-axis norm, weight-only."""
+    if bias is not None or weight is None:
+        return False
+    if begin_axis != x.ndim - 1:
+        return False
+    import jax as _j
+
+    return any(d.platform != "cpu" for d in _j.devices())
+
+
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
     begin_axis = begin_norm_axis % x.ndim
+    if _fused_rms_available(x, weight, bias, begin_axis):
+        from ...kernels.rms_norm import rms_norm_fused
+
+        def _fused(xv, wv):
+            return rms_norm_fused(xv, wv, float(epsilon))
+
+        return dispatch.apply(
+            "fused_rms_norm", _fused, (x, weight), cache=False
+        )
     return dispatch.apply(
         "rms_norm",
         _rms_norm,

@@ -302,6 +302,64 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._eps = epsilon
+        self._use_multi_tensor = use_multi_tensor
+
+    def step(self):
+        if not getattr(self, "_use_multi_tensor", False):
+            return super().step()
+        # multi-tensor fused path (reference: fused_adam_kernel.cu /
+        # use_multi_tensor): ONE jitted whole-tree update per (lr, wd)
+        # bucket instead of one dispatch per parameter.
+        params_grads = []
+        for group, p in self._all_params():
+            if p.grad is None or p.stop_gradient:
+                continue
+            params_grads.append((p, p.grad, group))
+        if not params_grads:
+            return
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g, _ in params_grads])
+            params_grads = [
+                (p, g, grp) for (p, g), (_, _, grp) in zip(clipped, params_grads)
+            ]
+        self._step_count += 1
+        lr = self.get_lr()
+        from ..kernels.fused_adam import fused_adam_update
+
+        buckets: dict = {}
+        for p, g, group in params_grads:
+            plr = (
+                lr
+                * float(group.get("learning_rate", 1.0))
+                * p.optimize_attr.get("learning_rate", 1.0)
+            )
+            wd, l1 = self._decay_value(group, p)
+            if self._decoupled and isinstance(
+                self, AdamW
+            ) and self._apply_decay_fun is not None and not self._apply_decay_fun(
+                p.name or ""
+            ):
+                wd = 0.0
+            if l1 == "l1":
+                # L1 decay has no fused form; per-param fallback
+                self._update_param(p, g, plr, group)
+                continue
+            buckets.setdefault((plr, float(wd)), []).append((p, g))
+        for (plr, wd), plist in buckets.items():
+            ps = [p.value for p, _ in plist]
+            gs = [g.value for _, g in plist]
+            ms = [self._acc(p, "moment1") for p, _ in plist]
+            vs = [self._acc(p, "moment2") for p, _ in plist]
+            new_p, new_m, new_v = fused_adam_update(
+                ps, ms, vs, gs, jnp.float32(plr),
+                jnp.float32(self._beta1), jnp.float32(self._beta2),
+                jnp.float32(self._eps), jnp.float32(self._step_count),
+                self._decoupled, jnp.float32(wd),
+            )
+            for (p, _), np_, nm, nv in zip(plist, new_p, new_m, new_v):
+                p.value = np_
+                self._set_acc(p, "moment1", nm)
+                self._set_acc(p, "moment2", nv)
 
     def _update_param(self, p, g, lr, group):
         wd, l1 = self._decay_value(group, p)
@@ -328,10 +386,11 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         name=name)
+                         use_multi_tensor, name=name)
         self._apply_decay_fun = apply_decay_param_fun
 
     def _update_param(self, p, g, lr, group):

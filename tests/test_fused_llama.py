@@ -1,0 +1,302 @@
+"""Fused kernels (Pallas, interpret-mode on CPU), incubate API, Llama.
+
+Reference parity targets: paddle.incubate.nn.functional fused ops (backed
+by phi fusion kernels) and the PaddleNLP-tier Llama decoder (BASELINE
+config #4 model family). Numpy/composed-jnp oracles per the reference's
+OpTest strategy (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+import paddle_tpu.incubate.nn.functional as IF
+
+
+class TestFusedRmsNormKernel:
+    def _oracle(self, x, w, eps=1e-6):
+        ms = np.mean(x.astype(np.float64) ** 2, axis=-1, keepdims=True)
+        return (x / np.sqrt(ms + eps)) * w
+
+    def test_forward_matches_oracle(self):
+        from paddle_tpu.kernels.rms_norm import rms_norm_fused
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 64).astype(np.float32)
+        w = rng.randn(64).astype(np.float32)
+        y = np.asarray(rms_norm_fused(jnp.asarray(x), jnp.asarray(w)))
+        np.testing.assert_allclose(y, self._oracle(x, w), rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_composed(self):
+        from paddle_tpu.kernels.rms_norm import rms_norm_fused
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+        w = jnp.asarray(rng.randn(32).astype(np.float32))
+
+        def composed(x, w):
+            ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+            return jnp.sum(jnp.sin(x * jax.lax.rsqrt(ms + 1e-6) * w))
+
+        def fused(x, w):
+            return jnp.sum(jnp.sin(rms_norm_fused(x, w, 1e-6)))
+
+        gx_c, gw_c = jax.grad(composed, argnums=(0, 1))(x, w)
+        gx_f, gw_f = jax.grad(fused, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_c), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_c), rtol=1e-4, atol=1e-5)
+
+    def test_3d_and_dtype(self):
+        from paddle_tpu.kernels.rms_norm import rms_norm_fused
+
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 5, 16).astype(np.float32)
+        w = np.ones(16, np.float32)
+        y = np.asarray(rms_norm_fused(jnp.asarray(x), jnp.asarray(w)))
+        assert y.shape == (2, 5, 16)
+        np.testing.assert_allclose(y, self._oracle(x, w), rtol=2e-5, atol=2e-5)
+
+
+class TestFusedRope:
+    def _oracle(self, x, cos, sin):
+        d2 = x.shape[-1] // 2
+        x1, x2 = x[..., :d2], x[..., d2:]
+        return np.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+    def test_kernel_matches_oracle(self):
+        from paddle_tpu.kernels.rope import build_rope_cache, rope_fused
+
+        rng = np.random.RandomState(0)
+        B, S, H, D = 2, 8, 3, 16
+        x = rng.randn(B, S, H, D).astype(np.float32)
+        cos, sin = build_rope_cache(S, D)
+        y = np.asarray(rope_fused(jnp.asarray(x), cos, sin))
+        np.testing.assert_allclose(
+            y, self._oracle(x, np.asarray(cos), np.asarray(sin)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_backward_is_inverse_rotation(self):
+        from paddle_tpu.kernels.rope import build_rope_cache, rope_fused
+
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(1, 4, 2, 8).astype(np.float32))
+        cos, sin = build_rope_cache(4, 8)
+        # rotation is orthogonal: grad of sum(rot(x)*t) wrt x == rot^-1(t)
+        t = jnp.asarray(rng.randn(1, 4, 2, 8).astype(np.float32))
+        g = jax.grad(lambda x: jnp.sum(rope_fused(x, cos, sin) * t))(x)
+        expect = self._oracle(np.asarray(t), np.asarray(cos), -np.asarray(sin))
+        np.testing.assert_allclose(np.asarray(g), expect, rtol=1e-5, atol=1e-5)
+
+    def test_incubate_api_neox_and_gptj(self):
+        rng = np.random.RandomState(2)
+        B, S, H, D = 2, 6, 2, 8
+        q = Tensor(jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)))
+        k = Tensor(jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)))
+        qo, ko, vo = IF.fused_rotary_position_embedding(q, k, None)
+        assert vo is None and qo.shape == [B, S, H, D]
+        # norms preserved (rotation is orthogonal per pair)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(qo.numpy())),
+            np.linalg.norm(np.asarray(q.numpy())), rtol=1e-5,
+        )
+        qg, _, _ = IF.fused_rotary_position_embedding(
+            q, None, None, use_neox_rotary_style=False
+        )
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(qg.numpy())),
+            np.linalg.norm(np.asarray(q.numpy())), rtol=1e-5,
+        )
+
+    def test_full_dim_tables_and_position_ids(self):
+        from paddle_tpu.kernels.rope import build_rope_cache
+
+        rng = np.random.RandomState(3)
+        B, S, H, D = 1, 8, 2, 8
+        q = Tensor(jnp.asarray(rng.randn(B, S, H, D).astype(np.float32)))
+        cos_h, sin_h = build_rope_cache(S, D)
+        # full-dim mirrored tables, reference layout
+        cos_full = jnp.concatenate([cos_h, cos_h], -1)
+        sin_full = jnp.concatenate([sin_h, sin_h], -1)
+        a, _, _ = IF.fused_rotary_position_embedding(q, sin=sin_full, cos=cos_full)
+        b, _, _ = IF.fused_rotary_position_embedding(q)
+        np.testing.assert_allclose(
+            np.asarray(a.numpy()), np.asarray(b.numpy()), rtol=1e-5, atol=1e-6
+        )
+        # identity position ids == default
+        pid = jnp.arange(S)[None, :].repeat(B, 0)
+        c, _, _ = IF.fused_rotary_position_embedding(q, position_ids=pid)
+        np.testing.assert_allclose(
+            np.asarray(c.numpy()), np.asarray(b.numpy()), rtol=1e-5, atol=1e-6
+        )
+
+
+class TestIncubateFunctional:
+    def test_swiglu_split_and_pair(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 8).astype(np.float32)
+        got = np.asarray(IF.swiglu(Tensor(jnp.asarray(x))).numpy())
+        x1, x2 = x[:, :4], x[:, 4:]
+        sil = x1 / (1 + np.exp(-x1))
+        np.testing.assert_allclose(got, sil * x2, rtol=1e-5)
+        got2 = np.asarray(
+            IF.swiglu(Tensor(jnp.asarray(x1)), Tensor(jnp.asarray(x2))).numpy()
+        )
+        np.testing.assert_allclose(got2, sil * x2, rtol=1e-5)
+
+    def test_fused_rms_norm_residual_contract(self):
+        rng = np.random.RandomState(1)
+        x = Tensor(jnp.asarray(rng.randn(2, 8).astype(np.float32)))
+        r = Tensor(jnp.asarray(rng.randn(2, 8).astype(np.float32)))
+        w = Tensor(jnp.asarray(np.ones(8, np.float32)))
+        out, res = IF.fused_rms_norm(x, w, residual=r)
+        np.testing.assert_allclose(
+            np.asarray(res.numpy()),
+            np.asarray(x.numpy()) + np.asarray(r.numpy()), rtol=1e-6,
+        )
+        solo = IF.fused_rms_norm(res, w)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()), np.asarray(solo.numpy()), rtol=1e-6
+        )
+
+    def test_fused_dropout_add(self):
+        x = Tensor(jnp.ones((4, 4), jnp.float32))
+        y = Tensor(jnp.full((4, 4), 2.0, jnp.float32))
+        out = IF.fused_dropout_add(x, y, p=0.0)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
+        out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
+        paddle.seed(7)
+        out = IF.fused_dropout_add(x, y, p=0.5, training=True)
+        vals = np.asarray(out.numpy())
+        assert set(np.unique(vals.round(4))) <= {2.0, 4.0}
+
+    def test_fused_linear_paths(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(3, 4).astype(np.float32)
+        w = rng.randn(4, 5).astype(np.float32)
+        b = rng.randn(5).astype(np.float32)
+        got = np.asarray(
+            IF.fused_linear(Tensor(jnp.asarray(x)), Tensor(jnp.asarray(w)),
+                            Tensor(jnp.asarray(b))).numpy()
+        )
+        np.testing.assert_allclose(got, x @ w + b, rtol=1e-5)
+        got_t = np.asarray(
+            IF.fused_linear(Tensor(jnp.asarray(x)), Tensor(jnp.asarray(w.T)),
+                            transpose_weight=True).numpy()
+        )
+        np.testing.assert_allclose(got_t, x @ w, rtol=1e-5)
+        act = np.asarray(
+            IF.fused_linear_activation(
+                Tensor(jnp.asarray(x)), Tensor(jnp.asarray(w)),
+                Tensor(jnp.asarray(b)), activation="relu",
+            ).numpy()
+        )
+        np.testing.assert_allclose(act, np.maximum(x @ w + b, 0), rtol=1e-5)
+
+    def test_fused_layers_forward_backward(self):
+        from paddle_tpu.incubate.nn import FusedFeedForward, FusedMultiHeadAttention
+
+        paddle.seed(0)
+        mha = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0,
+                                      normalize_before=True)
+        ffn = FusedFeedForward(32, 64, dropout_rate=0.0,
+                               normalize_before=True, activation="gelu")
+        x = Tensor(
+            jnp.asarray(np.random.RandomState(0).randn(2, 6, 32), jnp.float32),
+            stop_gradient=False,
+        )
+        out = ffn(mha(x))
+        assert out.shape == [2, 6, 32]
+        out.sum().backward()
+        assert mha.qkv_weight.grad is not None
+        assert ffn.linear1_weight.grad is not None
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("cls,kw", [
+        (paddle.optimizer.Adam, {"weight_decay": 0.01}),
+        (paddle.optimizer.AdamW, {"weight_decay": 0.05}),
+    ])
+    def test_multi_tensor_parity(self, cls, kw):
+        def build():
+            paddle.seed(3)
+            return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+        def train(use_mt):
+            net = build()
+            opt = cls(1e-2, parameters=net.parameters(),
+                      use_multi_tensor=use_mt, **kw)
+            rng = np.random.RandomState(0)
+            for _ in range(5):
+                x = Tensor(jnp.asarray(rng.randn(4, 8).astype(np.float32)))
+                y = Tensor(jnp.asarray(rng.randn(4, 4).astype(np.float32)))
+                loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            return {k: np.asarray(p.numpy()) for k, p in net.named_parameters()}
+
+        ref = train(False)
+        fused = train(True)
+        for k in ref:
+            np.testing.assert_allclose(fused[k], ref[k], rtol=2e-5, atol=1e-6,
+                                       err_msg=k)
+
+
+class TestLlama:
+    def test_forward_backward_and_converges(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(num_key_value_heads=2)
+        net = LlamaForCausalLM(cfg)
+        rng = np.random.RandomState(0)
+        ids = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16))))
+        labels = Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 16))))
+        opt = paddle.optimizer.AdamW(3e-3, parameters=net.parameters(),
+                                     use_multi_tensor=True)
+        first = None
+        for _ in range(25):
+            logits = net(ids)
+            loss = F.cross_entropy(
+                logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])
+            )
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(np.asarray(loss.numpy()))
+        final = float(np.asarray(loss.numpy()))
+        assert final < first * 0.5, (first, final)
+
+    def test_tied_embeddings_and_compiled_step(self):
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(1)
+        cfg = LlamaConfig.tiny(tie_word_embeddings=True)
+        net = LlamaForCausalLM(cfg)
+        assert net.lm_head is None
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+
+        def loss_fn(logits, labels):
+            return F.cross_entropy(
+                logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])
+            )
+
+        step = CompiledTrainStep(net, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 8)))
+        labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 8)))
+        losses = [
+            float(np.asarray(step([Tensor(ids)], [Tensor(labels)])[0].numpy()))
+            for _ in range(3)
+        ]
+        assert losses[-1] < losses[0]
