@@ -7,5 +7,6 @@ composed jnp elsewhere; XLA fusion makes the composed paths one kernel in
 compiled steps either way, so both tiers are "fused" in the sense that
 matters (no extra HBM round trips).
 """
+from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -1,0 +1,12 @@
+"""Mixture-of-experts with expert parallelism (config #5 surface).
+
+Reference parity: python/paddle/incubate/distributed/models/moe/
+(unverified, mount empty). See moe_layer.py for the TPU-first design notes
+(stacked ep-sharded experts, einsum dispatch -> XLA all-to-all).
+"""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .grad_clip import (  # noqa: F401
+    ClipGradForMOEByGlobalNorm,
+    ClipGradForMoEByGlobalNorm,
+)
+from .moe_layer import ExpertLayer, MoELayer  # noqa: F401

@@ -1,0 +1,29 @@
+"""Expert-aware global-norm gradient clipping.
+
+Reference parity: python/paddle/incubate/distributed/models/moe/grad_clip.py
+(ClipGradForMOEByGlobalNorm — unverified, mount empty). In the reference,
+each rank of the moe_group owns a DIFFERENT slice of the experts, so the
+correct global norm is sqrt(|shared|^2 + allreduce_ep(|local experts|^2))
+— a hand-written norm partition + collective.
+
+TPU redesign: parameters (including stacked expert weights) are global
+jax.Arrays under SPMD — every process addresses the full logical tensor and
+XLA partitions the norm reduction across shards automatically. The plain
+global norm therefore IS the expert-aware norm; this subclass only keeps
+the reference constructor surface. It remains a ClipGradByGlobalNorm
+instance, so CompiledTrainStep fuses it into the compiled step unchanged.
+"""
+from __future__ import annotations
+
+from .....optimizer.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
+
+
+ClipGradForMoEByGlobalNorm = ClipGradForMOEByGlobalNorm

@@ -1,0 +1,284 @@
+"""MoE / expert parallelism tests (config #5).
+
+Reference parity target: test/collective/fleet/ MoE worker scripts +
+python/paddle/incubate/distributed/models/moe tests (unverified, mount
+empty): gate routing/capacity semantics vs a numpy oracle, MoE layer
+output parity vs per-token expert evaluation, and ep-sharded compiled
+training parity vs a replicated gold run on the virtual 8-device mesh.
+"""
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.incubate.distributed.models.moe import (
+    ClipGradForMOEByGlobalNorm,
+    ExpertLayer,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+)
+from paddle_tpu.jit.trainer import CompiledTrainStep
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+REPLICATED = SimpleNamespace(mesh_axis="pp")  # pp degree 1 -> no ep sharding
+
+
+@pytest.fixture(scope="module")
+def hcg():
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [2, 1, 1, 1, 4]
+    )
+    return HybridCommunicateGroup(topo)
+
+
+# --------------------------------------------------------------- gate math
+def test_switch_gate_routing_oracle(hcg):
+    paddle.seed(1)
+    d, e, n = 8, 4, 16
+    gate = SwitchGate(d, e, capacity_factor=(8.0, 8.0))
+    x = paddle.randn([n, d])
+    combine, dispatch, aux = gate(x)
+    w = np.asarray(gate.weight.numpy())
+    probs = _softmax(np.asarray(x.numpy()) @ w)
+    idx = probs.argmax(-1)
+
+    disp = np.asarray(dispatch.numpy())
+    comb = np.asarray(combine.numpy())
+    # every token dispatched exactly once, to its argmax expert
+    assert np.allclose(disp.sum((1, 2)), 1.0)
+    assert np.array_equal(disp.sum(2).argmax(-1), idx)
+    # combine weight equals the (unnormalized) top-1 prob
+    np.testing.assert_allclose(
+        comb.sum((1, 2)), probs[np.arange(n), idx], rtol=1e-5
+    )
+    # no capacity slot double-booked
+    assert disp.sum(0).max() <= 1.0 + 1e-6
+    # balanced-ish aux loss near 1
+    assert 0.5 < float(aux.numpy()) < 2.0
+
+
+def test_switch_gate_capacity_drop(hcg):
+    paddle.seed(2)
+    d, e, n = 8, 2, 12
+    gate = SwitchGate(d, e, capacity_factor=(1.0 / 6.0, 1.0), min_capacity=1)
+    assert gate.capacity(n) == 1
+    x = paddle.randn([n, d])
+    combine, dispatch, _ = gate(x)
+    disp = np.asarray(dispatch.numpy())
+    # exactly one token kept per expert (capacity 1), everything else dropped
+    assert disp.sum() <= e + 1e-6
+    per_tok = disp.sum((1, 2))
+    assert set(np.round(per_tok).astype(int)) <= {0, 1}
+    # the kept token per expert is the FIRST one routed there (cumsum priority)
+    w = np.asarray(gate.weight.numpy())
+    idx = _softmax(np.asarray(x.numpy()) @ w).argmax(-1)
+    for ex in range(e):
+        routed = np.where(idx == ex)[0]
+        if len(routed):
+            assert per_tok[routed[0]] == 1.0
+
+
+def test_gshard_gate_top2_weights(hcg):
+    paddle.seed(3)
+    d, e, n = 8, 4, 10
+    gate = GShardGate(d, e, capacity_factor=(8.0, 8.0))
+    x = paddle.randn([n, d])
+    combine, dispatch, aux = gate(x)
+    disp = np.asarray(dispatch.numpy())
+    comb = np.asarray(combine.numpy())
+    # each token goes to exactly two experts, combine sums to 1 (normalized)
+    assert np.allclose(disp.sum((1, 2)), 2.0)
+    np.testing.assert_allclose(comb.sum((1, 2)), 1.0, rtol=1e-5)
+    w = np.asarray(gate.weight.numpy())
+    probs = _softmax(np.asarray(x.numpy()) @ w)
+    top2 = np.argsort(-probs, -1)[:, :2]
+    assert np.array_equal(np.sort(disp.sum(2), -1)[:, -2:] > 0.5,
+                          np.ones((n, 2), bool))
+    # dispatched experts match numpy top-2
+    got = np.argsort(-disp.sum(2), -1)[:, :2]
+    assert np.array_equal(np.sort(got, -1), np.sort(top2, -1))
+
+
+# ------------------------------------------------------------ layer parity
+def test_moe_top1_matches_per_token_expert(hcg):
+    paddle.seed(4)
+    d, h, e = 8, 16, 4
+    moe = MoELayer(d_model=d, num_expert=e, d_hidden=h,
+                   gate={"type": "switch", "capacity_factor": (8.0, 8.0)},
+                   moe_group=REPLICATED)
+    x = paddle.randn([3, 5, d])
+    y = np.asarray(moe(x).numpy())
+
+    xv = np.asarray(x.numpy()).reshape(-1, d)
+    wg = np.asarray(moe.gate.weight.numpy())
+    w1 = np.asarray(moe.w1.numpy())
+    b1 = np.asarray(moe.b1.numpy())
+    w2 = np.asarray(moe.w2.numpy())
+    b2 = np.asarray(moe.b2.numpy())
+    probs = _softmax(xv @ wg)
+    idx = probs.argmax(-1)
+
+    def gelu(v):
+        from scipy.special import erf
+
+        return v * 0.5 * (1 + erf(v / np.sqrt(2)))
+
+    exp = np.zeros_like(xv)
+    for t in range(xv.shape[0]):
+        ex = idx[t]
+        o = gelu(xv[t] @ w1[ex] + b1[ex]) @ w2[ex] + b2[ex]
+        exp[t] = probs[t, ex] * o
+    np.testing.assert_allclose(y.reshape(-1, d), exp, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_top2_matches_per_token_experts(hcg):
+    paddle.seed(5)
+    d, h, e = 8, 16, 4
+    moe = MoELayer(d_model=d, num_expert=e, d_hidden=h,
+                   gate={"type": "gshard", "capacity_factor": (8.0, 8.0)},
+                   moe_group=REPLICATED)
+    x = paddle.randn([2, 4, d])
+    y = np.asarray(moe(x).numpy())
+
+    xv = np.asarray(x.numpy()).reshape(-1, d)
+    wg = np.asarray(moe.gate.weight.numpy())
+    w1, b1 = np.asarray(moe.w1.numpy()), np.asarray(moe.b1.numpy())
+    w2, b2 = np.asarray(moe.w2.numpy()), np.asarray(moe.b2.numpy())
+    probs = _softmax(xv @ wg)
+
+    def gelu(v):
+        from scipy.special import erf
+
+        return v * 0.5 * (1 + erf(v / np.sqrt(2)))
+
+    exp = np.zeros_like(xv)
+    for t in range(xv.shape[0]):
+        i1, i2 = np.argsort(-probs[t])[:2]
+        p1, p2 = probs[t, i1], probs[t, i2]
+        g1, g2 = p1 / (p1 + p2 + 1e-9), p2 / (p1 + p2 + 1e-9)
+        o1 = gelu(xv[t] @ w1[i1] + b1[i1]) @ w2[i1] + b2[i1]
+        o2 = gelu(xv[t] @ w1[i2] + b1[i2]) @ w2[i2] + b2[i2]
+        exp[t] = g1 * o1 + g2 * o2
+    np.testing.assert_allclose(y.reshape(-1, d), exp, rtol=2e-4, atol=2e-5)
+
+
+def test_custom_experts_match_stacked(hcg):
+    """The arbitrary-expert loop path computes the same function as the
+    stacked fast path when the weights agree."""
+    paddle.seed(6)
+    d, h, e = 8, 16, 4
+    experts = [ExpertLayer(d, h) for _ in range(e)]
+    moe_loop = MoELayer(d_model=d, experts=experts,
+                        gate={"type": "gshard", "capacity_factor": (8.0, 8.0)},
+                        moe_group=REPLICATED)
+    moe_fast = MoELayer(d_model=d, num_expert=e, d_hidden=h,
+                        gate={"type": "gshard", "capacity_factor": (8.0, 8.0)},
+                        moe_group=REPLICATED)
+    import jax.numpy as jnp
+
+    moe_fast.gate.weight.set_value(moe_loop.gate.weight)
+    moe_fast.w1.value = jnp.stack([ex.htoh4.weight.value for ex in experts])
+    moe_fast.b1.value = jnp.stack([ex.htoh4.bias.value for ex in experts])
+    moe_fast.w2.value = jnp.stack([ex.h4toh.weight.value for ex in experts])
+    moe_fast.b2.value = jnp.stack([ex.h4toh.bias.value for ex in experts])
+
+    x = paddle.randn([2, 5, d])
+    np.testing.assert_allclose(
+        np.asarray(moe_loop(x).numpy()), np.asarray(moe_fast(x).numpy()),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_naive_gate_no_drop(hcg):
+    paddle.seed(7)
+    d, e, n = 8, 4, 64
+    gate = NaiveGate(d, e, top_k=2)
+    x = paddle.randn([n, d])
+    combine, dispatch, aux = gate(x)
+    assert float(aux.numpy()) == 0.0
+    assert np.allclose(np.asarray(dispatch.numpy()).sum((1, 2)), 2.0)
+
+
+# -------------------------------------------------- ep-sharded training
+class MoeLM(nn.Layer):
+    def __init__(self, vocab, d, h, e, moe_group=None):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, d)
+        self.moe = MoELayer(d_model=d, num_expert=e, d_hidden=h,
+                            gate={"type": "gshard",
+                                  "capacity_factor": (2.0, 2.0)},
+                            moe_group=moe_group)
+        self.head = nn.Linear(d, vocab)
+
+    def forward(self, ids):
+        return self.head(self.moe(self.emb(ids)))
+
+
+def _train_losses(moe_group, steps=4, clip=None):
+    VOCAB, D, H, E, B, S = 16, 8, 16, 4, 4, 6
+    paddle.seed(42)
+    net = MoeLM(VOCAB, D, H, E, moe_group=moe_group)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=net.parameters(), grad_clip=clip
+    )
+
+    def loss_fn(logits, labels):
+        ce = F.cross_entropy(
+            logits.reshape([-1, VOCAB]), labels.reshape([-1])
+        )
+        return ce + 0.01 * net.moe.l_aux
+
+    step = CompiledTrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    # fixed batch: the loss trajectory must strictly improve (memorization)
+    ids = jnp.asarray(rng.randint(0, VOCAB, (B, S)))
+    labels = jnp.asarray(rng.randint(0, VOCAB, (B, S)))
+    losses = []
+    for _ in range(steps):
+        loss, _ = step([Tensor(ids)], [Tensor(labels)])
+        losses.append(float(np.asarray(loss.numpy())))
+    return losses
+
+
+def test_moe_compiled_ep_parity_vs_replicated(hcg):
+    """Experts sharded over the dp axis (the default ep fold) must train
+    bit-comparably to the replicated gold run — XLA's all-to-all dispatch
+    is a layout change, not a math change."""
+    gold = _train_losses(REPLICATED)
+    ep = _train_losses(None)  # default: fold experts over dp (degree 2)
+    np.testing.assert_allclose(gold, ep, rtol=1e-4)
+    assert gold[-1] < gold[0]  # actually trains
+
+
+def test_moe_expert_params_sharded(hcg):
+    moe = MoELayer(d_model=8, num_expert=4, d_hidden=16)
+    import jax
+    from jax.sharding import NamedSharding
+
+    s = moe.w1.value.sharding
+    assert isinstance(s, NamedSharding)
+    assert s.spec[0] == "dp"
+
+
+def test_moe_grad_clip_compiled(hcg):
+    clip = ClipGradForMOEByGlobalNorm(0.5)
+    losses = _train_losses(None, clip=clip)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
