@@ -414,6 +414,7 @@ class Parameter(Tensor):
         "is_distributed",
         "need_clip",
         "split_axis",
+        "sequence_parallel",
     )
 
     def __init__(self, value, trainable=True, name=None):
@@ -424,6 +425,7 @@ class Parameter(Tensor):
         self.is_distributed = False
         self.need_clip = True
         self.split_axis = None  # set by TP layers (mp partition axis)
+        self.sequence_parallel = False  # set by SP's mark_as_... helper
         self.persistable = True
 
 

@@ -4,3 +4,4 @@
 path for activation recomputation.
 """
 from ..recompute.recompute import recompute, recompute_sequential  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401

@@ -1,0 +1,192 @@
+"""Megatron-style sequence parallelism (SP) utilities.
+
+Reference parity: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (unverified, mount empty): ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp autograd functions plus
+ColumnSequenceParallelLinear / RowSequenceParallelLinear and the
+sequence-parallel parameter grad-allreduce hooks.
+
+TPU-first redesign (GSPMD): SP shards *activations* along the sequence dim
+over the same ``mp`` axis the TP weights use, so the LayerNorm/dropout
+regions between the Megatron matmuls hold only S/mp of the sequence. Where
+the reference hand-writes allgather-before-qkv / reduce-scatter-after-proj,
+here the layers stamp sharding constraints:
+
+    seq-sharded  P(None, 'mp', None)   (LayerNorm / dropout / residual)
+      -- ColumnSequenceParallelLinear: constraint to seq-replicated
+         (XLA inserts the allgather), matmul with P(None, 'mp') weight,
+         output P(None, None, 'mp')
+      -- RowSequenceParallelLinear: matmul with P('mp', None) weight,
+         output constrained back to P(None, 'mp', None) — XLA lowers the
+         partial-sum + re-shard to ONE reduce-scatter (the Megatron-SP
+         trick: same bytes as TP's allreduce, but the result is seq-sharded)
+
+The Scatter/Gather op surface is kept: under GSPMD each is just a sharding
+constraint whose gradient is the transposed constraint, which jax derives.
+Activations stay logically global, so code written against the reference
+API (explicit split/allgather bookkeeping) maps onto whole-array ops.
+
+Parameter grad sync: with global parameters under SPMD, gradients of
+replicated params (LayerNorm scales inside the seq-sharded region) are
+already correct — XLA reduces across the mp axis when lowering. The
+mark/register hook APIs are therefore kept as no-op markers for parity.
+"""
+from __future__ import annotations
+
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ....parallel import mesh as mesh_mod
+from ..meta_parallel.parallel_layers.mp_layers import (
+    _mp_axis,
+    _mp_degree,
+    _place,
+    shard_constraint,
+)
+
+
+def _seq_spec(t, axis):
+    """P(None, axis, None, ...) — sequence dim of a [B, S, ...] tensor."""
+    return [None, axis] + [None] * (len(t.shape) - 2)
+
+
+class ScatterOp:
+    """Forward: shard the sequence dim over mp; backward: the transposed
+    constraint (an allgather of the cotangent). Reference API is a static
+    ``apply``."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        mp = _mp_axis(None)
+        spec = [None] * len(x.shape)
+        spec[axis] = mp
+        return shard_constraint(x, *spec)
+
+
+class GatherOp:
+    """Forward: replicate the sequence dim (allgather); backward: re-shard
+    the cotangent (a scatter)."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        return shard_constraint(x, *([None] * len(x.shape)))
+
+
+# reference aliases: in GSPMD form allgather==gather and the reduce-scatter
+# materializes from the Row layer's output constraint
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def scatter(x, axis=1):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x, axis=1):
+    return GatherOp.apply(x, axis)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """ColumnParallelLinear whose input arrives sequence-sharded: the
+    implied allgather over S happens on entry (XLA inserts it), output
+    stays sharded on the feature dim over mp."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self._world_size = _mp_degree(self._axis)
+        self.gather_output = gather_output
+        if out_features % max(self._world_size, 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} must be divisible by the "
+                f"mp degree {self._world_size}"
+            )
+        self.weight = _place(
+            self.create_parameter(
+                [in_features, out_features], attr=weight_attr,
+                default_initializer=I.XavierUniform(
+                    fan_in=in_features, fan_out=out_features
+                ),
+            ),
+            None, self._axis,
+        )
+        self.bias = None
+        if has_bias is None or has_bias:
+            self.bias = _place(
+                self.create_parameter([out_features], is_bias=True),
+                self._axis,
+            )
+
+    def forward(self, x):
+        # allgather the sequence shards (constraint to seq-replicated)
+        x = shard_constraint(x, *([None] * len(x.shape)))
+        y = F.linear(x, self.weight, self.bias)
+        lead = [None] * (len(y.shape) - 1)
+        if self.gather_output:
+            return shard_constraint(y, *lead)
+        return shard_constraint(y, *lead, self._axis)
+
+
+class RowSequenceParallelLinear(Layer):
+    """RowParallelLinear whose output leaves sequence-sharded: the
+    partial-sum reduce and the sequence re-shard fuse into one
+    reduce-scatter (XLA lowers the output constraint)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self._world_size = _mp_degree(self._axis)
+        self.input_is_parallel = input_is_parallel
+        if in_features % max(self._world_size, 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} must be divisible by the "
+                f"mp degree {self._world_size}"
+            )
+        self.weight = _place(
+            self.create_parameter(
+                [in_features, out_features], attr=weight_attr,
+                default_initializer=I.XavierUniform(
+                    fan_in=in_features, fan_out=out_features
+                ),
+            ),
+            self._axis, None,
+        )
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = shard_constraint(
+                x, *([None] * (len(x.shape) - 1)), self._axis
+            )
+        y = F.linear(x, self.weight)
+        # reduce-scatter: partial sums over mp -> seq-sharded output
+        y = shard_constraint(y, *_seq_spec(y, self._axis))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Mark a parameter (e.g. a LayerNorm scale used inside the
+    seq-sharded region) as sequence-parallel. Under SPMD with global
+    parameters the grad reduction over mp is inserted by XLA, so the mark
+    is metadata-only (kept for reference API parity and introspection)."""
+    parameter.sequence_parallel = True
+    return parameter
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """No-op under SPMD (grad reduction is compiled into the step); kept
+    so reference training scripts run unchanged."""
+    return model

@@ -176,16 +176,33 @@ class MoELayer(Layer):
 
         return shard_constraint(t, self._ep, *( [None] * (len(t.shape) - 1)))
 
-    def _expert_compute(self, dispatched):
+    def _stacked_ffn(self, dispatched, w1, b1, w2, b2):
+        """Pure-args form so recompute() threads the weights (a closure
+        would treat them as constants and drop their gradients)."""
+        h = ops_math.matmul(dispatched, w1)  # [E,C,h]
+        h = self._act(h + b1.unsqueeze(1))
+        return ops_math.matmul(h, w2) + b2.unsqueeze(1)
+
+    def _expert_compute(self, dispatched, use_recompute=False):
         """dispatched [E, C, d] -> expert outputs [E, C, d]."""
+        if use_recompute:
+            from .....distributed.fleet.recompute import recompute
+
         if self._stacked:
-            h = ops_math.matmul(dispatched, self.w1)  # [E,C,h]
-            h = self._act(h + self.b1.unsqueeze(1))
-            out = ops_math.matmul(h, self.w2) + self.b2.unsqueeze(1)
-            return out
+            if use_recompute:
+                return recompute(self._stacked_ffn, dispatched,
+                                 self.w1, self.b1, self.w2, self.b2)
+            return self._stacked_ffn(
+                dispatched, self.w1, self.b1, self.w2, self.b2
+            )
         outs = []
         for e in range(self.num_expert):
-            outs.append(self.experts[e](dispatched[e]))
+            # per-expert recompute: the expert IS a Layer, so its
+            # parameters are threaded into the checkpointed function
+            if use_recompute:
+                outs.append(recompute(self.experts[e], dispatched[e]))
+            else:
+                outs.append(self.experts[e](dispatched[e]))
         from .....ops.manipulation import stack
 
         return stack(outs, axis=0)
@@ -203,12 +220,10 @@ class MoELayer(Layer):
             "nec,nd->ecd", dispatch.cast(x2.dtype), x2)
         dispatched = self._ep_constraint(dispatched)
 
-        if self.recompute_interval and self.training:
-            from .....distributed.fleet.recompute import recompute
-
-            out = recompute(self._expert_compute, dispatched)
-        else:
-            out = self._expert_compute(dispatched)
+        out = self._expert_compute(
+            dispatched,
+            use_recompute=bool(self.recompute_interval) and self.training,
+        )
         out = self._ep_constraint(out)
 
         # expert outputs -> original token order, gate-weighted

@@ -231,3 +231,18 @@ def fused_bias_dropout_residual_layer_norm(
     shape = (int(h.shape[-1]),)
     return F.layer_norm(h, shape, weight=ln_scale, bias=ln_bias,
                         epsilon=ln_epsilon)
+
+
+def ring_flash_attention(q, k, v, causal=True, axis=None, name=None):
+    """Exact attention over a sep-sharded sequence (ring KV rotation).
+    See paddle_tpu.parallel.sep_ops for the design notes."""
+    from ....parallel.sep_ops import ring_flash_attention as _ring
+
+    return _ring(q, k, v, causal=causal, axis=axis)
+
+
+def ulysses_attention(q, k, v, causal=True, axis=None, name=None):
+    """Exact attention over a sep-sharded sequence (head<->seq all-to-all)."""
+    from ....parallel.sep_ops import ulysses_attention as _uly
+
+    return _uly(q, k, v, causal=causal, axis=axis)

@@ -16,3 +16,4 @@ from .mesh import (  # noqa: F401
     set_mesh,
 )
 from . import collectives  # noqa: F401
+from .sep_ops import ring_flash_attention, ulysses_attention  # noqa: F401
