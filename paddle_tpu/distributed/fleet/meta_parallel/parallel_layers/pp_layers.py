@@ -55,11 +55,10 @@ class PipelineLayer(Layer):
                  loss_fn=None, seg_method="uniform", recompute_interval=0,
                  recompute_ctx=None, num_virtual_pipeline_stages=None):
         super().__init__()
-        if num_virtual_pipeline_stages not in (None, 1):
-            raise NotImplementedError(
-                "interleaved virtual pipeline stages: use the compiled "
-                "stacked-stage pipeline (paddle_tpu.parallel.pipeline)"
-            )
+        # interleaved virtual stages are honored by the COMPILED schedule
+        # (jit.pipeline_trainer / pipeline_configs["compiled"]); the eager
+        # engine runs items in order either way (same math)
+        self._num_virtual = int(num_virtual_pipeline_stages or 1)
         self._descs = list(layers)
         self._topology = topology
         if num_stages is None:
@@ -139,7 +138,7 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def get_num_virtual_stages(self):
-        return 1
+        return self._num_virtual
 
     def stage_items(self, stage):
         lo, hi = self._stage_bounds[stage], self._stage_bounds[stage + 1]

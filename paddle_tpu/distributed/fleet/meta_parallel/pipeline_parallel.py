@@ -62,6 +62,12 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(pipe_cfg.get("accumulate_steps", 1))
         self.num_stages = layers.num_stages
         self.stage_id = hcg.get_stage_id() if hcg is not None else 0
+        # compiled schedule: ONE jitted step running the ppermute ring
+        # (jit.pipeline_trainer); the eager engine below stays the
+        # debug/correctness path
+        self._use_compiled = bool(pipe_cfg.get("compiled", False))
+        self._compiled_amp = pipe_cfg.get("amp_level", None)
+        self._compiled_step = None
 
     # re-expose the wrapped model
     def forward(self, *args, **kwargs):
@@ -94,6 +100,40 @@ class PipelineParallel(Layer):
             loss = scaler.scale(loss)
         return loss
 
+    def _train_batch_compiled(self, inputs, labels, optimizer,
+                              lr_scheduler=None, scaler=None):
+        if scaler is not None:
+            raise NotImplementedError(
+                "GradScaler is not supported on the compiled pipeline "
+                "path; use pipeline_configs['amp_level']='O2' (bf16)"
+            )
+        if (self._compiled_step is not None
+                and self._compiled_step.optimizer is not optimizer):
+            # a fresh optimizer (e.g. after resume) needs a rebuilt step —
+            # the jitted update is bound to the optimizer's accumulators
+            self._compiled_step = None
+        if self._compiled_step is None:
+            from ....jit.pipeline_trainer import CompiledPipelineTrainStep
+
+            model = self._layers
+            if model._loss_fn is None:
+                raise ValueError(
+                    "PipelineLayer needs loss_fn for train_batch"
+                )
+            self._compiled_step = CompiledPipelineTrainStep(
+                model,
+                lambda out, *lbls: model._loss_fn(out, *lbls),
+                optimizer,
+                micro_batches=self.accumulate_steps,
+                num_virtual=model.get_num_virtual_stages(),
+                amp_level=self._compiled_amp,
+            )
+        self._layers.train()
+        loss, _ = self._compiled_step(inputs, labels)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """1F1B: warmup forwards, steady-state alternating 1F/1B, cooldown
         backwards. Single-process SPMD runs the same order the multi-chip
@@ -104,6 +144,11 @@ class PipelineParallel(Layer):
                   (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
         labels = [v if isinstance(v, Tensor) else Tensor(v) for v in
                   (labels if isinstance(labels, (list, tuple)) else [labels])]
+
+        if self._use_compiled:
+            return self._train_batch_compiled(
+                inputs, labels, optimizer, lr_scheduler, scaler
+            )
 
         acc = self.accumulate_steps
         micro_in = _split_microbatches(inputs, acc)

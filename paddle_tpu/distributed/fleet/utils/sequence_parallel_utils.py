@@ -34,13 +34,10 @@ mark/register hook APIs are therefore kept as no-op markers for parity.
 from __future__ import annotations
 
 from ....nn import functional as F
-from ....nn import initializer as I
-from ....nn.layer.layers import Layer
-from ....parallel import mesh as mesh_mod
 from ..meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
     _mp_axis,
-    _mp_degree,
-    _place,
     shard_constraint,
 )
 
@@ -86,50 +83,28 @@ def all_gather(x, axis=1):
     return GatherOp.apply(x, axis)
 
 
-class ColumnSequenceParallelLinear(Layer):
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
     """ColumnParallelLinear whose input arrives sequence-sharded: the
     implied allgather over S happens on entry (XLA inserts it), output
-    stays sharded on the feature dim over mp."""
+    stays sharded on the feature dim over mp. Constructor surface
+    inherited from ColumnParallelLinear (gather_output defaults False in
+    the SP pattern)."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=None, gather_output=False, fuse_matmul_bias=False,
                  mp_group=None, name=None):
-        super().__init__()
-        self._axis = _mp_axis(mp_group)
-        self._world_size = _mp_degree(self._axis)
-        self.gather_output = gather_output
-        if out_features % max(self._world_size, 1) != 0:
-            raise ValueError(
-                f"out_features {out_features} must be divisible by the "
-                f"mp degree {self._world_size}"
-            )
-        self.weight = _place(
-            self.create_parameter(
-                [in_features, out_features], attr=weight_attr,
-                default_initializer=I.XavierUniform(
-                    fan_in=in_features, fan_out=out_features
-                ),
-            ),
-            None, self._axis,
-        )
-        self.bias = None
-        if has_bias is None or has_bias:
-            self.bias = _place(
-                self.create_parameter([out_features], is_bias=True),
-                self._axis,
-            )
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
 
     def forward(self, x):
         # allgather the sequence shards (constraint to seq-replicated)
         x = shard_constraint(x, *([None] * len(x.shape)))
-        y = F.linear(x, self.weight, self.bias)
-        lead = [None] * (len(y.shape) - 1)
-        if self.gather_output:
-            return shard_constraint(y, *lead)
-        return shard_constraint(y, *lead, self._axis)
+        return super().forward(x)
 
 
-class RowSequenceParallelLinear(Layer):
+class RowSequenceParallelLinear(RowParallelLinear):
     """RowParallelLinear whose output leaves sequence-sharded: the
     partial-sum reduce and the sequence re-shard fuse into one
     reduce-scatter (XLA lowers the output constraint)."""
@@ -137,27 +112,11 @@ class RowSequenceParallelLinear(Layer):
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=True,
                  fuse_matmul_bias=False, mp_group=None, name=None):
-        super().__init__()
-        self._axis = _mp_axis(mp_group)
-        self._world_size = _mp_degree(self._axis)
-        self.input_is_parallel = input_is_parallel
-        if in_features % max(self._world_size, 1) != 0:
-            raise ValueError(
-                f"in_features {in_features} must be divisible by the "
-                f"mp degree {self._world_size}"
-            )
-        self.weight = _place(
-            self.create_parameter(
-                [in_features, out_features], attr=weight_attr,
-                default_initializer=I.XavierUniform(
-                    fan_in=in_features, fan_out=out_features
-                ),
-            ),
-            self._axis, None,
-        )
-        self.bias = None
-        if has_bias:
-            self.bias = self.create_parameter([out_features], is_bias=True)
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias,
+                         input_is_parallel=input_is_parallel,
+                         fuse_matmul_bias=fuse_matmul_bias,
+                         mp_group=mp_group, name=name)
 
     def forward(self, x):
         if self.input_is_parallel:

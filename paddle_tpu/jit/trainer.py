@@ -88,6 +88,12 @@ class CompiledTrainStep:
             elif self._kind is opt_mod.Momentum:
                 opt._set_acc(p, "velocity", accs[0])
 
+    def _forward_traced(self, inputs):
+        """Network invocation inside the traced step (hook: the pipeline
+        trainer overrides this to run the stacked-stage shard_map
+        schedule instead of the sequential forward)."""
+        return self.network(*(Tensor(v) for v in inputs))
+
     # ----------------------------------------------------------- pure step
     def _build(self):
         network = self.network
@@ -146,7 +152,7 @@ class CompiledTrainStep:
                 cm = contextlib.nullcontext()
             with tape.trace_scope(), tape.no_grad(), random_mod.key_scope(rng), cm:
                 network.train()
-                out = network(*(Tensor(v) for v in inputs))
+                out = self._forward_traced(inputs)
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 loss = loss_fn(*(list(outs) + [Tensor(v) for v in labels]))
             new_buffers = {k: b.value for k, b in network.named_buffers()}

@@ -1,28 +1,43 @@
 """Compiled SPMD pipeline parallelism: microbatch schedule over the pp
 mesh axis with ppermute activation rotation.
 
-Reference parity: the 1F1B/GPipe schedules of
+Reference parity: the GPipe/1F1B/interleaved schedules of
 python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py and the
 p2p machinery of pp_utils/p2p_communication.py (unverified, mount empty) —
 re-expressed the TPU way (SURVEY.md §7 hard part #2): stage weights are
 STACKED with the leading dim sharded over ``pp`` (stage s's chunk lives on
-pp rank s), and one jitted program runs the whole microbatch schedule:
+pp rank s), and ONE jitted program runs the whole microbatch schedule:
 
-  tick t: every stage applies its block-chunk to its current activation,
-  then the activations rotate one stage forward via lax.ppermute. Stage 0
-  injects microbatch t; the last stage's outputs are collected. XLA's
-  autodiff reverses the schedule (reverse ppermutes) for the backward
-  pass, yielding the pipelined backward wave of the reference's 1F1B
-  without hand-written p2p.
+  tick t: every stage applies its current block-chunk to its current
+  activation, then activations rotate one stage forward via lax.ppermute
+  (the ring wraps, so multi-pass interleaved schedules need no extra
+  plumbing). Stage 0 injects microbatches; the last stage's outputs are
+  collected. XLA's autodiff reverses the schedule (reverse ppermutes) for
+  the backward pass, yielding the pipelined backward wave of the
+  reference's 1F1B without hand-written p2p.
 
-The eager/API engine (fleet.meta_parallel.PipelineParallel) drives the
-same schedule imperatively; this module is the compiled perf path.
+Interleaved virtual pipeline (reference: num_virtual_pipeline_stages>1,
+Megatron-style): with v virtual stages per device, device d owns model
+chunks c=0..v-1 holding blocks of virtual stage j = c*S + d, and each
+activation makes v passes around the ring. The schedule assigns device d
+at tick t the work item derived from local time r = t - d:
+
+    s = r // S ;  c = s % v ;  m = (s // v) * S + r % S
+
+which is conflict-free (each device processes exactly one chunk per tick),
+dependency-exact (the ppermute ring delivers the wrapped activation of
+chunk c-1 precisely one tick before chunk c needs it — no buffering), and
+cuts the fill/drain bubble from v*(S-1) to 2*(S-1) chunk-ticks: the
+interleaved win, with microbatch m's result ready at tick
+S*((v-1) + (m//S)*v) + m%S + (S-1).
+
+``remat=True`` wraps each block in jax.checkpoint (activation recompute in
+the backward wave — the reference's recompute_interval inside pp stages).
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-
 
 
 def stack_stage_params(per_stage_params):
@@ -33,75 +48,150 @@ def stack_stage_params(per_stage_params):
     )
 
 
+def stack_block_params(per_block, num_stages, num_virtual=1):
+    """[block0_tree, ... block{L-1}_tree] -> one tree with leading dims
+    [S, k] (v==1) or [S, v, k] (v>1), where L = S*v*k and block
+    j = (c*S + d)*k + i lands at [d, c, i] — i.e. device d's chunk c holds
+    the k consecutive blocks of virtual stage c*S + d. Shard dim 0 over pp
+    when placing."""
+    L = len(per_block)
+    S, v = int(num_stages), int(num_virtual)
+    if L % (S * v) != 0:
+        raise ValueError(
+            f"{L} blocks cannot tile {S} stages x {v} virtual chunks"
+        )
+    k = L // (S * v)
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_block
+    )
+    if v == 1:
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((S, k) + a.shape[1:]), stacked
+        )
+
+    def _arrange(a):
+        a = a.reshape((v, S, k) + a.shape[1:])  # axes (c, d, i, ...)
+        return jnp.moveaxis(a, 1, 0)  # -> (d, c, i, ...)
+
+    return jax.tree_util.tree_map(_arrange, stacked)
+
+
+def microbatch_ready_ticks(num_microbatches, num_stages, num_virtual=1):
+    """Tick at which microbatch m's final output appears on the last
+    stage (see module docstring schedule)."""
+    S, v = num_stages, num_virtual
+    return [
+        S * ((v - 1) + (m // S) * v) + m % S + (S - 1)
+        for m in range(num_microbatches)
+    ]
+
+
 def pipeline_apply(block_fn, chunk_params, h_mb, axis_name="pp",
-                   num_stages=None):
+                   num_stages=None, num_virtual=1, remat=False):
     """Run the microbatch pipeline INSIDE a shard_map over ``axis_name``.
 
     block_fn(one_block_params, x) -> x
-    chunk_params: local slice, leaves [1, blocks_per_stage, ...] (the
-        shard_map in_spec puts the stage dim first; squeezed here)
+    chunk_params: local slice, leaves [1, k, ...] (v==1) or [1, v, k, ...]
+        (the shard_map in_spec puts the stage dim first; squeezed here)
     h_mb: [M, ...microbatch...] activations entering stage 0 (replicated
         over the pp axis)
-    Returns [M, ...] outputs of the LAST stage, replicated over pp.
+    Returns [M, ...] outputs of the LAST (virtual) stage, replicated
+    over pp.
     """
     S = num_stages
+    v = int(num_virtual)
     M = h_mb.shape[0]
-    s = jax.lax.axis_index(axis_name)
+    s_idx = jax.lax.axis_index(axis_name)
     chunk = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), chunk_params)
+    bf = jax.checkpoint(block_fn) if remat else block_fn
 
-    def chunk_apply(x):
+    def chunk_apply(blocks, x):
         def body(h, blk):
-            return block_fn(blk, h), None
+            return bf(blk, h), None
 
-        h, _ = jax.lax.scan(body, x, chunk)
+        h, _ = jax.lax.scan(body, x, blocks)
         return h
 
-    perm = [(i, i + 1) for i in range(S - 1)]
+    if S <= 1:
+        perm = None
+    elif v > 1:
+        # full ring: the wrap edge carries multi-pass activations
+        perm = [(i, (i + 1) % S) for i in range(S)]
+    else:
+        # v==1: stage 0 always injects, so skip the dead wrap transfer
+        perm = [(i, i + 1) for i in range(S - 1)]
 
     def tick(recv, t):
-        x0 = h_mb[jnp.minimum(t, M - 1)]
-        x_in = jnp.where(s == 0, x0, recv)
-        y = chunk_apply(x_in)
+        r = jnp.maximum(t - s_idx, 0)  # local logical time
+        sq = r // S
+        c = sq % v
+        m = (sq // v) * S + r % S
+        x0 = h_mb[jnp.clip(m, 0, M - 1)]
+        inject = jnp.logical_and(s_idx == 0, c == 0)
+        x_in = jnp.where(inject, x0, recv)
+        if v == 1:
+            y = chunk_apply(chunk, x_in)
+        else:
+            blk = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, c, 0, keepdims=False
+                ),
+                chunk,
+            )
+            y = chunk_apply(blk, x_in)
         send = jax.lax.ppermute(y, axis_name, perm) if perm else y
         return send, y
 
+    touts = microbatch_ready_ticks(M, S, v)
     _, ys = jax.lax.scan(
         tick, jnp.zeros(h_mb.shape[1:], h_mb.dtype),
-        jnp.arange(M + S - 1),
+        jnp.arange(max(touts) + 1),
     )
-    outs = ys[S - 1 :]
+    outs = jnp.take(ys, jnp.asarray(touts), axis=0)
     # only the last stage holds real outputs; raw psum replicates them.
     # NOTE: under unchecked shard_map, a replicated out_spec's transpose
     # hands each device ct/n — and psum's transpose (psum) sums those n
     # pieces back to the full ct, so the pair is exactly grad-correct.
     # (Do NOT swap in an identity-bwd allreduce here; that halves grads.)
-    mask = (s == S - 1).astype(outs.dtype)
+    mask = (s_idx == S - 1).astype(outs.dtype)
     return jax.lax.psum(outs * mask, axis_name)
 
 
 def make_pipeline_fn(block_fn, num_stages, mesh, axis_name="pp",
-                     extra_in_specs=None):
+                     extra_in_specs=None, num_virtual=1, remat=False,
+                     manual_axes=None):
     """Build a jittable fn(stacked_params, h_mb) -> outs where
-    stacked_params leaves are [num_stages, blocks_per_stage, ...] sharded
-    over ``axis_name`` on dim 0, h_mb is [M, ...] (replicated over pp; may
-    carry other-axis shardings via ``extra_in_specs``)."""
+    stacked_params leaves are [num_stages, (v,) blocks, ...] sharded over
+    ``axis_name`` on dim 0, h_mb is [M, ...] (replicated over pp; may
+    carry other-axis shardings via ``extra_in_specs``).
+
+    manual_axes: axes the shard_map body is manual over (default: all mesh
+    axes). Pass {axis_name} to leave the other axes (dp/mp/...) in GSPMD
+    auto mode so sharding constraints inside block_fn keep working — the
+    TP-inside-PP composition path.
+    """
     from jax.sharding import PartitionSpec as P
 
     h_spec = extra_in_specs if extra_in_specs is not None else P()
 
     def fn(stacked_params, h_mb):
         body = lambda cp, h: pipeline_apply(
-            block_fn, cp, h, axis_name=axis_name, num_stages=num_stages
+            block_fn, cp, h, axis_name=axis_name, num_stages=num_stages,
+            num_virtual=num_virtual, remat=remat,
         )
         spec_params = jax.tree_util.tree_map(
             lambda _: P(axis_name), stacked_params
         )
+        kwargs = {}
+        if manual_axes is not None:
+            kwargs["axis_names"] = set(manual_axes)
         return jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(spec_params, h_spec),
             out_specs=h_spec,
             check_vma=False,
+            **kwargs,
         )(stacked_params, h_mb)
 
     return fn

@@ -260,3 +260,140 @@ class TestCompiledPipeline:
             np.testing.assert_allclose(
                 np.asarray(outs[m]), np.asarray(hm), rtol=1e-5, atol=1e-6
             )
+
+
+# ---------------------------------------------------------------- bridge
+class TestCompiledPipelineBridge:
+    """PipelineLayer driven by the compiled ppermute schedule
+    (jit.pipeline_trainer), wired through PipelineParallel.train_batch
+    with pipeline_configs={"compiled": True}."""
+
+    @pytest.fixture(scope="class")
+    def hcg_pp4(self):
+        topo = CommunicateTopology(
+            ["dp", "pp", "sharding", "sep", "mp"], [2, 4, 1, 1, 1]
+        )
+        return HybridCommunicateGroup(topo)
+
+    @staticmethod
+    def _descs8():
+        return (
+            [LayerDesc(nn.Linear, IN, HID)]
+            + [LayerDesc(Blk, HID) for _ in range(8)]
+            + [LayerDesc(nn.Linear, HID, OUT)]
+        )
+
+    def _run(self, hcg, compiled, virtual=1, acc=4, steps=4, recompute=0):
+        from types import SimpleNamespace
+
+        paddle.seed(77)
+        pipe = PipelineLayer(
+            self._descs8(), num_stages=hcg.get_pipe_parallel_world_size(),
+            loss_fn=_loss_fn, recompute_interval=recompute,
+            num_virtual_pipeline_stages=virtual,
+        )
+        opt = paddle.optimizer.AdamW(1e-2, parameters=pipe.parameters())
+        engine = PipelineParallel(
+            pipe, hcg,
+            SimpleNamespace(pipeline_configs={
+                "accumulate_steps": acc, "compiled": compiled,
+            }),
+        )
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(B, IN), jnp.float32)
+        y = jnp.asarray(rng.randn(B, OUT), jnp.float32)
+        return [
+            float(np.asarray(
+                engine.train_batch((Tensor(x), Tensor(y)), opt).numpy()
+            ))
+            for _ in range(steps)
+        ]
+
+    def test_compiled_matches_eager_engine(self, hcg_pp4):
+        eager = self._run(hcg_pp4, compiled=False)
+        comp = self._run(hcg_pp4, compiled=True)
+        np.testing.assert_allclose(eager, comp, rtol=2e-4, atol=1e-5)
+        assert comp[-1] < comp[0]
+
+    def test_interleaved_virtual_stages_match(self, hcg_pp4):
+        v1 = self._run(hcg_pp4, compiled=True, virtual=1)
+        v2 = self._run(hcg_pp4, compiled=True, virtual=2)
+        np.testing.assert_allclose(v1, v2, rtol=2e-4, atol=1e-5)
+
+    def test_compiled_with_remat_matches(self, hcg_pp4):
+        plain = self._run(hcg_pp4, compiled=True)
+        remat = self._run(hcg_pp4, compiled=True, recompute=1)
+        np.testing.assert_allclose(plain, remat, rtol=2e-4, atol=1e-5)
+
+    def test_rejects_undersized_block_run(self, hcg_pp4):
+        from paddle_tpu.jit.pipeline_trainer import CompiledPipelineTrainStep
+
+        paddle.seed(1)
+        pipe = PipelineLayer(_descs(), num_stages=4, loss_fn=_loss_fn)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=pipe.parameters())
+        with pytest.raises(ValueError, match="identical blocks"):
+            CompiledPipelineTrainStep(
+                pipe, lambda o, l: _loss_fn(o, l), opt,
+                micro_batches=2, num_virtual=2,
+            )
+
+
+class TestTPInsidePP:
+    """dp x pp x mp composition: Megatron TP blocks inside the compiled
+    pp ring (shard_map manual over pp only; mp stays GSPMD-auto)."""
+
+    @pytest.fixture(scope="class")
+    def hcg_hybrid(self):
+        topo = CommunicateTopology(
+            ["dp", "pp", "sharding", "sep", "mp"], [2, 2, 1, 1, 2]
+        )
+        return HybridCommunicateGroup(topo)
+
+    def _run(self, hcg, compiled, steps=4):
+        from types import SimpleNamespace
+
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        class TPBlk(nn.Layer):
+            def __init__(self, d):
+                super().__init__()
+                self.up = ColumnParallelLinear(d, 2 * d,
+                                               gather_output=False)
+                self.down = RowParallelLinear(2 * d, d,
+                                              input_is_parallel=True)
+
+            def forward(self, x):
+                return x + self.down(F.gelu(self.up(x)))
+
+        paddle.seed(78)
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, IN, HID)]
+            + [LayerDesc(TPBlk, HID) for _ in range(4)]
+            + [LayerDesc(nn.Linear, HID, OUT)],
+            num_stages=2, loss_fn=_loss_fn,
+        )
+        opt = paddle.optimizer.AdamW(1e-2, parameters=pipe.parameters())
+        engine = PipelineParallel(
+            pipe, hcg,
+            SimpleNamespace(pipeline_configs={
+                "accumulate_steps": 2, "compiled": compiled,
+            }),
+        )
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(B, IN), jnp.float32)
+        y = jnp.asarray(rng.randn(B, OUT), jnp.float32)
+        return [
+            float(np.asarray(
+                engine.train_batch((Tensor(x), Tensor(y)), opt).numpy()
+            ))
+            for _ in range(steps)
+        ]
+
+    def test_tp_blocks_inside_compiled_pp(self, hcg_hybrid):
+        eager = self._run(hcg_hybrid, compiled=False)
+        comp = self._run(hcg_hybrid, compiled=True)
+        np.testing.assert_allclose(eager, comp, rtol=2e-4, atol=1e-5)
+        assert comp[-1] < comp[0]
