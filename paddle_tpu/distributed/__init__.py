@@ -5,6 +5,7 @@ empty). The comm API is ProcessGroupICI-backed (XLA collectives over
 ICI/DCN); fleet/topology build the hybrid jax mesh; the compiled parallel
 path lives in paddle_tpu.parallel.
 """
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from .communication import (  # noqa: F401
     ReduceOp,

@@ -1,0 +1,7 @@
+"""paddle.distributed.checkpoint parity: sharded save/load + reshard.
+
+Reference: python/paddle/distributed/checkpoint/ (unverified, mount
+empty). See save_load.py for the TPU design notes.
+"""
+from .metadata import Metadata, ShardMeta, TensorMeta  # noqa: F401
+from .save_load import load_state_dict, save_state_dict  # noqa: F401

@@ -126,6 +126,13 @@ class CompiledPipelineTrainStep(CompiledTrainStep):
         for it in items[: self._blk_lo]:
             x = pl._run_item(it, x)
 
+        if not isinstance(x, Tensor):
+            raise NotImplementedError(
+                "the compiled pipeline schedule requires a single-tensor "
+                "activation entering the block run (got a tuple); fold "
+                "extra inputs into the blocks or use the eager engine "
+                "(pipeline_configs={'compiled': False})"
+            )
         M = self.micro_batches
         hv = x.value
         B = hv.shape[0]

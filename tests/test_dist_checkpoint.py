@@ -1,0 +1,211 @@
+"""Distributed checkpoint: sharded save + reshard-on-load.
+
+Reference parity target: python/paddle/distributed/checkpoint tests
+(unverified, mount empty) — save on one parallel layout, resume on
+another, values identical; optimizer state and scheduler scalars ride
+along.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint import (
+    load_state_dict,
+    save_state_dict,
+)
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from paddle_tpu.parallel import init_mesh
+
+
+def _mesh(dp, mp):
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [dp, 1, 1, 1, mp]
+    )
+    return HybridCommunicateGroup(topo).mesh
+
+
+class TPNet(nn.Layer):
+    def __init__(self, d=16, f=32):
+        super().__init__()
+        self.up = ColumnParallelLinear(d, f, gather_output=False)
+        self.down = RowParallelLinear(f, d, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x)))
+
+
+def test_save_reshard_load_roundtrip(tmp_path):
+    path = str(tmp_path / "ckpt")
+    _mesh(2, 4)
+    paddle.seed(100)
+    src = TPNet()
+    gold = {k: np.asarray(v.numpy()) for k, v in src.state_dict().items()}
+    # confirm the source really is mp-sharded
+    assert src.up.weight.value.sharding.spec == P(None, "mp")
+    save_state_dict(src.state_dict(), path)
+    assert os.path.exists(os.path.join(path, "metadata.json"))
+
+    # fresh process layout: dp4 x mp2 — different shard boxes
+    _mesh(4, 2)
+    paddle.seed(7)  # different init, must be overwritten by load
+    dst = TPNet()
+    load_state_dict(dst.state_dict(), path)
+    for k, v in dst.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()), gold[k])
+    # placements follow the NEW layout
+    assert dst.up.weight.value.sharding.mesh.shape["mp"] == 2
+
+
+def test_load_onto_single_device_mesh(tmp_path):
+    path = str(tmp_path / "ckpt")
+    _mesh(2, 4)
+    paddle.seed(101)
+    src = TPNet()
+    gold = {k: np.asarray(v.numpy()) for k, v in src.state_dict().items()}
+    save_state_dict(src.state_dict(), path)
+
+    _mesh(8, 1)  # mp degree 1: everything effectively replicated
+    paddle.seed(8)
+    dst = TPNet()
+    load_state_dict(dst.state_dict(), path)
+    for k, v in dst.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()), gold[k])
+
+
+def test_optimizer_state_and_scalars_roundtrip(tmp_path):
+    path = str(tmp_path / "ckpt")
+    _mesh(2, 4)
+    paddle.seed(102)
+    net = TPNet()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    # one real step so moments exist
+    x = paddle.randn([4, 16])
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+    state = {
+        "model": net.state_dict(),
+        "opt": opt.state_dict(),
+        "step": 3,
+        "lr": 0.125,
+    }
+    gold_opt = {
+        k: np.asarray(v.numpy()) if hasattr(v, "numpy") else v
+        for k, v in opt.state_dict().items()
+    }
+    save_state_dict(state, path)
+
+    _mesh(4, 2)
+    paddle.seed(9)
+    net2 = TPNet()
+    opt2 = paddle.optimizer.AdamW(1e-3, parameters=net2.parameters())
+    x2 = paddle.randn([4, 16])
+    ((net2(x2) ** 2).mean()).backward()
+    opt2.step()
+    opt2.clear_grad()
+    state2 = {
+        "model": net2.state_dict(),
+        "opt": opt2.state_dict(),
+        "step": 0,
+        "lr": 0.0,
+    }
+    load_state_dict(state2, path)
+    assert state2["step"] == 3
+    assert state2["lr"] == 0.125
+    for k, v in state2["opt"].items():
+        if hasattr(v, "numpy"):
+            np.testing.assert_array_equal(
+                np.asarray(v.numpy()), gold_opt[k]
+            )
+
+
+def test_missing_tensor_raises(tmp_path):
+    path = str(tmp_path / "ckpt")
+    _mesh(2, 4)
+    paddle.seed(103)
+    src = TPNet()
+    save_state_dict(src.state_dict(), path)
+    dst = {"not_there": Tensor(jnp.zeros([3, 3]))}
+    with pytest.raises(KeyError, match="missing tensors"):
+        load_state_dict(dst, path)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    path = str(tmp_path / "ckpt")
+    _mesh(2, 4)
+    save_state_dict({"w": Tensor(jnp.ones([4, 4]))}, path)
+    with pytest.raises(ValueError, match="shape"):
+        load_state_dict({"w": Tensor(jnp.ones([2, 2]))}, path)
+
+
+def test_training_resume_parity(tmp_path):
+    """Kill-and-resume: save mid-training on dp2 x mp4, restore on
+    dp4 x mp2, continue — loss trajectory matches the uninterrupted run."""
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    def make(seed):
+        paddle.seed(seed)
+        net = TPNet()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        step = CompiledTrainStep(
+            net, lambda o, y: ((o - y) ** 2).mean(), opt
+        )
+        return net, opt, step
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 16), jnp.float32)
+
+    # uninterrupted gold: 6 steps on dp2 x mp4
+    _mesh(2, 4)
+    net, opt, step = make(200)
+    gold = [
+        float(np.asarray(step([Tensor(x)], [Tensor(y)])[0].numpy()))
+        for _ in range(6)
+    ]
+
+    # run 3 steps, checkpoint, "crash"
+    _mesh(2, 4)
+    net, opt, step = make(200)
+    first = [
+        float(np.asarray(step([Tensor(x)], [Tensor(y)])[0].numpy()))
+        for _ in range(3)
+    ]
+    path = str(tmp_path / "resume")
+    save_state_dict({"model": net.state_dict(), "opt": opt.state_dict()},
+                    path)
+
+    # resume on a DIFFERENT mesh
+    _mesh(4, 2)
+    net2, opt2, step2 = make(201)
+    st = {"model": net2.state_dict(), "opt": opt2.state_dict()}
+    # moments must exist before load: prime with a throwaway step
+    prime = step2([Tensor(x)], [Tensor(y)])
+    st = {"model": net2.state_dict(), "opt": opt2.state_dict()}
+    load_state_dict(st, path)
+    # scalars (e.g. @step_count for Adam bias correction) live in the
+    # filled dict; hand them back to the optimizer object
+    opt2.set_state_dict(st["opt"])
+    rest = [
+        float(np.asarray(step2([Tensor(x)], [Tensor(y)])[0].numpy()))
+        for _ in range(3)
+    ]
+    np.testing.assert_allclose(first + rest, gold, rtol=2e-4)
