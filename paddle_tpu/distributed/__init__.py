@@ -5,8 +5,19 @@ empty). The comm API is ProcessGroupICI-backed (XLA collectives over
 ICI/DCN); fleet/topology build the hybrid jax mesh; the compiled parallel
 path lives in paddle_tpu.parallel.
 """
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    get_placements,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
 from .communication import (  # noqa: F401
     ReduceOp,
     all_gather,
