@@ -1,0 +1,166 @@
+"""Multiprocess DataLoader workers over shared-memory rings.
+
+Reference parity: python/paddle/io/dataloader/worker.py + the shared-
+memory queue transport (unverified, mount empty): forked worker
+processes fetch+collate batches and pass them to the parent without
+pickling the payload.
+
+TPU design notes:
+- Workers are FORKED, inheriting the dataset in-memory; they must stay
+  jax-free (jax runtimes do not survive fork), so worker-side collation
+  is numpy-only and the parent converts the zero-copy views to device
+  arrays (the host->device DMA reads straight out of the shared segment).
+- Batch i is produced by worker i % num_workers and the parent reads
+  rings round-robin, preserving the reference's deterministic ordering.
+- Record format: [u32 magic][u32 header_len][pickled (spec, leaf_meta)]
+  [64-aligned raw array bytes...]. Only the structure is pickled; the
+  array payload is memcpy'd once in the worker and viewed in the parent.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import sys
+
+import numpy as np
+
+_MAGIC = 0x50445452  # "PDTR"
+_ALIGN = 64
+
+
+def _align(n):
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def collate_numpy(batch):
+    """default_collate_fn semantics with numpy leaves (worker-side)."""
+    sample = batch[0]
+    if hasattr(sample, "value") and hasattr(sample, "stop_gradient"):
+        # catch paddle Tensors BEFORE the np.asarray fallback would
+        # invoke Tensor.__array__ -> jax inside the forked child
+        raise TypeError(
+            "multiprocess DataLoader workers must produce numpy, not "
+            "paddle Tensors (jax does not survive fork); return numpy "
+            "from the dataset or use use_shared_memory=False"
+        )
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return tuple(
+            collate_numpy(list(col)) for col in zip(*batch)
+        )
+    if isinstance(sample, dict):
+        return {k: collate_numpy([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return np.stack([np.asarray(s) for s in batch])
+
+
+def serialize_batch(batch):
+    """-> one bytes record: pickled structure + raw aligned array bytes."""
+    leaves = []
+
+    def enc(x):
+        if hasattr(x, "value") and hasattr(x, "stop_gradient"):
+            raise TypeError(
+                "multiprocess DataLoader workers must produce numpy, not "
+                "paddle Tensors (jax does not survive fork); return numpy "
+                "from the dataset/collate_fn or use num_workers with "
+                "use_shared_memory=False"
+            )
+        if isinstance(x, np.ndarray):
+            leaves.append(np.ascontiguousarray(x))
+            return ("a", len(leaves) - 1)
+        if isinstance(x, tuple):
+            return ("t", [enc(v) for v in x])
+        if isinstance(x, list):
+            return ("l", [enc(v) for v in x])
+        if isinstance(x, dict):
+            return ("d", {k: enc(v) for k, v in x.items()})
+        return ("o", x)
+
+    spec = enc(batch)
+    meta = [(l.dtype.str, l.shape, l.nbytes) for l in leaves]
+    header = pickle.dumps((spec, meta), protocol=pickle.HIGHEST_PROTOCOL)
+    off = _align(8 + len(header))
+    offsets = []
+    for l in leaves:
+        offsets.append(off)
+        off = _align(off + l.nbytes)
+    buf = bytearray(off)
+    struct.pack_into("<II", buf, 0, _MAGIC, len(header))
+    buf[8 : 8 + len(header)] = header
+    for l, o in zip(leaves, offsets):
+        buf[o : o + l.nbytes] = l.tobytes()  # one worker-side copy
+    return bytes(buf)
+
+
+def deserialize_batch(view, to_leaf):
+    """Rebuild the structure from a record view; array leaves become
+    ``to_leaf(np_view)`` where np_view is ZERO-COPY into the ring."""
+    magic, hlen = struct.unpack_from("<II", view, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt DataLoader record")
+    spec, meta = pickle.loads(bytes(memoryview(view)[8 : 8 + hlen]))
+    off = _align(8 + hlen)
+    arrays = []
+    for dtype, shape, nbytes in meta:
+        arr = np.frombuffer(view, dtype=np.dtype(dtype), count=int(
+            np.prod(shape)) if shape else 1, offset=off).reshape(shape)
+        arrays.append(arr)
+        off = _align(off + nbytes)
+
+    def dec(node):
+        kind = node[0]
+        if kind == "a":
+            return to_leaf(arrays[node[1]])
+        if kind == "t":
+            return tuple(dec(v) for v in node[1])
+        if kind == "l":
+            return [dec(v) for v in node[1]]
+        if kind == "d":
+            return {k: dec(v) for k, v in node[1].items()}
+        return node[1]
+
+    return dec(spec)
+
+
+def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
+                worker_init_fn=None):
+    """Child-process entry: fetch assigned batches in order, write to the
+    per-worker ring, close the ring when done (or on error, after
+    shipping the exception)."""
+    from ..native import ShmRing
+
+    ring = ShmRing(ring_name, create=False)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        for indices in index_batches:
+            samples = [dataset[i] for i in indices]
+            batch = (collate_fn or collate_numpy)(samples)
+            ring.write(serialize_batch(batch))
+        ring.close()
+    except BrokenPipeError:
+        pass  # parent tore down mid-epoch
+    except BaseException as e:  # ship the failure to the parent
+        try:
+            import traceback
+
+            msg = pickle.dumps(
+                ("error", f"{type(e).__name__}: {e}\n"
+                 + "".join(traceback.format_exc()))
+            )
+            ring.write(b"\xff\xff\xff\xff" + msg)
+            ring.close()
+        except Exception:
+            pass
+        os._exit(1)
+    finally:
+        ring.detach()
+    os._exit(0)

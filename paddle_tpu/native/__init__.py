@@ -1,0 +1,171 @@
+"""paddle_tpu.native — C components of the runtime.
+
+Reference parity: the reference implements its data-loader transport,
+allocators, and executors in C++ (SURVEY.md §2.1/§2.4); on TPU the
+compute-side native surface is XLA itself, so the native code that
+remains useful host-side is the IO path. This package holds a C
+shared-memory SPSC ring buffer (shm_ring.c) used by the multiprocess
+DataLoader: forked workers write collated numpy batches into per-worker
+rings; the parent maps the same segments and reads them as zero-copy
+numpy views.
+
+The extension is compiled on first use with the system C compiler into
+``_shm_ring.so`` next to this file (no pip/setup step; the build is one
+``cc -O2 -shared -fPIC`` invocation). If no compiler is available the
+DataLoader falls back to its thread-pool path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_shm_ring.so")
+_SRC = os.path.join(_HERE, "shm_ring.c")
+_LOCK = threading.Lock()
+_LIB = None
+HDR_SIZE = 4096
+
+
+def _compile():
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+                capture_output=True, text=True, timeout=120,
+            )
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def get_lib():
+    """ctypes handle to the ring library, compiling it if needed.
+    Returns None when no C toolchain is available (failure is cached —
+    we don't re-spawn compilers every DataLoader epoch)."""
+    global _LIB
+    with _LOCK:
+        if _LIB is False:
+            return None
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            if not _compile():
+                _LIB = False
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.shm_ring_attach.restype = ctypes.c_void_p
+        lib.shm_ring_attach.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.shm_ring_capacity.restype = ctypes.c_uint64
+        lib.shm_ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_detach.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_closed.restype = ctypes.c_int
+        lib.shm_ring_closed.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_write.restype = ctypes.c_int
+        lib.shm_ring_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int64,
+        ]
+        lib.shm_ring_next.restype = ctypes.c_int64
+        lib.shm_ring_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        lib.shm_ring_advance.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+class ShmRing:
+    """Python face of one SPSC ring (create in the parent, attach in the
+    forked worker — the fork inherits nothing but the shm NAME, keeping
+    the two mappings independent)."""
+
+    def __init__(self, name, capacity=None, create=False):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("no C compiler available for shm_ring")
+        self._lib = lib
+        self.name = name.encode()
+        self._owner = bool(create)
+        base = lib.shm_ring_attach(
+            self.name, int(capacity or 0), 1 if create else 0
+        )
+        if not base:
+            raise OSError(f"shm_ring_attach({name!r}) failed")
+        self._base = base
+        self.capacity = lib.shm_ring_capacity(base)
+        import mmap as _m  # noqa: F401  (documentation: base IS an mmap)
+
+    # ------------------------------------------------------------ producer
+    def write(self, buf, timeout_ms=-1):
+        r = self._lib.shm_ring_write(
+            self._base, bytes(buf) if not isinstance(buf, (bytes, bytearray))
+            else buf, len(buf), timeout_ms,
+        )
+        if r == -2:
+            raise BrokenPipeError("ring closed")
+        if r == -1:
+            raise TimeoutError("ring write timeout")
+        if r == -3:
+            raise ValueError(
+                f"record of {len(buf)} bytes exceeds ring capacity "
+                f"{self.capacity}; raise the FLAGS_dataloader_shm_mb env "
+                "var (default 64) or shrink the batch"
+            )
+
+    # ------------------------------------------------------------ consumer
+    def next_view(self, timeout_ms=-1):
+        """-> memoryview over the next record's payload (zero-copy into
+        the shared segment), or None when the ring is closed and drained.
+        Call advance() when done with the view."""
+        off = ctypes.c_uint64()
+        n = self._lib.shm_ring_next(
+            self._base, ctypes.byref(off), timeout_ms
+        )
+        if n == -2:
+            return None
+        if n == -1:
+            raise TimeoutError("ring read timeout")
+        return (ctypes.c_char * n).from_address(
+            self._base + off.value
+        )
+
+    def advance(self):
+        self._lib.shm_ring_advance(self._base)
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        self._lib.shm_ring_close(self._base)
+
+    @property
+    def closed(self):
+        return bool(self._lib.shm_ring_closed(self._base))
+
+    def detach(self):
+        if self._base:
+            self._lib.shm_ring_detach(self._base)
+            self._base = None
+
+    def unlink(self):
+        self._lib.shm_ring_unlink(self.name)
+
+    def __del__(self):
+        try:
+            self.detach()
+            if self._owner:
+                self.unlink()
+        except Exception:
+            pass

@@ -1,0 +1,178 @@
+/* SPSC shared-memory ring buffer for DataLoader worker transport.
+ *
+ * Reference parity: the shared-memory queue under the reference's
+ * multiprocess DataLoader (paddle/fluid/operators/reader/ + the
+ * core._shared_memory machinery — unverified, mount empty), rebuilt as a
+ * minimal single-producer/single-consumer ring: one forked worker writes
+ * collated batch records, the parent maps the same segment and reads them
+ * zero-copy (numpy views over the mmap).
+ *
+ * Layout: [header page][data area of `capacity` bytes]. head/tail are
+ * monotonic byte offsets (mod capacity gives the position); records are
+ * [u64 len][payload] padded to 8 bytes and never wrap — a len of
+ * UINT64_MAX is a skip marker sending the reader back to offset 0.
+ * Synchronization: C11 atomics + sched_yield/usleep spinning (batch
+ * granularity makes wakeup latency irrelevant).
+ */
+#include <fcntl.h>
+#include <sched.h>
+#include <stdatomic.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#define HDR_SIZE 4096
+#define ALIGN8(x) (((x) + 7ull) & ~7ull)
+#define SKIP UINT64_MAX
+
+typedef struct {
+    uint64_t capacity;
+    _Atomic uint64_t head; /* producer-owned write offset (monotonic) */
+    _Atomic uint64_t tail; /* consumer-owned read offset (monotonic) */
+    _Atomic uint32_t closed;
+} ring_hdr;
+
+static ring_hdr *hdr(void *base) { return (ring_hdr *)base; }
+static char *data(void *base) { return (char *)base + HDR_SIZE; }
+
+/* returns mmap'd base or NULL; capacity used only when create != 0 */
+void *shm_ring_attach(const char *name, uint64_t capacity, int create) {
+    int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+    int fd = shm_open(name, flags, 0600);
+    if (fd < 0) return NULL;
+    uint64_t total;
+    if (create) {
+        total = HDR_SIZE + capacity;
+        if (ftruncate(fd, (off_t)total) != 0) {
+            close(fd);
+            shm_unlink(name);
+            return NULL;
+        }
+    } else {
+        struct stat st;
+        if (fstat(fd, &st) != 0) { close(fd); return NULL; }
+        total = (uint64_t)st.st_size;
+    }
+    void *base = mmap(NULL, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+    close(fd);
+    if (base == MAP_FAILED) return NULL;
+    if (create) {
+        memset(base, 0, HDR_SIZE);
+        hdr(base)->capacity = capacity;
+    }
+    return base;
+}
+
+uint64_t shm_ring_capacity(void *base) { return hdr(base)->capacity; }
+
+void shm_ring_detach(void *base) {
+    munmap(base, HDR_SIZE + hdr(base)->capacity);
+}
+
+int shm_ring_unlink(const char *name) { return shm_unlink(name); }
+
+void shm_ring_close(void *base) {
+    atomic_store(&hdr(base)->closed, 1u);
+}
+
+int shm_ring_closed(void *base) {
+    return (int)atomic_load(&hdr(base)->closed);
+}
+
+static void backoff(int *spins) {
+    if (++(*spins) < 64) sched_yield();
+    else usleep(200);
+}
+
+/* free contiguous bytes at the producer's current position */
+static uint64_t contiguous_free(ring_hdr *h, uint64_t head, uint64_t tail,
+                                uint64_t *pos_out) {
+    uint64_t cap = h->capacity;
+    uint64_t used = head - tail;
+    uint64_t pos = head % cap;
+    uint64_t until_end = cap - pos;
+    uint64_t free_total = cap - used;
+    *pos_out = pos;
+    return until_end < free_total ? until_end : free_total;
+}
+
+/* 0 ok, -1 timeout, -2 closed, -3 record too large */
+int shm_ring_write(void *base, const void *src, uint64_t len,
+                   int64_t timeout_ms) {
+    ring_hdr *h = hdr(base);
+    uint64_t need = ALIGN8(8 + len);
+    if (need + 8 >= h->capacity) return -3;
+    int spins = 0;
+    int64_t waited_us = 0;
+    for (;;) {
+        if (atomic_load(&h->closed)) return -2;
+        uint64_t head = atomic_load(&h->head);
+        uint64_t tail = atomic_load(&h->tail);
+        uint64_t pos;
+        uint64_t cfree = contiguous_free(h, head, tail, &pos);
+        uint64_t cap = h->capacity;
+        uint64_t free_total = cap - (head - tail);
+        if (cfree >= need) {
+            char *p = data(base) + pos;
+            memcpy(p, &len, 8);
+            memcpy(p + 8, src, len);
+            atomic_store(&h->head, head + need);
+            return 0;
+        }
+        /* not enough contiguous room at the end: emit skip + wrap, but
+         * only once the reader has left the front of the buffer */
+        uint64_t until_end = cap - (head % cap);
+        if (free_total >= until_end + need && until_end >= 8) {
+            char *p = data(base) + (head % cap);
+            uint64_t skip = SKIP;
+            memcpy(p, &skip, 8);
+            atomic_store(&h->head, head + until_end);
+            continue;
+        }
+        backoff(&spins);
+        waited_us += (spins < 64) ? 1 : 200;
+        if (timeout_ms >= 0 && waited_us / 1000 > timeout_ms) return -1;
+    }
+}
+
+/* >=0: length of the next record (its payload offset written to
+ * *payload_off, relative to segment start); -1 timeout; -2 closed+empty */
+int64_t shm_ring_next(void *base, uint64_t *payload_off,
+                      int64_t timeout_ms) {
+    ring_hdr *h = hdr(base);
+    int spins = 0;
+    int64_t waited_us = 0;
+    for (;;) {
+        uint64_t head = atomic_load(&h->head);
+        uint64_t tail = atomic_load(&h->tail);
+        if (head != tail) {
+            uint64_t cap = h->capacity;
+            uint64_t pos = tail % cap;
+            uint64_t len;
+            memcpy(&len, data(base) + pos, 8);
+            if (len == SKIP) {
+                atomic_store(&h->tail, tail + (cap - pos));
+                continue;
+            }
+            *payload_off = HDR_SIZE + pos + 8;
+            return (int64_t)len;
+        }
+        if (atomic_load(&h->closed)) return -2;
+        backoff(&spins);
+        waited_us += (spins < 64) ? 1 : 200;
+        if (timeout_ms >= 0 && waited_us / 1000 > timeout_ms) return -1;
+    }
+}
+
+/* consume the record previously returned by shm_ring_next */
+void shm_ring_advance(void *base) {
+    ring_hdr *h = hdr(base);
+    uint64_t tail = atomic_load(&h->tail);
+    uint64_t pos = tail % h->capacity;
+    uint64_t len;
+    memcpy(&len, data(base) + pos, 8);
+    atomic_store(&h->tail, tail + ALIGN8(8 + len));
+}

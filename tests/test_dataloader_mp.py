@@ -1,0 +1,155 @@
+"""Multiprocess DataLoader over C shared-memory rings.
+
+Reference parity target: the reference's multiprocess DataLoader tests
+(python/paddle/io worker/shared-memory queue paths — unverified, mount
+empty): forked workers, deterministic ordering, error propagation, and a
+throughput win over single-process loading for GIL-bound datasets.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.native import get_lib
+
+pytestmark = pytest.mark.skipif(
+    get_lib() is None, reason="no C toolchain for shm_ring"
+)
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, shape=(3, 8, 8)):
+        self.x = np.arange(
+            n * int(np.prod(shape)), dtype=np.float32
+        ).reshape((n,) + shape)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i)
+
+
+def _collect(dl):
+    out = []
+    for xb, yb in dl:
+        out.append((np.asarray(xb.numpy()), np.asarray(yb.numpy())))
+    return out
+
+
+def test_mp_matches_single_process_order_and_values():
+    ds = ArrayDataset()
+    gold = _collect(DataLoader(ds, batch_size=8, num_workers=0))
+    mp = _collect(
+        DataLoader(ds, batch_size=8, num_workers=4, use_shared_memory=True)
+    )
+    assert len(gold) == len(mp) == 8
+    for (gx, gy), (mx, my) in zip(gold, mp):
+        np.testing.assert_array_equal(gx, mx)
+        np.testing.assert_array_equal(gy, my)
+
+
+def test_mp_ring_wraps_many_batches():
+    # small ring forces wrap-around + skip markers
+    import os
+
+    os.environ["FLAGS_dataloader_shm_mb"] = "1"
+    try:
+        ds = ArrayDataset(n=256, shape=(3, 16, 16))
+        gold = _collect(DataLoader(ds, batch_size=4, num_workers=0))
+        mp = _collect(DataLoader(ds, batch_size=4, num_workers=2))
+        for (gx, _), (mx, _) in zip(gold, mp):
+            np.testing.assert_array_equal(gx, mx)
+    finally:
+        del os.environ["FLAGS_dataloader_shm_mb"]
+
+
+class FailingDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 19:
+            raise ValueError("boom at 19")
+        return super().__getitem__(i)
+
+
+def test_mp_worker_error_propagates():
+    dl = DataLoader(FailingDataset(), batch_size=8, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 19"):
+        _collect(dl)
+
+
+class SlowDataset(Dataset):
+    """GIL-bound per-item work: threads cannot parallelize this, forked
+    processes can."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(120000):  # pure-python: holds the GIL (~6ms)
+            acc += k * k
+        return np.full((16,), float(i % 7), np.float32), np.int64(acc % 3)
+
+
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 3,
+    reason="mp speedup needs >=3 cores (parent + parallel workers)",
+)
+def test_mp_outperforms_single_process():
+    ds = SlowDataset()
+    t0 = time.perf_counter()
+    single = _collect(DataLoader(ds, batch_size=8, num_workers=0))
+    t_single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    mp = _collect(DataLoader(ds, batch_size=8, num_workers=4))
+    t_mp = time.perf_counter() - t0
+    for (gx, _), (mx, _) in zip(single, mp):
+        np.testing.assert_array_equal(gx, mx)
+    # 4 workers on GIL-bound work: demand a clear win, not perfect scaling
+    assert t_mp < t_single * 0.8, (t_single, t_mp)
+
+
+class HardCrashDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 9:
+            os._exit(2)  # simulates segfault/OOM: no cleanup, ring open
+        return super().__getitem__(i)
+
+
+def test_mp_worker_hard_crash_detected():
+    dl = DataLoader(HardCrashDataset(n=32), batch_size=8, num_workers=2)
+    with pytest.raises(RuntimeError, match="died|ended early"):
+        _collect(dl)
+
+
+def test_custom_numpy_collate_fn():
+    def collate(samples):
+        xs, ys = zip(*samples)
+        return {"x": np.stack(xs) * 2.0, "y": np.asarray(ys)}
+
+    ds = ArrayDataset(n=16)
+    out = list(DataLoader(ds, batch_size=4, num_workers=2,
+                          collate_fn=collate))
+    assert len(out) == 4
+    np.testing.assert_array_equal(
+        np.asarray(out[0]["x"].numpy()), ds.x[:4] * 2.0
+    )
+
+
+def test_tensor_producing_collate_rejected():
+    from paddle_tpu.core.tensor import Tensor
+    import jax.numpy as jnp
+
+    def bad_collate(samples):
+        return Tensor(jnp.zeros([2]))
+
+    dl = DataLoader(ArrayDataset(n=8), batch_size=4, num_workers=2,
+                    collate_fn=bad_collate)
+    with pytest.raises(RuntimeError, match="numpy, not"):
+        list(dl)
