@@ -1,0 +1,10 @@
+"""Elastic training manager (fleet.elastic parity).
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py (unverified,
+mount empty). See manager.py for the TPU redesign notes.
+"""
+from .manager import (  # noqa: F401
+    ElasticManager,
+    ElasticStatus,
+    latest_checkpoint,
+)

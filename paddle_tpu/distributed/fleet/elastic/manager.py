@@ -1,0 +1,159 @@
+"""ElasticManager — peer registry, scale events, restart-from-checkpoint.
+
+Reference parity: python/paddle/distributed/fleet/elastic/manager.py
+(unverified, mount empty): nodes register under an etcd job prefix, watch
+peer keys, detect scale-in/scale-out, rewrite PADDLE_TRAINER_ENDPOINTS,
+and restart workers from the latest checkpoint.
+
+TPU redesign: the registry is a directory of per-node heartbeat files
+(name = node rank, contents = endpoint, liveness = mtime) instead of
+etcd — on TPU pods the jobs already share a filesystem (GCS fuse / NFS)
+and `jax.distributed` supplies the in-job coordination service, so the
+only piece elastic needs is the OUT-of-job membership view that survives
+process death. The manager's surface (register/watch/endpoint rewrite /
+ElasticStatus) mirrors the reference so launcher logic ports unchanged.
+
+Recovery model is the reference's: restart-from-checkpoint, not
+in-flight repair. `latest_checkpoint` picks the newest complete save in
+a directory (distributed-checkpoint dirs with metadata.json, or
+paddle.save files), for the training script to resume from.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, job_id, registry_dir, node_rank, endpoint,
+                 np_range=(1, 1), heartbeat_interval=1.0,
+                 timeout=6.0):
+        self.job_id = job_id
+        self.dir = os.path.join(registry_dir, job_id, "nodes")
+        os.makedirs(self.dir, exist_ok=True)
+        self.node_rank = int(node_rank)
+        self.endpoint = endpoint
+        self.lo, self.hi = int(np_range[0]), int(np_range[1])
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_view = None
+
+    # ------------------------------------------------------------ registry
+    def _path(self, rank=None):
+        return os.path.join(
+            self.dir, str(self.node_rank if rank is None else rank)
+        )
+
+    def register(self):
+        """Write this node's heartbeat file and start refreshing it."""
+        self._write()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._beat, daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _write(self):
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.endpoint)
+        os.replace(tmp, self._path())
+
+    def _beat(self):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._write()
+            except OSError:
+                pass
+
+    def deregister(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        try:
+            os.remove(self._path())
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------------- view
+    def peers(self):
+        """Live peers: [(rank, endpoint)] sorted by rank; a peer whose
+        heartbeat is older than ``timeout`` counts as dead."""
+        now = time.time()
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.isdigit():
+                continue
+            p = os.path.join(self.dir, name)
+            try:
+                st = os.stat(p)
+                if now - st.st_mtime > self.timeout:
+                    continue
+                with open(p) as f:
+                    out.append((int(name), f.read().strip()))
+            except OSError:
+                continue
+        return sorted(out)
+
+    def endpoints(self):
+        """PADDLE_TRAINER_ENDPOINTS for the CURRENT membership (the
+        endpoint-rewrite step of a scale event)."""
+        return ",".join(ep for _, ep in self.peers())
+
+    def watch(self):
+        """One poll: HOLD while membership is unchanged and within range,
+        RESTART when it changed but still >= lo nodes, EXIT when below
+        the minimum."""
+        view = tuple(self.peers())
+        prev, self._last_view = self._last_view, view
+        n = len(view)
+        if n < self.lo:
+            return ElasticStatus.EXIT
+        if prev is not None and view != prev:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+
+_STEP_PAT = re.compile(r"(\d+)")
+
+
+def latest_checkpoint(ckpt_dir):
+    """Newest COMPLETE checkpoint under ``ckpt_dir``.
+
+    Distributed-checkpoint saves are directories containing
+    metadata.json (incomplete saves lack it and are skipped);
+    paddle.save files are plain files. Ordered by the trailing step
+    number in the name when present, else by mtime. Returns a path or
+    None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    candidates = []
+    for name in os.listdir(ckpt_dir):
+        p = os.path.join(ckpt_dir, name)
+        if os.path.isdir(p):
+            if not os.path.exists(os.path.join(p, "metadata.json")):
+                continue  # torn save
+        nums = _STEP_PAT.findall(name)
+        step = int(nums[-1]) if nums else -1
+        candidates.append((step, os.path.getmtime(p), p))
+    if not candidates:
+        return None
+    return max(candidates)[-1]

@@ -40,22 +40,42 @@ def _parse_args(argv):
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--max_restart", type=int,
                    default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", "0")))
+    p.add_argument("--elastic_registry", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_REGISTRY", ""),
+                   help="shared dir for the elastic peer registry "
+                        "(default <log_dir>/.elastic)")
+    p.add_argument("--elastic_timeout", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_ELASTIC_TIMEOUT", "6")),
+                   help="heartbeat staleness before a peer counts dead")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _spawn(args, nnodes):
+def _own_host(args):
+    """This node's address: its --ips entry when given, else the master
+    host (single-host default)."""
+    master = args.master or "127.0.0.1:49175"
+    if args.ips:
+        hosts = [h.strip() for h in args.ips.split(",")]
+        if args.node_rank < len(hosts):
+            return hosts[args.node_rank]
+    return master.split(":")[0]
+
+
+def _spawn(args, nnodes, hosts_override=None, node_index=None):
     nproc = args.nproc_per_node
     world = nnodes * nproc
     master = args.master or "127.0.0.1:49175"
     master_host = master.split(":")[0]
     base_port = int(master.split(":")[1]) if ":" in master else 49175
-    hosts = (
-        [h.strip() for h in args.ips.split(",")]
-        if args.ips
-        else [master_host] * nnodes
-    )
+    if hosts_override is not None:
+        hosts = hosts_override  # elastic endpoint rewrite (live peers)
+    elif args.ips:
+        hosts = [h.strip() for h in args.ips.split(",")]
+    else:
+        hosts = [master_host] * nnodes
     if len(hosts) != nnodes:
         raise SystemExit(
             f"--ips lists {len(hosts)} hosts but --nnodes is {nnodes}"
@@ -66,9 +86,13 @@ def _spawn(args, nnodes):
             endpoints.append(f"{hosts[n]}:{base_port + n * nproc + i}")
 
     os.makedirs(args.log_dir, exist_ok=True)
+    # after a scale event the surviving nodes are renumbered by their
+    # position in the live-peer list (node_index); fresh pods keep the
+    # operator-assigned node_rank
+    node_pos = args.node_rank if node_index is None else node_index
     procs = []
     for local_rank in range(nproc):
-        rank = args.node_rank * nproc + local_rank
+        rank = node_pos * nproc + local_rank
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
@@ -89,8 +113,13 @@ def _spawn(args, nnodes):
     return procs
 
 
-def _watch(procs):
-    """Reference controller behavior: any child crash tears down the pod."""
+def _watch(procs, manager=None):
+    """Reference controller behavior: any child crash tears down the pod;
+    with an elastic manager attached, peer-membership changes do too
+    (returning "membership"/"scale_exit" so launch() can rewrite
+    endpoints and respawn, or give up below the minimum)."""
+    from ..fleet.elastic import ElasticStatus
+
     try:
         while True:
             alive = 0
@@ -107,6 +136,21 @@ def _watch(procs):
                     return code
             if alive == 0:
                 return 0
+            if manager is not None:
+                status = manager.watch()
+                if status == ElasticStatus.RESTART:
+                    sys.stderr.write(
+                        "elastic: peer membership changed; "
+                        "restarting pod with rewritten endpoints\n"
+                    )
+                    _kill(procs)
+                    return "membership"
+                if status == ElasticStatus.EXIT:
+                    sys.stderr.write(
+                        "elastic: live nodes below minimum; exiting\n"
+                    )
+                    _kill(procs)
+                    return "scale_exit"
             time.sleep(0.5)
     except KeyboardInterrupt:
         _kill(procs)
@@ -128,24 +172,70 @@ def _kill(procs):
 
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
+    manager = None
     if ":" in args.nnodes:
         lo, _, hi = args.nnodes.partition(":")
-        nnodes = int(lo)
+        lo, hi = int(lo), int(hi)
+        nnodes = lo
         restarts = args.max_restart or 3
+        # elastic mode: join the peer registry so membership changes
+        # (a node dying, a replacement appearing) trigger endpoint
+        # rewrite + pod restart — the reference's etcd ElasticManager
+        from ..fleet.elastic import ElasticManager
+
+        registry = args.elastic_registry or os.path.join(
+            args.log_dir, ".elastic"
+        )
+        manager = ElasticManager(
+            args.job_id, registry, args.node_rank,
+            endpoint=_own_host(args),
+            np_range=(lo, hi), timeout=args.elastic_timeout,
+        ).register()
+        time.sleep(min(1.0, args.elastic_timeout / 4))  # let peers appear
     else:
         nnodes = int(args.nnodes)
         restarts = args.max_restart
     attempt = 0
-    while True:
-        procs = _spawn(args, nnodes)
-        code = _watch(procs)
-        if code == 0 or code == 130 or attempt >= restarts:
-            # 130 = operator Ctrl-C: never auto-restart a deliberate stop
-            return code
-        attempt += 1
-        sys.stderr.write(
-            f"elastic restart {attempt}/{restarts} (resume from checkpoint)\n"
-        )
+    try:
+        while True:
+            hosts = None
+            node_index = None
+            if manager is not None:
+                # ONE registry snapshot: both the spawned host list and
+                # the watch baseline come from it (a peer dying between
+                # two reads would otherwise go unnoticed)
+                peers = manager.peers()
+                manager._last_view = tuple(peers)
+                if peers:
+                    hi_n = int(args.nnodes.split(":")[1])
+                    nnodes = max(min(len(peers), hi_n), 1)
+                    peers = peers[:nnodes]
+                    hosts = [ep for _, ep in peers]
+                    ranks = [r for r, _ in peers]
+                    if args.node_rank in ranks:
+                        node_index = ranks.index(args.node_rank)
+            procs = _spawn(args, nnodes, hosts_override=hosts,
+                           node_index=node_index)
+            code = _watch(procs, manager)
+            if code == "scale_exit":
+                return 1
+            if code == "membership":
+                sys.stderr.write(
+                    "elastic restart (membership change; resume from "
+                    "checkpoint)\n"
+                )
+                continue  # membership restarts don't consume attempts
+            if code == 0 or code == 130 or attempt >= restarts:
+                # 130 = operator Ctrl-C: never auto-restart
+                return code
+            attempt += 1
+            sys.stderr.write(
+                f"elastic restart {attempt}/{restarts} "
+                "(resume from checkpoint)\n"
+            )
+    finally:
+        if manager is not None:
+            manager.deregister()
 
 
 def main():

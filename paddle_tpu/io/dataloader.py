@@ -214,8 +214,14 @@ class DataLoader:
 
             copy_leaf = jax.default_backend() == "cpu"
             converted = []
+            # type parity with the other paths: default collation yields
+            # Tensors; a custom collate_fn's arrays stay numpy (exactly
+            # what the thread-pool fallback would yield)
+            raw_leaves = self._user_collate is not None
 
             def to_leaf(np_view):
+                if raw_leaves:
+                    return np.array(np_view)  # own the bytes: ring recycles
                 # CPU backend may alias host buffers; copy before the
                 # ring slot is recycled. Accelerator backends DMA out of
                 # the view — we block on the transfer before advance().

@@ -134,10 +134,16 @@ def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
                 worker_init_fn=None):
     """Child-process entry: fetch assigned batches in order, write to the
     per-worker ring, close the ring when done (or on error, after
-    shipping the exception)."""
-    from ..native import ShmRing
+    shipping the exception). NOTHING may escape this function — an
+    exception unwinding into the fork caller would run the PARENT's
+    cleanup inside the child (unlinking shared rings) and then continue
+    executing the training script as a duplicate process."""
+    try:
+        from ..native import ShmRing
 
-    ring = ShmRing(ring_name, create=False)
+        ring = ShmRing(ring_name, create=False)
+    except BaseException:
+        os._exit(1)
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)

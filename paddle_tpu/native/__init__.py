@@ -61,7 +61,18 @@ def get_lib():
             if not _compile():
                 _LIB = False
                 return None
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/foreign-platform binary: rebuild once, else give up
+            if not _compile():
+                _LIB = False
+                return None
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                _LIB = False
+                return None
         lib.shm_ring_attach.restype = ctypes.c_void_p
         lib.shm_ring_attach.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,

@@ -104,7 +104,11 @@ int shm_ring_write(void *base, const void *src, uint64_t len,
                    int64_t timeout_ms) {
     ring_hdr *h = hdr(base);
     uint64_t need = ALIGN8(8 + len);
-    if (need + 8 >= h->capacity) return -3;
+    /* records between capacity/2 and capacity can deadlock: too big to
+     * fit after a mid-buffer head AND too big to wrap while the unread
+     * tail pins the front — reject them up front so the producer errors
+     * instead of spinning forever */
+    if (need + 8 >= h->capacity / 2) return -3;
     int spins = 0;
     int64_t waited_us = 0;
     for (;;) {

@@ -1,0 +1,178 @@
+"""Elastic training: peer registry, scale events, restart-from-checkpoint.
+
+Reference parity target: the ElasticManager etcd tests +
+launch-level restart tests (unverified, mount empty). The integration
+test is the VERDICT's 'kill one worker -> training resumes' scenario:
+a worker crashes mid-training, the launcher restarts the pod, and the
+script resumes from the latest checkpoint instead of step 0.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager,
+    ElasticStatus,
+    latest_checkpoint,
+)
+
+
+# ------------------------------------------------------------- manager
+def test_register_peers_and_endpoints(tmp_path):
+    a = ElasticManager("job", str(tmp_path), 0, "hostA",
+                       np_range=(1, 2), timeout=5).register()
+    b = ElasticManager("job", str(tmp_path), 1, "hostB",
+                       np_range=(1, 2), timeout=5).register()
+    try:
+        assert a.peers() == [(0, "hostA"), (1, "hostB")]
+        assert a.endpoints() == "hostA,hostB"
+    finally:
+        a.deregister()
+        b.deregister()
+    assert a.peers() == []
+
+
+def test_watch_detects_peer_death(tmp_path):
+    a = ElasticManager("job", str(tmp_path), 0, "hostA",
+                       np_range=(1, 2), heartbeat_interval=0.1,
+                       timeout=0.5).register()
+    b = ElasticManager("job", str(tmp_path), 1, "hostB",
+                       np_range=(1, 2), heartbeat_interval=0.1,
+                       timeout=0.5).register()
+    try:
+        assert a.watch() == ElasticStatus.HOLD  # baseline: both alive
+        b._stop.set()  # simulate hard node death: heartbeats stop
+        b._thread.join(timeout=2)
+        deadline = time.time() + 5
+        status = ElasticStatus.HOLD
+        while time.time() < deadline:
+            status = a.watch()
+            if status != ElasticStatus.HOLD:
+                break
+            time.sleep(0.1)
+        assert status == ElasticStatus.RESTART
+        assert a.endpoints() == "hostA"  # rewrite drops the dead peer
+    finally:
+        a.deregister()
+        b.deregister()
+
+
+def test_watch_exit_below_minimum(tmp_path):
+    a = ElasticManager("job", str(tmp_path), 0, "hostA",
+                       np_range=(2, 3), heartbeat_interval=0.1,
+                       timeout=0.5).register()
+    try:
+        # alone with lo=2 -> EXIT
+        assert a.watch() == ElasticStatus.EXIT
+    finally:
+        a.deregister()
+
+
+def test_scale_out_detected(tmp_path):
+    a = ElasticManager("job", str(tmp_path), 0, "hostA",
+                       np_range=(1, 3), timeout=5).register()
+    try:
+        assert a.watch() == ElasticStatus.HOLD
+        b = ElasticManager("job", str(tmp_path), 1, "hostB",
+                           np_range=(1, 3), timeout=5).register()
+        try:
+            assert a.watch() == ElasticStatus.RESTART  # new peer joined
+        finally:
+            b.deregister()
+    finally:
+        a.deregister()
+
+
+# ---------------------------------------------------- latest_checkpoint
+def test_latest_checkpoint_selection(tmp_path):
+    assert latest_checkpoint(str(tmp_path / "nope")) is None
+    # dist-checkpoint dirs: step-numbered, one torn (no metadata.json)
+    for step, complete in [(1, True), (5, True), (9, False)]:
+        d = tmp_path / f"ckpt_step{step}"
+        d.mkdir()
+        if complete:
+            (d / "metadata.json").write_text("{}")
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt_step5")
+    # a plain paddle.save file with a higher step wins
+    (tmp_path / "model_step12.pdparams").write_text("x")
+    assert latest_checkpoint(str(tmp_path)).endswith("model_step12.pdparams")
+
+
+# -------------------------------------------- kill-one-worker integration
+TRAIN_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict)
+    from paddle_tpu.distributed.fleet.elastic import latest_checkpoint
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    work = {work!r}
+    ckdir = os.path.join(work, "ckpts")
+    os.makedirs(ckdir, exist_ok=True)
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    start = 0
+    latest = latest_checkpoint(ckdir)
+    if latest:
+        st = {{"model": net.state_dict(), "step": 0}}
+        load_state_dict(st, latest)
+        start = int(st["step"]) + 1
+
+    rng = np.random.RandomState(0)
+    x = Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32"))
+    y = Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32"))
+    crash_marker = os.path.join(work, "crashed_once")
+    log = open(os.path.join(work, f"steps.{{rank}}.log"), "a")
+    for step in range(start, 8):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        print(json.dumps({{"step": step,
+                           "loss": float(loss.numpy())}}), file=log,
+              flush=True)
+        if rank == 0:
+            save_state_dict({{"model": net.state_dict(), "step": step}},
+                            os.path.join(ckdir, f"ck_step{{step}}"))
+        if step == 3 and rank == 1 and not os.path.exists(crash_marker):
+            open(crash_marker, "w").close()
+            os._exit(17)  # simulated worker crash
+""")
+
+
+def test_kill_one_worker_resumes_from_checkpoint(tmp_path):
+    work = str(tmp_path)
+    script = tmp_path / "train.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(TRAIN_SCRIPT.format(repo=repo, work=work))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "2",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "elastic restart" in r.stderr
+    # worker 0's step log: ran 0..3, crashed pod, RESUMED at 4 (not 0)
+    import json
+
+    steps = [
+        json.loads(line)["step"]
+        for line in open(tmp_path / "steps.0.log")
+    ]
+    assert steps == list(range(4)) + list(range(4, 8)), steps
+    # crash actually happened
+    assert os.path.exists(tmp_path / "crashed_once")
