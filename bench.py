@@ -9,7 +9,9 @@ records the provenance gap). FLOPs use the standard 6N + attention
 accounting (models/llama.py:flops_per_token).
 
 Run with --profile to additionally write a jax profiler trace to
-./bench_trace (inspect with tensorboard / xprof).
+./bench_trace (inspect with tensorboard / xprof). See BENCH_NOTES.md for
+the measured ablation breakdown behind the current configuration
+(attention path choice, batch size, remat, CE dtype).
 """
 from __future__ import annotations
 
