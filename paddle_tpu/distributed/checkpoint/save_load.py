@@ -100,8 +100,12 @@ def save_state_dict(state_dict, path, process_group=None,
         comm.barrier()
     if proc == coordinator_rank or jax.process_count() == 1:
         meta = Metadata(tensors=tensors, scalars=scalars)
-        with open(metadata_path(path), "w") as f:
+        # atomic publish: metadata existence is the checkpoint's
+        # completeness marker (latest_checkpoint relies on it)
+        tmp = metadata_path(path) + ".tmp"
+        with open(tmp, "w") as f:
             f.write(meta.to_json())
+        os.replace(tmp, metadata_path(path))
 
 
 class _ShardReader:

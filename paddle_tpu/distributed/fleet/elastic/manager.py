@@ -36,8 +36,11 @@ class ElasticStatus:
 
 class ElasticManager:
     def __init__(self, job_id, registry_dir, node_rank, endpoint,
-                 np_range=(1, 1), heartbeat_interval=1.0,
+                 np_range=(1, 1), heartbeat_interval=None,
                  timeout=6.0):
+        if heartbeat_interval is None:
+            # beats must outpace the staleness timeout or live peers flap
+            heartbeat_interval = max(0.05, timeout / 4.0)
         self.job_id = job_id
         self.dir = os.path.join(registry_dir, job_id, "nodes")
         os.makedirs(self.dir, exist_ok=True)
@@ -149,8 +152,14 @@ def latest_checkpoint(ckpt_dir):
     for name in os.listdir(ckpt_dir):
         p = os.path.join(ckpt_dir, name)
         if os.path.isdir(p):
-            if not os.path.exists(os.path.join(p, "metadata.json")):
-                continue  # torn save
+            meta = os.path.join(p, "metadata.json")
+            try:
+                import json
+
+                with open(meta) as f:
+                    json.load(f)
+            except (OSError, ValueError):
+                continue  # torn save: absent or unparsable metadata
         nums = _STEP_PAT.findall(name)
         step = int(nums[-1]) if nums else -1
         candidates.append((step, os.path.getmtime(p), p))

@@ -191,11 +191,24 @@ def launch(argv=None):
             endpoint=_own_host(args),
             np_range=(lo, hi), timeout=args.elastic_timeout,
         ).register()
-        time.sleep(min(1.0, args.elastic_timeout / 4))  # let peers appear
+        # quorum wait: ordinary start skew must not make an early node
+        # spawn an undersized pod and EXIT below the minimum
+        grace = max(10.0, args.elastic_timeout * 5)
+        deadline = time.time() + grace
+        while len(manager.peers()) < lo and time.time() < deadline:
+            time.sleep(0.2)
+        if len(manager.peers()) < lo:
+            sys.stderr.write(
+                f"elastic: only {len(manager.peers())} of the minimum "
+                f"{lo} nodes registered within {grace:.0f}s; aborting\n"
+            )
+            manager.deregister()
+            return 1
     else:
         nnodes = int(args.nnodes)
         restarts = args.max_restart
     attempt = 0
+    m_restarts = 0
     try:
         while True:
             hosts = None
@@ -207,8 +220,7 @@ def launch(argv=None):
                 peers = manager.peers()
                 manager._last_view = tuple(peers)
                 if peers:
-                    hi_n = int(args.nnodes.split(":")[1])
-                    nnodes = max(min(len(peers), hi_n), 1)
+                    nnodes = max(min(len(peers), hi), 1)
                     peers = peers[:nnodes]
                     hosts = [ep for _, ep in peers]
                     ranks = [r for r, _ in peers]
@@ -220,11 +232,19 @@ def launch(argv=None):
             if code == "scale_exit":
                 return 1
             if code == "membership":
+                m_restarts += 1
+                if m_restarts > max(10, restarts * 3):
+                    sys.stderr.write(
+                        "elastic: membership flapping "
+                        f"({m_restarts} restarts); giving up — check "
+                        "--elastic_timeout vs real heartbeat latency\n"
+                    )
+                    return 1
                 sys.stderr.write(
                     "elastic restart (membership change; resume from "
                     "checkpoint)\n"
                 )
-                continue  # membership restarts don't consume attempts
+                continue  # membership restarts have their own cap
             if code == 0 or code == 130 or attempt >= restarts:
                 # 130 = operator Ctrl-C: never auto-restart
                 return code

@@ -132,9 +132,10 @@ class ShmRing:
             raise TimeoutError("ring write timeout")
         if r == -3:
             raise ValueError(
-                f"record of {len(buf)} bytes exceeds ring capacity "
-                f"{self.capacity}; raise the FLAGS_dataloader_shm_mb env "
-                "var (default 64) or shrink the batch"
+                f"record of {len(buf)} bytes exceeds the per-record limit "
+                f"of capacity/2 ({self.capacity // 2} of {self.capacity}); "
+                "raise the FLAGS_dataloader_shm_mb env var (default 64) "
+                "or shrink the batch"
             )
 
     # ------------------------------------------------------------ consumer
