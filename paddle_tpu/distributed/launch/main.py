@@ -228,10 +228,17 @@ def launch(argv=None):
                         node_index = ranks.index(args.node_rank)
             procs = _spawn(args, nnodes, hosts_override=hosts,
                            node_index=node_index)
+            pod_started = time.time()
             code = _watch(procs, manager)
             if code == "scale_exit":
                 return 1
             if code == "membership":
+                if time.time() - pod_started > max(
+                    60.0, args.elastic_timeout * 10
+                ):
+                    # a stable run preceded this event: normal elasticity
+                    # (preemption days apart), not flapping
+                    m_restarts = 0
                 m_restarts += 1
                 if m_restarts > max(10, restarts * 3):
                     sys.stderr.write(

@@ -4,13 +4,15 @@ Reference parity: python/paddle/io/dataloader/ + the C++ reader ops and
 shared-memory queues (paddle/fluid/operators/reader/ — unverified, mount
 empty). Two worker modes, as in the reference:
 
-- ``num_workers>0, use_shared_memory=True`` (default): FORKED worker
-  processes fetch+collate numpy batches and push them through per-worker
-  C shared-memory SPSC rings (paddle_tpu/native/shm_ring.c); the parent
-  reads zero-copy views and converts to device arrays. True parallelism
-  for Python-heavy datasets (decode/augment), matching the reference's
-  multiprocess loader. Requires map-style datasets returning numpy; falls
-  back to the thread pool when fork or a C compiler is unavailable.
+- ``num_workers>0, use_shared_memory=True`` (default): SPAWNED worker
+  processes (fresh jax-free interpreters — see worker.py for why fork is
+  unsafe here) fetch+collate numpy batches and push them through
+  per-worker C shared-memory SPSC rings (paddle_tpu/native/shm_ring.c);
+  the parent reads zero-copy views and converts to device arrays. True
+  parallelism for Python-heavy datasets (decode/augment), matching the
+  reference's multiprocess loader. Requires map-style picklable datasets
+  returning numpy; falls back to the thread pool when a C compiler is
+  unavailable, the dataset won't pickle, or workers fail to start.
 - ``use_shared_memory=False``: a thread pool (numpy collation releases
   the GIL for the heavy copies) plus a bounded prefetch queue.
 """
@@ -138,10 +140,13 @@ class DataLoader:
         for indices in self.batch_sampler:
             yield self._fetch(indices)
 
-    def _iter_prefetch(self):
+    def _iter_prefetch(self, batches=None):
         """Thread-pool fetch + bounded queue: overlaps host data work with
-        device compute (jax dispatch is already async on the device side)."""
-        if self._iterable or self.batch_sampler is None:
+        device compute (jax dispatch is already async on the device side).
+        ``batches`` overrides the sampler (the multiprocess path passes
+        its already-materialized index list when falling back, since a
+        one-shot sampler iterator is consumed by then)."""
+        if batches is None and (self._iterable or self.batch_sampler is None):
             yield from self._iter_single()
             return
         sentinel = object()
@@ -152,7 +157,7 @@ class DataLoader:
             try:
                 futures = []
                 depth = self.prefetch_factor * self.num_workers
-                it = iter(self.batch_sampler)
+                it = iter(self.batch_sampler if batches is None else batches)
                 for indices in it:
                     futures.append(pool.submit(self._fetch, indices))
                     if len(futures) >= depth:
@@ -174,23 +179,51 @@ class DataLoader:
             pool.shutdown(wait=False, cancel_futures=True)
 
     def _iter_multiprocess(self):
-        """Forked workers + per-worker shm rings (see module docstring).
+        """Spawned workers + per-worker shm rings (see module docstring).
         Batch i comes from worker i % W; reading rings round-robin keeps
         the reference's deterministic order."""
+        import pickle
+        import subprocess
+        import sys
+        import tempfile
+
         from ..native import ShmRing
-        from .worker import deserialize_batch, worker_loop
+        from .worker import deserialize_batch
 
         batches = list(self.batch_sampler)
         w = min(self.num_workers, max(1, len(batches)))
         ring_mb = int(os.environ.get("FLAGS_dataloader_shm_mb", 64))
-        rings, pids = [], []
+        rings, procs = [], []
         per_worker = [batches[i::w] for i in range(w)]
         # numpy-producing collate in the worker; Tensor conversion here
         worker_collate = self._user_collate
         timeout_ms = int(self.timeout * 1000) if self.timeout > 0 else -1
 
-        # jax must be live before fork only in the PARENT; children never
-        # touch it (worker_loop is numpy-only)
+        worker_py = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "worker.py"
+        )
+        bootstrap = (
+            "import importlib.util, sys; "
+            f"spec = importlib.util.spec_from_file_location('ptw', {worker_py!r}); "
+            "m = importlib.util.module_from_spec(spec); "
+            "spec.loader.exec_module(m); m.spawn_main()"
+        )
+        # child env: forward the parent's sys.path so the pickled
+        # dataset's defining module resolves, but jax-free by
+        # construction — the axon sitecustomize entry (which imports jax
+        # at interpreter start) is stripped
+        env = dict(os.environ)
+        parent_paths = [
+            p if p else os.getcwd()
+            for p in sys.path
+            if "axon_site" not in (p or "")
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(parent_paths)  # de-dupe, keep order
+        )
+        env["JAX_PLATFORMS"] = "cpu"
+
+        payload_files = []
         try:
             for i in range(w):
                 name = f"/pt_dl_{os.getpid()}_{uuid.uuid4().hex[:8]}_{i}"
@@ -198,17 +231,71 @@ class DataLoader:
                     ShmRing(name, capacity=ring_mb << 20, create=True)
                 )
             for i in range(w):
-                pid = os.fork()
-                if pid == 0:  # child
-                    for r in rings[:i] + rings[i + 1 :]:
-                        r.detach()
-                    worker_loop(
-                        rings[i].name.decode(), self.dataset,
-                        worker_collate, per_worker[i], i,
-                        self.worker_init_fn,
-                    )
-                    os._exit(0)  # not reached (worker_loop exits)
-                pids.append(pid)
+                pf = tempfile.NamedTemporaryFile(
+                    suffix=".pkl", delete=False
+                )
+                payload_files.append(pf.name)
+                main_mod = sys.modules.get("__main__")
+                main_script = getattr(main_mod, "__file__", None)
+                if main_script and not str(main_script).endswith(".py"):
+                    main_script = None
+                try:
+                    try:
+                        inner = pickle.dumps(
+                            (rings[i].name.decode(), self.dataset,
+                             worker_collate, per_worker[i], i,
+                             self.worker_init_fn),
+                            protocol=pickle.HIGHEST_PROTOCOL,
+                        )
+                        pickle.dump((main_script, inner), pf)
+                    except Exception:
+                        # unpicklable dataset/collate: thread-pool fallback
+                        self._teardown_workers(rings, procs)
+                        rings, procs = [], []
+                        sys.stderr.write(
+                            "paddle_tpu DataLoader: dataset/collate_fn "
+                            "not picklable for spawned workers; falling "
+                            "back to the thread-pool loader\n"
+                        )
+                        yield from self._iter_prefetch(batches)
+                        return
+                finally:
+                    pf.close()
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", bootstrap, pf.name], env=env,
+                ))
+
+            # startup handshake: every worker must deliver its HELLO
+            # record promptly (covers interpreter startup failures and
+            # any residual environment weirdness); on timeout, degrade
+            # to the thread pool instead of hanging
+            hello_s = float(os.environ.get(
+                "FLAGS_dataloader_worker_start_timeout", "30"))
+            try:
+                for i, r in enumerate(rings):
+                    waited = 0.0
+                    while True:  # 500ms steps: catch fast-dying workers
+                        try:
+                            v = r.next_view(500)
+                            break
+                        except TimeoutError:
+                            waited += 0.5
+                            if (procs[i].poll() is not None
+                                    or waited >= hello_s):
+                                raise
+                    if v is None or bytes(memoryview(v)) != b"HELLO":
+                        raise TimeoutError("bad handshake")
+                    r.advance()
+            except TimeoutError:
+                self._teardown_workers(rings, procs)
+                rings, procs = [], []
+                sys.stderr.write(
+                    "paddle_tpu DataLoader: worker startup handshake "
+                    "failed or timed out; falling back to the "
+                    "thread-pool loader for this epoch\n"
+                )
+                yield from self._iter_prefetch(batches)
+                return
 
             import jax
 
@@ -243,8 +330,8 @@ class DataLoader:
                         return ring.next_view(step_ms)
                     except TimeoutError:
                         waited += step_ms / 1000.0
-                        done, status = os.waitpid(pids[wi], os.WNOHANG)
-                        if done and not ring.closed:
+                        status = procs[wi].poll()
+                        if status is not None and not ring.closed:
                             raise RuntimeError(
                                 f"DataLoader worker {wi} died "
                                 f"(status {status}) without closing its "
@@ -279,19 +366,34 @@ class DataLoader:
                 ring.advance()
                 yield batch
         finally:
-            for r in rings:
+            self._teardown_workers(rings, procs)
+            for pf_name in payload_files:
                 try:
-                    r.close()
-                except Exception:
+                    os.unlink(pf_name)
+                except OSError:
                     pass
-            for pid in pids:
-                try:
-                    os.waitpid(pid, 0)
-                except ChildProcessError:
-                    pass
-            for r in rings:
+
+    @staticmethod
+    def _teardown_workers(rings, procs):
+        import subprocess
+
+        for r in rings:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        for r in rings:
+            try:
                 r.detach()
                 r.unlink()
+            except Exception:
+                pass
 
     def _can_multiprocess(self):
         from ..native import get_lib
@@ -300,7 +402,6 @@ class DataLoader:
             self.use_shared_memory
             and not self._iterable
             and self.batch_sampler is not None
-            and hasattr(os, "fork")
             and get_lib() is not None
         )
 

@@ -6,10 +6,13 @@ processes fetch+collate batches and pass them to the parent without
 pickling the payload.
 
 TPU design notes:
-- Workers are FORKED, inheriting the dataset in-memory; they must stay
-  jax-free (jax runtimes do not survive fork), so worker-side collation
-  is numpy-only and the parent converts the zero-copy views to device
-  arrays (the host->device DMA reads straight out of the shared segment).
+- Workers are SPAWNED (fork+exec of a fresh interpreter), not forked:
+  the training process is heavily multithreaded (jax/XLA thread pools),
+  and a bare fork() inherits their locked mutexes — measured deadlocks,
+  sometimes after the child had already produced batches. The spawn
+  bootstrap loads ONLY numpy + this module (the axon sitecustomize jax
+  import is stripped from the child's PYTHONPATH), so workers can never
+  touch jax; the dataset/collate_fn/indices ship via one pickle file.
 - Batch i is produced by worker i % num_workers and the parent reads
   rings round-robin, preserving the reference's deterministic ordering.
 - Record format: [u32 magic][u32 header_len][pickled (spec, leaf_meta)]
@@ -33,17 +36,17 @@ def _align(n):
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+def _is_paddle_tensor(x):
+    return hasattr(x, "value") and hasattr(x, "stop_gradient")
+
+
 def collate_numpy(batch):
-    """default_collate_fn semantics with numpy leaves (worker-side)."""
+    """default_collate_fn semantics with numpy leaves (worker-side).
+    Paddle-Tensor samples are materialized to numpy — safe in a SPAWNED
+    worker (its private jax runtime was created in this process, on CPU)."""
     sample = batch[0]
-    if hasattr(sample, "value") and hasattr(sample, "stop_gradient"):
-        # catch paddle Tensors BEFORE the np.asarray fallback would
-        # invoke Tensor.__array__ -> jax inside the forked child
-        raise TypeError(
-            "multiprocess DataLoader workers must produce numpy, not "
-            "paddle Tensors (jax does not survive fork); return numpy "
-            "from the dataset or use use_shared_memory=False"
-        )
+    if _is_paddle_tensor(sample):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
     if isinstance(sample, np.ndarray):
         return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
@@ -66,13 +69,8 @@ def serialize_batch(batch):
     leaves = []
 
     def enc(x):
-        if hasattr(x, "value") and hasattr(x, "stop_gradient"):
-            raise TypeError(
-                "multiprocess DataLoader workers must produce numpy, not "
-                "paddle Tensors (jax does not survive fork); return numpy "
-                "from the dataset/collate_fn or use num_workers with "
-                "use_shared_memory=False"
-            )
+        if _is_paddle_tensor(x):
+            x = np.asarray(x.numpy())
         if isinstance(x, np.ndarray):
             leaves.append(np.ascontiguousarray(x))
             return ("a", len(leaves) - 1)
@@ -130,21 +128,79 @@ def deserialize_batch(view, to_leaf):
     return dec(spec)
 
 
-def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
-                worker_init_fn=None):
-    """Child-process entry: fetch assigned batches in order, write to the
-    per-worker ring, close the ring when done (or on error, after
-    shipping the exception). NOTHING may escape this function — an
-    exception unwinding into the fork caller would run the PARENT's
-    cleanup inside the child (unlinking shared rings) and then continue
-    executing the training script as a duplicate process."""
+def _load_shmring():
+    """ShmRing class, resolvable both in-package and from the spawn
+    bootstrap (where this module is loaded by file path with no parent
+    package — importing paddle_tpu/__init__ would drag in jax)."""
     try:
         from ..native import ShmRing
+
+        return ShmRing
+    except ImportError:
+        import importlib.util
+
+        p = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), os.pardir,
+            "native", "__init__.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "paddle_tpu_native_standalone", p
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.ShmRing
+
+
+def spawn_main():
+    """Entry point of a SPAWNED worker: argv[1] is a pickle file holding
+    (main_script, ring_name, dataset, collate_fn, index_batches,
+    worker_id, worker_init_fn).
+
+    Datasets/collate_fns defined in the training script itself pickle as
+    ``__main__.X``; like multiprocessing's spawn, the parent's main
+    script is re-imported here under ``__mp_main__`` and aliased to
+    ``__main__`` so those names resolve. The script runs with
+    __name__ != "__main__", so the standard ``if __name__ == "__main__"``
+    guard keeps its training entry from re-executing."""
+    # the outer payload holds (main_script, inner_pickle): the alias must
+    # be installed BEFORE the inner args (which may reference __main__
+    # classes) are unpickled
+    with open(sys.argv[1], "rb") as f:
+        main_script, blob = pickle.load(f)
+    if main_script and os.path.exists(main_script):
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "__mp_main__", main_script
+            )
+            m = importlib.util.module_from_spec(spec)
+            sys.modules["__mp_main__"] = m
+            spec.loader.exec_module(m)
+            sys.modules["__main__"] = m
+        except BaseException:
+            pass  # unpickle below will fail with a shipped error if needed
+    worker_loop(*pickle.loads(blob))
+
+
+def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
+                worker_init_fn=None):
+    """Worker-process entry: fetch assigned batches in order, write to
+    the per-worker ring, close the ring when done (or on error, after
+    shipping the exception). NOTHING may escape this function — it
+    always terminates the process via os._exit."""
+    try:
+        ShmRing = _load_shmring()
 
         ring = ShmRing(ring_name, create=False)
     except BaseException:
         os._exit(1)
     try:
+        # startup handshake: fork-from-a-threaded-parent can deadlock the
+        # child before it runs a single line (inherited locked mutexes —
+        # jax is multithreaded); the parent waits for this record with a
+        # timeout and falls back to the thread pool if it never arrives
+        ring.write(b"HELLO")
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         for indices in index_batches:

@@ -143,14 +143,81 @@ def test_custom_numpy_collate_fn():
     np.testing.assert_array_equal(out[0]["x"], ds.x[:4] * 2.0)
 
 
-def test_tensor_producing_collate_rejected():
-    from paddle_tpu.core.tensor import Tensor
+def _tensor_collate(samples):
+    # module-level (picklable for spawn); builds a paddle Tensor inside
+    # the worker — legal for spawned workers (private CPU jax runtime),
+    # serialized to numpy for the ring
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    def bad_collate(samples):
-        return Tensor(jnp.zeros([2]))
+    from paddle_tpu.core.tensor import Tensor
+
+    xs, ys = zip(*samples)
+    return Tensor(jnp.asarray(np.stack(xs)) + 1.0)
+
+
+def test_tensor_producing_collate_serialized():
+    ds = ArrayDataset(n=8)
+    out = list(DataLoader(ds, batch_size=4, num_workers=2,
+                          collate_fn=_tensor_collate))
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[0], ds.x[:4] + 1.0)
+
+
+def test_main_defined_dataset_via_mp_main_alias(tmp_path):
+    """Datasets defined in the training script (__main__) must work with
+    spawned workers via the __mp_main__ aliasing, as in multiprocessing."""
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "train_main.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {repo!r})
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class MainDataset(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((4,), float(i), np.float32), np.int64(i)
+
+        if __name__ == "__main__":
+            dl = DataLoader(MainDataset(), batch_size=4, num_workers=2)
+            batches = list(dl)
+            assert len(batches) == 4
+            xb, yb = batches[0]
+            assert float(xb.numpy()[3][0]) == 3.0
+            print("MAIN-DATASET-OK")
+    """))
+    r = subprocess.run(
+        [_sys.executable, str(script)], capture_output=True, text=True,
+        timeout=300, cwd=repo,
+    )
+    assert "MAIN-DATASET-OK" in r.stdout, r.stderr[-2000:]
+    # no silent thread-pool fallback
+    assert "falling back" not in r.stderr, r.stderr[-2000:]
+
+
+def test_unpicklable_collate_falls_back_to_threads():
+    def local_collate(samples):  # local closure: not picklable
+        xs, ys = zip(*samples)
+        return np.stack(xs), np.asarray(ys)
 
     dl = DataLoader(ArrayDataset(n=8), batch_size=4, num_workers=2,
-                    collate_fn=bad_collate)
-    with pytest.raises(RuntimeError, match="numpy, not"):
-        list(dl)
+                    collate_fn=local_collate)
+    out = list(dl)
+    assert len(out) == 2
+    np.testing.assert_array_equal(out[0][0], ArrayDataset(n=8).x[:4])
