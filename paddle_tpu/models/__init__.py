@@ -3,7 +3,15 @@
 The reference keeps its LLM zoo in the PaddleNLP ecosystem on top of the
 core framework; this package ships the framework-native equivalents used
 by the acceptance configs (BASELINE.json #3-#5): a Llama-family decoder
-built on the fused-op API (RMSNorm/rope/flash-attention/SwiGLU), sized by
-config, single-chip or hybrid-parallel via fleet.
+(RMSNorm/rope/flash-attention/SwiGLU) and a BERT encoder family
+(fused post-LN attention/FFN blocks, tied MLM decoder, pretraining
+criterion), both built on the fused-op API, sized by config, single-chip
+or hybrid-parallel via fleet.
 """
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertModel,
+    BertPretrainingCriterion,
+)
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

@@ -1,0 +1,222 @@
+"""BERT encoder family (BASELINE config #3: BERT-base pretraining with
+fused kernels).
+
+Reference parity: the Fleet BERT pretraining config (BASELINE.json #3;
+the model itself lives in PaddleNLP's bert modeling on top of core ops —
+unverified, mount empty). TPU-first design: encoder blocks are the
+incubate fused layers (FusedMultiHeadAttention / FusedFeedForward,
+post-LN) — one QKV gemm, flash/composed attention via
+F.scaled_dot_product_attention, gemm+bias+activation epilogues — so the
+whole step compiles onto the MXU as a few fused loops. The MLM decoder
+ties the word-embedding matrix (standard BERT weight tying).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..incubate.nn.layer import FusedFeedForward, FusedMultiHeadAttention
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    initializer_range: float = 0.02
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, type_vocab_size=2,
+        )
+        base.update(kw)
+        return BertConfig(**base)
+
+    @staticmethod
+    def bert_base(**kw):
+        return BertConfig(**kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size
+        )
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size
+        )
+        self.layer_norm = nn.LayerNorm(
+            cfg.hidden_size, epsilon=cfg.layer_norm_eps
+        )
+        self._dropout = cfg.hidden_dropout_prob
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = int(input_ids.shape[1])
+        max_s = int(self.position_embeddings.weight.shape[0])
+        if s > max_s:
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{max_s}"
+            )
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros(tuple(input_ids.shape), jnp.int32)
+            )
+        h = h + self.token_type_embeddings(token_type_ids)
+        h = self.layer_norm(h)
+        return F.dropout(h, p=self._dropout, training=self.training)
+
+
+def _init_bert_weights(layer, std):
+    """Reference BERT init: weights ~ N(0, initializer_range), biases 0
+    (LayerNorm params keep their 1/0 defaults)."""
+    import jax
+
+    from ..core import random as random_mod
+    from ..nn.layer.norm import LayerNorm
+
+    for sub in layer.sublayers(include_self=True):
+        if isinstance(sub, LayerNorm):
+            continue
+        for p in sub.parameters(include_sublayers=False):
+            if len(p.shape) < 2:
+                continue  # biases / 1-d params keep their zero defaults
+            key = random_mod.next_key()
+            p.value = (
+                jax.random.normal(key, tuple(p.shape), jnp.float32) * std
+            )
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        if cfg.hidden_act not in ("gelu", "relu"):
+            raise ValueError(
+                f"hidden_act {cfg.hidden_act!r} not supported (gelu/relu)"
+            )
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder_layers = nn.LayerList([
+            nn.LayerList([
+                FusedMultiHeadAttention(
+                    cfg.hidden_size, cfg.num_attention_heads,
+                    dropout_rate=cfg.hidden_dropout_prob,
+                    attn_dropout_rate=cfg.attention_probs_dropout_prob,
+                    normalize_before=False, epsilon=cfg.layer_norm_eps,
+                ),
+                FusedFeedForward(
+                    cfg.hidden_size, cfg.intermediate_size,
+                    dropout_rate=cfg.hidden_dropout_prob,
+                    activation=cfg.hidden_act,
+                    normalize_before=False, epsilon=cfg.layer_norm_eps,
+                ),
+            ])
+            for _ in range(cfg.num_hidden_layers)
+        ])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    @staticmethod
+    def _additive_mask(attention_mask):
+        """[B, S] 0/1 padding mask -> additive [B, 1, 1, S] bias."""
+        m = attention_mask.cast("float32")
+        return (1.0 - m).unsqueeze(1).unsqueeze(2) * -1e9
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        mask = (
+            self._additive_mask(attention_mask)
+            if attention_mask is not None else None
+        )
+        h = self.embeddings(input_ids, token_type_ids)
+        for attn, ffn in self.encoder_layers:
+            h = attn(h, attn_mask=mask)
+            h = ffn(h)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertPretrainingHeads(nn.Layer):
+    """MLM transform + tied decoder, and the NSP classifier."""
+
+    def __init__(self, cfg: BertConfig, embedding_weights):
+        super().__init__()
+        self._act = getattr(F, cfg.hidden_act)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_ln = nn.LayerNorm(
+            cfg.hidden_size, epsilon=cfg.layer_norm_eps
+        )
+        self._decoder_weight = embedding_weights  # tied [V, H]
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0),
+        )
+        self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output,
+                masked_positions=None):
+        h = sequence_output
+        if masked_positions is not None:
+            # gather only the masked slots before the big vocab gemm
+            b, s, d = (int(x) for x in h.shape)
+            flat = h.reshape([b * s, d])
+            idx = masked_positions.reshape([-1])
+            h = flat[idx]
+        h = self.transform_ln(self._act(self.transform(h)))
+        logits = F.linear(h, self._decoder_weight.t()) + self.decoder_bias
+        return logits, self.seq_relationship(pooled_output)
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.bert = BertModel(cfg)
+        self.cls = BertPretrainingHeads(
+            cfg, self.bert.embeddings.word_embeddings.weight
+        )
+        _init_bert_weights(self.cls, cfg.initializer_range)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq, pooled, masked_positions)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """Masked-LM CE (ignore_index=-1 for unmasked slots) + NSP CE."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels):
+        mlm = F.cross_entropy(
+            prediction_scores.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]),
+            ignore_index=-1,
+        )
+        nsp = F.cross_entropy(
+            seq_relationship_score, next_sentence_labels.reshape([-1])
+        )
+        return mlm + nsp

@@ -1,0 +1,210 @@
+"""BERT family (config #3): shape/semantics, HF-transformers parity, and
+compiled pretraining.
+
+Reference parity target: the BERT-base pretraining acceptance config
+(BASELINE.json #3). The parity test loads identical weights into
+HuggingFace's torch BertModel (baked into the image) and compares
+hidden states — a true cross-framework oracle.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trainer import CompiledTrainStep
+from paddle_tpu.models import (
+    BertConfig,
+    BertForPretraining,
+    BertModel,
+    BertPretrainingCriterion,
+)
+
+CFG = BertConfig.tiny()
+B, S = 4, 16
+
+
+def _batch(rng):
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S)))
+    tt = jnp.asarray(rng.randint(0, 2, (B, S)))
+    am = jnp.asarray((rng.rand(B, S) > 0.1).astype(np.int32))
+    return Tensor(ids), Tensor(tt), Tensor(am)
+
+
+def test_bert_forward_shapes():
+    paddle.seed(0)
+    net = BertModel(CFG)
+    net.eval()
+    ids, tt, am = _batch(np.random.RandomState(0))
+    seq, pooled = net(ids, tt, am)
+    assert list(seq.shape) == [B, S, CFG.hidden_size]
+    assert list(pooled.shape) == [B, CFG.hidden_size]
+
+
+def test_bert_padding_mask_blocks_attention():
+    """Padded positions must not influence un-padded outputs."""
+    paddle.seed(1)
+    net = BertModel(CFG)
+    net.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, CFG.vocab_size, (1, S))
+    am = np.ones((1, S), np.int32)
+    am[0, S // 2:] = 0  # right half padded
+    out1, _ = net(Tensor(jnp.asarray(ids)), None, Tensor(jnp.asarray(am)))
+    ids2 = ids.copy()
+    ids2[0, S // 2:] = rng.randint(1, CFG.vocab_size, (S // 2,))
+    out2, _ = net(Tensor(jnp.asarray(ids2)), None, Tensor(jnp.asarray(am)))
+    np.testing.assert_allclose(
+        np.asarray(out1.numpy())[:, : S // 2],
+        np.asarray(out2.numpy())[:, : S // 2],
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_bert_matches_huggingface():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.hidden_size,
+        num_hidden_layers=CFG.num_hidden_layers,
+        num_attention_heads=CFG.num_attention_heads,
+        intermediate_size=CFG.intermediate_size,
+        max_position_embeddings=CFG.max_position_embeddings,
+        type_vocab_size=CFG.type_vocab_size,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=CFG.layer_norm_eps,
+        attn_implementation="eager",
+    )
+    hf = transformers.BertModel(hf_cfg)
+    hf.eval()
+
+    paddle.seed(3)
+    net = BertModel(CFG)
+    net.eval()
+
+    def t2j(t):
+        return jnp.asarray(t.detach().numpy())
+
+    # embeddings
+    emb = net.embeddings
+    emb.word_embeddings.weight.value = t2j(
+        hf.embeddings.word_embeddings.weight)
+    emb.position_embeddings.weight.value = t2j(
+        hf.embeddings.position_embeddings.weight)
+    emb.token_type_embeddings.weight.value = t2j(
+        hf.embeddings.token_type_embeddings.weight)
+    emb.layer_norm.weight.value = t2j(hf.embeddings.LayerNorm.weight)
+    emb.layer_norm.bias.value = t2j(hf.embeddings.LayerNorm.bias)
+    # encoder layers
+    for ours, theirs in zip(net.encoder_layers, hf.encoder.layer):
+        attn, ffn = ours
+        sa = theirs.attention.self
+        qkv_w = np.concatenate(
+            [sa.query.weight.detach().numpy().T,
+             sa.key.weight.detach().numpy().T,
+             sa.value.weight.detach().numpy().T], axis=1)
+        qkv_b = np.concatenate(
+            [sa.query.bias.detach().numpy(),
+             sa.key.bias.detach().numpy(),
+             sa.value.bias.detach().numpy()])
+        attn.qkv_weight.value = jnp.asarray(qkv_w)
+        attn.qkv_bias.value = jnp.asarray(qkv_b)
+        ao = theirs.attention.output
+        attn.linear_weight.value = t2j(ao.dense.weight).T
+        attn.linear_bias.value = t2j(ao.dense.bias)
+        attn.ln_scale.value = t2j(ao.LayerNorm.weight)
+        attn.ln_bias.value = t2j(ao.LayerNorm.bias)
+        ffn.linear1_weight.value = t2j(theirs.intermediate.dense.weight).T
+        ffn.linear1_bias.value = t2j(theirs.intermediate.dense.bias)
+        ffn.linear2_weight.value = t2j(theirs.output.dense.weight).T
+        ffn.linear2_bias.value = t2j(theirs.output.dense.bias)
+        ffn.ln2_scale.value = t2j(theirs.output.LayerNorm.weight)
+        ffn.ln2_bias.value = t2j(theirs.output.LayerNorm.bias)
+    net.pooler.weight.value = t2j(hf.pooler.dense.weight).T
+    net.pooler.bias.value = t2j(hf.pooler.dense.bias)
+
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, CFG.vocab_size, (B, S))
+    am = (rng.rand(B, S) > 0.15).astype(np.int64)
+    am[:, 0] = 1
+    tt = rng.randint(0, 2, (B, S))
+
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(am),
+            token_type_ids=torch.tensor(tt),
+        )
+    seq, pooled = net(
+        Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(tt)),
+        Tensor(jnp.asarray(am)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(seq.numpy()), ref.last_hidden_state.numpy(),
+        rtol=2e-4, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled.numpy()), ref.pooler_output.numpy(),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_bert_pretraining_compiled_step():
+    paddle.seed(4)
+    net = BertForPretraining(CFG)
+    crit = BertPretrainingCriterion(CFG.vocab_size)
+    opt = paddle.optimizer.AdamW(5e-4, parameters=net.parameters())
+
+    def loss_fn(pred, seq_rel, mlm_labels, nsp_labels):
+        return crit(pred, seq_rel, mlm_labels, nsp_labels)
+
+    step = CompiledTrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S)))
+    mlm = np.full((B, S), -1, np.int64)
+    mask = rng.rand(B, S) < 0.15
+    mlm[mask] = rng.randint(0, CFG.vocab_size, int(mask.sum()))
+    nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+    losses = []
+    for _ in range(6):
+        loss, _ = step(
+            [Tensor(ids)], [Tensor(jnp.asarray(mlm)), Tensor(nsp)]
+        )
+        losses.append(float(np.asarray(loss.numpy())))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+def test_bert_init_and_guards():
+    paddle.seed(6)
+    net = BertModel(CFG)
+    w = np.asarray(net.embeddings.word_embeddings.weight.numpy())
+    assert abs(w.std() - CFG.initializer_range) < 0.01  # BERT init recipe
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        ids = Tensor(jnp.zeros(
+            (1, CFG.max_position_embeddings + 1), jnp.int32))
+        net(ids)
+    with pytest.raises(ValueError, match="hidden_act"):
+        BertModel(BertConfig.tiny(hidden_act="silu"))
+
+
+def test_bert_masked_positions_gather():
+    paddle.seed(5)
+    net = BertForPretraining(CFG)
+    net.eval()
+    rng = np.random.RandomState(2)
+    ids = Tensor(jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S))))
+    # flat positions into [B*S]
+    pos = Tensor(jnp.asarray(
+        rng.choice(B * S, size=6, replace=False).astype(np.int32)
+    ))
+    logits, seq_rel = net(ids, masked_positions=pos)
+    assert list(logits.shape) == [6, CFG.vocab_size]
+    full_logits, _ = net(ids)
+    got = np.asarray(logits.numpy())
+    want = np.asarray(full_logits.numpy()).reshape(B * S, -1)[
+        np.asarray(pos.numpy())
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
