@@ -1,0 +1,243 @@
+"""paddle.inference parity: Config / create_predictor / Predictor.
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.cc +
+python/paddle/inference (unverified, mount empty): a deployment API that
+loads a saved inference program + params, exposes named input/output
+handles, and runs optimized inference.
+
+TPU redesign: the "analysis + IR pass + engine" pipeline IS XLA — the
+artifact produced by ``paddle_tpu.jit.save`` is batch-polymorphic
+StableHLO, already optimized and retargetable, so the predictor's job
+reduces to artifact loading + a handle-based execution surface. The
+graph-optimization knobs on Config (IR optim, memory optim, TensorRT)
+are accepted for API parity and recorded; they have no effect because
+their work is absorbed by the XLA pipeline (documented per-method).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class Config:
+    """Holds artifact paths + deployment knobs (reference AnalysisConfig)."""
+
+    def __init__(self, model_file=None, params_file=None, model_dir=None):
+        if model_dir and not model_file:
+            # find the jit.save prefix inside the directory
+            hits = sorted(
+                f for f in os.listdir(model_dir)
+                if f.endswith(".stablehlo")
+            ) if os.path.isdir(model_dir) else []
+            if len(hits) == 1:
+                model_file = os.path.join(model_dir, hits[0])
+            elif hits:
+                raise ValueError(
+                    f"model_dir {model_dir!r} holds several artifacts "
+                    f"({hits}); pass model_file explicitly"
+                )
+            else:
+                model_file = os.path.join(model_dir, "__model__")
+        self._model_file = model_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._flags = {}
+
+    # ------------------------------------------------------------- artifact
+    def set_model(self, model_file, params_file=None):
+        self._model_file = model_file
+        self._params_file = params_file
+
+    def model_file(self):
+        return self._model_file
+
+    def params_file(self):
+        return self._params_file
+
+    def prefix(self):
+        """The jit.save path prefix (accepts the prefix itself or any of
+        the three artifact files)."""
+        p = self._model_file or ""
+        for suffix in (".json", ".stablehlo", ".pdiparams", ".pdmodel"):
+            if p.endswith(suffix):
+                return p[: -len(suffix)]
+        return p
+
+    # ------------------------------------------------------------- devices
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # accepted for parity; on this build accelerators mean TPU
+        self._device, self._device_id = "tpu", device_id
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    # ------------------------------------ absorbed-by-XLA knobs (recorded)
+    def switch_ir_optim(self, x=True):
+        self._flags["ir_optim"] = x  # XLA passes always run
+
+    def enable_memory_optim(self, x=True):
+        self._flags["memory_optim"] = x  # XLA buffer assignment
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._flags["tensorrt"] = True  # XLA is the engine on TPU
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = n
+
+    def disable_glog_info(self):
+        self._flags["glog_info"] = False
+
+    def set_optim_cache_dir(self, d):
+        self._flags["cache_dir"] = d  # XLA compile cache is process-global
+
+    def summary(self):
+        return (
+            f"Config(model={self._model_file!r}, device={self._device}, "
+            f"flags={self._flags})"
+        )
+
+
+class _IOHandle:
+    """Named input/output tensor handle (reference paddle_infer::Tensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+        self._pending_shape = None
+
+    # inputs
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+        if self._pending_shape is not None:
+            self._value = self._value.reshape(self._pending_shape)
+            self._pending_shape = None
+
+    def reshape(self, shape):
+        """Reference call order is reshape-then-copy: record the shape
+        and apply it to the next copy_from_cpu (or immediately if data
+        is already present)."""
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+        else:
+            self._pending_shape = list(shape)
+        return self
+
+    def share_external_data(self, t):
+        self._value = np.asarray(
+            t.numpy() if hasattr(t, "numpy") else t
+        )
+
+    # outputs
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise RuntimeError(
+                f"handle {self.name!r} has no value; call run() first"
+            )
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.api import load as jit_load
+        import json
+
+        prefix = config.prefix()
+        if not os.path.exists(prefix + ".stablehlo"):
+            raise FileNotFoundError(
+                f"no inference artifact at {prefix!r} (expected "
+                f"{prefix}.stablehlo from paddle_tpu.jit.save)"
+            )
+        self._layer = jit_load(prefix, params_path=config.params_file())
+        self._config = config
+        with open(prefix + ".json") as f:
+            meta = json.load(f)
+        n_in = len(meta.get("input_specs", []))
+        names = meta.get("input_names")
+        self._input_names = list(names) if names else [
+            f"input_{i}" for i in range(n_in)
+        ]
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._output_names = []
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Execute. Either pass positional arrays here (new-style) or set
+        them through handles first (reference style)."""
+        if inputs is not None:
+            vals = [np.asarray(
+                x.numpy() if hasattr(x, "numpy") else x
+            ) for x in inputs]
+        else:
+            missing = [
+                n for n in self._input_names
+                if self._inputs[n]._value is None
+            ]
+            if missing:
+                raise RuntimeError(
+                    f"inputs {missing} not set; use "
+                    "get_input_handle(name).copy_from_cpu(arr)"
+                )
+            vals = [self._inputs[n]._value for n in self._input_names]
+        out = self._layer(*(Tensor(np.asarray(v)) for v in vals))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        results = []
+        for n, o in zip(self._output_names, outs):
+            arr = np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+            h = _IOHandle(n)
+            h._value = arr
+            self._outputs[n] = h
+            results.append(arr)
+        return results
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass  # XLA owns buffers
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

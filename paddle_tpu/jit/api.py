@@ -207,10 +207,29 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".stablehlo", "wb") as f:
         f.write(blob)
     fsave({"params": params, "buffers": buffers}, path + ".pdiparams")
+    import inspect
+
+    try:
+        sig_names = [
+            p.name for p in inspect.signature(fn).parameters.values()
+            if p.name != "self"
+            and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ][: len(specs)]
+    except (TypeError, ValueError):
+        sig_names = []
+    if len(sig_names) != len(specs):
+        sig_names = [f"input_{i}" for i in range(len(specs))]
+    # an explicit InputSpec.name is the feed name (reference contract);
+    # the forward signature is only the fallback
+    sig_names = [
+        (s.name if getattr(s, "name", None) else fallback)
+        for s, fallback in zip(specs, sig_names)
+    ]
     meta = {
         "input_specs": [
             {"shape": s.shape, "dtype": np.dtype(s.dtype).name} for s in specs
         ],
+        "input_names": sig_names,
         "format": "paddle_tpu.stablehlo.v1",
     }
     with open(path + ".json", "w") as f:
@@ -233,12 +252,15 @@ class TranslatedLayer(Layer):
         return _to_tensors(out)
 
 
-def load(path, **configs):
+def load(path, params_path=None, **configs):
+    """Load a saved inference artifact. ``params_path`` overrides the
+    default co-located weights file (deployment layouts may keep
+    finetuned params elsewhere — the reference Config's params_file)."""
     from ..framework.io import load as fload
 
     with open(path + ".stablehlo", "rb") as f:
         exported = jax.export.deserialize(f.read())
-    state = fload(path + ".pdiparams", return_numpy=False)
+    state = fload(params_path or (path + ".pdiparams"), return_numpy=False)
 
     def _val(v):
         import jax.numpy as jnp

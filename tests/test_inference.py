@@ -1,0 +1,134 @@
+"""Inference predictor (L10): Config/create_predictor over jit.save
+artifacts.
+
+Reference parity target: paddle_infer::Predictor usage pattern —
+config -> predictor -> named input handles -> run -> output handles.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.inference import Config, PrecisionType, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture()
+def artifact(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    net.eval()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(
+        net, prefix, input_spec=[InputSpec([None, 8], "float32", "x")]
+    )
+    return prefix, net
+
+
+def test_predictor_handle_flow(artifact):
+    prefix, net = artifact
+    cfg = Config(prefix + ".stablehlo", prefix + ".pdiparams")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    pred = create_predictor(cfg)
+
+    assert pred.get_input_names() == ["x"]
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    pred.get_input_handle("x").copy_from_cpu(x)
+    pred.run()
+    names = pred.get_output_names()
+    assert names == ["output_0"]
+    got = pred.get_output_handle(names[0]).copy_to_cpu()
+    want = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_batch_polymorphic(artifact):
+    prefix, net = artifact
+    pred = create_predictor(Config(prefix))
+    for b in (1, 3, 7):
+        x = np.random.RandomState(b).randn(b, 8).astype(np.float32)
+        (out,) = pred.run([x])
+        assert out.shape == (b, 4)
+        want = np.asarray(net(Tensor(jnp.asarray(x))).numpy())
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_missing_artifact(tmp_path):
+    with pytest.raises(FileNotFoundError, match="stablehlo"):
+        create_predictor(Config(str(tmp_path / "nope")))
+
+
+def test_predictor_unset_input_errors(artifact):
+    prefix, _ = artifact
+    pred = create_predictor(Config(prefix))
+    with pytest.raises(RuntimeError, match="not set"):
+        pred.run()
+
+
+def test_inputspec_name_is_feed_name(artifact):
+    prefix, _ = artifact
+    pred = create_predictor(Config(prefix))
+    # InputSpec([None, 8], "float32", "x") named the feed "x"
+    assert pred.get_input_names() == ["x"]
+
+
+def test_model_dir_discovers_artifact(artifact):
+    import os
+
+    prefix, net = artifact
+    pred = create_predictor(Config(model_dir=os.path.dirname(prefix)))
+    x = np.ones((2, 8), np.float32)
+    (out,) = pred.run([x])
+    assert out.shape == (2, 4)
+
+
+def test_params_file_override(artifact, tmp_path):
+    import shutil
+
+    prefix, net = artifact
+    moved = str(tmp_path / "elsewhere.pdiparams")
+    shutil.move(prefix + ".pdiparams", moved)
+    with pytest.raises(FileNotFoundError):
+        create_predictor(Config(prefix))  # co-located params gone
+    pred = create_predictor(Config(prefix + ".stablehlo", moved))
+    x = np.ones((1, 8), np.float32)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(
+        out, np.asarray(net(Tensor(jnp.asarray(x))).numpy()),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_reshape_before_copy(artifact):
+    prefix, net = artifact
+    pred = create_predictor(Config(prefix))
+    h = pred.get_input_handle("x")
+    h.reshape([2, 8])
+    h.copy_from_cpu(np.arange(16, dtype=np.float32))  # flat, pre-shaped
+    pred.run()
+    assert pred.get_output_handle("output_0").copy_to_cpu().shape == (2, 4)
+
+
+def test_config_knobs_are_recorded(artifact):
+    prefix, _ = artifact
+    cfg = Config(prefix)
+    cfg.enable_tensorrt_engine(precision_mode=PrecisionType.Half)
+    cfg.disable_glog_info()
+    cfg.set_cpu_math_library_num_threads(4)
+    assert "tensorrt" in cfg.summary()
+    create_predictor(cfg)  # knobs must not break loading
