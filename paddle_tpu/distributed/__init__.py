@@ -8,6 +8,7 @@ path lives in paddle_tpu.parallel.
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from . import rpc  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,
     ProcessMesh,

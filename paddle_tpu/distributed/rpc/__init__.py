@@ -1,0 +1,367 @@
+"""paddle.distributed.rpc parity: init_rpc / rpc_sync / rpc_async.
+
+Reference parity: python/paddle/distributed/rpc/ over a brpc C++
+transport (paddle/fluid/distributed/rpc/ — unverified, mount empty):
+named workers, a master rendezvous, synchronous/asynchronous remote
+function calls returning futures, and a graceful shutdown barrier.
+
+TPU redesign: remote *function* calls are control-plane, not data-plane —
+tensors move over ICI/DCN via XLA collectives, so the RPC layer only has
+to ship small pickled callables/results between hosts. A plain TCP
+server thread per worker with length-prefixed pickle frames replaces
+brpc; the master endpoint doubles as the name/rank registry. As in the
+reference, payloads are pickled: use only inside the trusted training
+cluster (the reference's brpc channel has the same trust model).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+_DEFAULT_TIMEOUT = 120.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _Conn:
+    @staticmethod
+    def send(sock, obj):
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+    @staticmethod
+    def recv(sock):
+        hdr = _Conn._read_exact(sock, 4)
+        (n,) = struct.unpack("<I", hdr)
+        return pickle.loads(_Conn._read_exact(sock, n))
+
+    @staticmethod
+    def _read_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("rpc peer closed")
+            buf += chunk
+        return buf
+
+
+class _Server(threading.Thread):
+    """Per-worker request server: executes incoming (fn, args, kwargs)."""
+
+    def __init__(self, host):
+        super().__init__(daemon=True)
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=8)
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._pool.submit(self._serve, conn)
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                req = _Conn.recv(conn)
+                kind = req[0]
+                if kind == "call":
+                    # a peer can finish rendezvous and call before OUR
+                    # init_rpc has stored the worker table; calls must
+                    # not observe the half-initialized state
+                    _S.ready.wait(_DEFAULT_TIMEOUT)
+                    _, fn, args, kwargs = req
+                    try:
+                        result = fn(*(args or ()), **(kwargs or {}))
+                        try:
+                            _Conn.send(conn, ("ok", result))
+                        except (pickle.PicklingError, TypeError,
+                                AttributeError):
+                            _Conn.send(conn, ("err", RuntimeError(
+                                "rpc result is not picklable: "
+                                f"{type(result).__name__}"
+                            )))
+                    except BaseException as e:  # ship the failure back
+                        try:
+                            _Conn.send(conn, ("err", e))
+                        except Exception:
+                            _Conn.send(conn, ("err", RuntimeError(
+                                f"remote raised unpicklable {e!r}"
+                            )))
+                elif kind == "ping":
+                    _Conn.send(conn, ("ok", None))
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+class _Master(threading.Thread):
+    """Rank-0 registry: collects WorkerInfos, serves the table."""
+
+    def __init__(self, endpoint, world_size):
+        super().__init__(daemon=True)
+        host, port = endpoint.split(":")
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, int(port)))
+        self.sock.listen(64)
+        self.world_size = world_size
+        self.table = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._shutdown_votes = set()
+        self._done_acked = set()
+        self.all_acked = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self.sock.settimeout(0.2)
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                with conn:
+                    conn.settimeout(5.0)  # a stalled client must not
+                    # freeze the single-threaded registry loop
+                    req = _Conn.recv(conn)
+                    if req[0] == "register":
+                        info = req[1]
+                        with self._lock:
+                            self.table[info.name] = info
+                        _Conn.send(conn, ("ok", None))
+                    elif req[0] == "table":
+                        with self._lock:
+                            full = len(self.table) >= self.world_size
+                            _Conn.send(
+                                conn,
+                                ("ok", dict(self.table) if full else None),
+                            )
+                    elif req[0] == "bye":
+                        with self._lock:
+                            self._shutdown_votes.add(req[1])
+                            done = (
+                                len(self._shutdown_votes)
+                                >= self.world_size
+                            )
+                        _Conn.send(conn, ("ok", done))
+                        if done:
+                            # this worker has now OBSERVED completion;
+                            # the master may exit once all have
+                            with self._lock:
+                                self._done_acked.add(req[1])
+                                if (len(self._done_acked)
+                                        >= self.world_size):
+                                    self.all_acked.set()
+            except Exception:
+                continue
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _State:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.name = None
+        self.rank = None
+        self.world_size = None
+        self.master = None
+        self.server = None
+        self.master_thread = None
+        self.workers = {}
+        self.pool = None
+        self.ready = threading.Event()
+
+
+_S = _State()
+
+
+def _master_request(obj, timeout=_DEFAULT_TIMEOUT):
+    host, port = _S.master.split(":")
+    deadline = time.time() + timeout
+    while True:
+        try:
+            with socket.create_connection(
+                (host, int(port)), timeout=max(0.5, deadline - time.time())
+            ) as sock:
+                _Conn.send(sock, obj)
+                status, payload = _Conn.recv(sock)
+                return payload
+        except (ConnectionError, OSError):
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Join the RPC group as ``name``. Rank 0's process hosts the master
+    registry at ``master_endpoint``."""
+    if _S.server is not None:
+        raise RuntimeError("rpc already initialized; call shutdown() first")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (
+        int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        if world_size is None else world_size
+    )
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:49820"
+    )
+    _S.name = name
+    _S.rank = rank
+    _S.world_size = world_size
+    _S.master = master_endpoint
+    try:
+        if rank == 0:
+            _S.master_thread = _Master(master_endpoint, world_size)
+            _S.master_thread.start()
+        host = master_endpoint.split(":")[0]
+        bind_host = host if host in ("127.0.0.1", "localhost") else "0.0.0.0"
+        _S.server = _Server(bind_host)
+        _S.server.start()
+        _S.pool = ThreadPoolExecutor(max_workers=8)
+        info = WorkerInfo(name, rank, host if bind_host != "0.0.0.0" else
+                          socket.gethostbyname(socket.gethostname()),
+                          _S.server.port)
+        _master_request(("register", info))
+        deadline = time.time() + _DEFAULT_TIMEOUT
+        while True:
+            table = _master_request(("table",))
+            if table is not None:
+                _S.workers = table
+                _S.ready.set()
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rpc rendezvous: {world_size} workers did not "
+                    "register"
+                )
+            time.sleep(0.1)
+    except BaseException:
+        # failed init must not leave live threads / half state behind:
+        # a retry of init_rpc should start clean
+        if _S.server is not None:
+            _S.server.stop()
+        if _S.pool is not None:
+            _S.pool.shutdown(wait=False)
+        if _S.master_thread is not None:
+            _S.master_thread.stop()
+        _S.reset()
+        raise
+
+
+def get_worker_info(name=None):
+    return _S.workers[name or _S.name]
+
+
+def get_all_worker_infos():
+    return sorted(_S.workers.values(), key=lambda w: w.rank)
+
+
+def _call(to, fn, args, kwargs, timeout):
+    info = _S.workers[to] if isinstance(to, str) else to
+    with socket.create_connection(
+        (info.ip, info.port), timeout=timeout or _DEFAULT_TIMEOUT
+    ) as sock:
+        _Conn.send(sock, ("call", fn, args, kwargs))
+        sock.settimeout(timeout or _DEFAULT_TIMEOUT)
+        status, payload = _Conn.recv(sock)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_TIMEOUT):
+    """Run fn(*args, **kwargs) on worker ``to``; return its result."""
+    if _S.server is None:
+        raise RuntimeError("call init_rpc first")
+    return _call(to, fn, args, kwargs, timeout)
+
+
+class FutureWrapper:
+    """Reference FutureWrapper surface (.wait()) over a stdlib Future."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self):
+        return self._fut.done()
+
+    def exception(self, timeout=None):
+        return self._fut.exception(timeout)
+
+    def add_done_callback(self, cb):
+        return self._fut.add_done_callback(cb)
+
+
+def rpc_async(to, fn, args=None, kwargs=None,
+              timeout=_DEFAULT_TIMEOUT) -> FutureWrapper:
+    """Async variant: returns a FutureWrapper (.wait()/.result())."""
+    if _S.server is None:
+        raise RuntimeError("call init_rpc first")
+    return FutureWrapper(_S.pool.submit(_call, to, fn, args, kwargs, timeout))
+
+
+def shutdown():
+    """Graceful: wait until every worker votes bye, then stop serving
+    (so peers' in-flight calls to this worker still complete)."""
+    if _S.server is None:
+        return
+    deadline = time.time() + _DEFAULT_TIMEOUT
+    while True:
+        done = _master_request(("bye", _S.name))
+        if done or time.time() > deadline:
+            break
+        time.sleep(0.1)
+    _S.server.stop()
+    if _S.pool is not None:
+        _S.pool.shutdown(wait=True)
+    if _S.master_thread is not None:
+        # exit only after EVERY worker has read done=True from a bye
+        # poll — a timed sleep would race slow peers into a dead master
+        _S.master_thread.all_acked.wait(_DEFAULT_TIMEOUT)
+        _S.master_thread.stop()
+    _S.reset()

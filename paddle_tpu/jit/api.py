@@ -225,6 +225,11 @@ def save(layer, path, input_spec=None, **configs):
         (s.name if getattr(s, "name", None) else fallback)
         for s, fallback in zip(specs, sig_names)
     ]
+    if len(set(sig_names)) != len(sig_names):
+        raise ValueError(
+            f"input_spec feed names must be unique, got {sig_names} "
+            "(named handles would collide in the predictor)"
+        )
     meta = {
         "input_specs": [
             {"shape": s.shape, "dtype": np.dtype(s.dtype).name} for s in specs

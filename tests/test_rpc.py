@@ -1,0 +1,106 @@
+"""paddle.distributed.rpc: named workers, sync/async calls, shutdown.
+
+Reference parity target: python/paddle/distributed/rpc tests (unverified,
+mount empty): a 2-worker group doing cross-worker function calls, plus a
+single-worker loopback and error propagation.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.rpc as rpc
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _square(x):
+    return x * x
+
+
+def _numpy_dot(a, b):
+    return np.dot(a, b)
+
+
+def _raise_boom():
+    raise ValueError("boom-rpc")
+
+
+def test_loopback_sync_async_and_errors():
+    ep = f"127.0.0.1:{_free_port()}"
+    rpc.init_rpc("solo", rank=0, world_size=1, master_endpoint=ep)
+    try:
+        assert rpc.rpc_sync("solo", _square, args=(7,)) == 49
+        fut = rpc.rpc_async("solo", _numpy_dot,
+                            args=(np.eye(3), np.arange(3.0)))
+        np.testing.assert_allclose(fut.result(), np.arange(3.0))
+        info = rpc.get_worker_info()
+        assert info.name == "solo" and info.rank == 0
+        assert [w.name for w in rpc.get_all_worker_infos()] == ["solo"]
+        with pytest.raises(ValueError, match="boom-rpc"):
+            rpc.rpc_sync("solo", _raise_boom)
+    finally:
+        rpc.shutdown()
+    # re-init after shutdown works
+    ep2 = f"127.0.0.1:{_free_port()}"
+    rpc.init_rpc("solo2", rank=0, world_size=1, master_endpoint=ep2)
+    assert rpc.rpc_sync("solo2", _square, args=(3,)) == 9
+    rpc.shutdown()
+
+
+WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # don't claim the TPU chip
+    import paddle_tpu.distributed.rpc as rpc
+
+    def mul(a, b):
+        return a * b
+
+    def whoami():
+        return rpc.get_worker_info().name
+
+    rank = int(sys.argv[1])
+    rpc.init_rpc(f"worker{{rank}}", rank=rank, world_size=2,
+                 master_endpoint={ep!r})
+    peer = f"worker{{1 - rank}}"
+    # cross-call: each worker asks the OTHER to compute
+    out = rpc.rpc_sync(peer, mul, args=(rank + 1, 10))
+    assert out == (rank + 1) * 10, out
+    name = rpc.rpc_async(peer, whoami).result()
+    assert name == peer, name
+    infos = rpc.get_all_worker_infos()
+    assert [w.rank for w in infos] == [0, 1]
+    print(f"RPC-OK-{{rank}}")
+    rpc.shutdown()
+""")
+
+
+def test_two_process_rpc():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ep = f"127.0.0.1:{_free_port()}"
+    script = WORKER.format(repo=repo, ep=ep)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(r)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for r in range(2)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, out[-2000:]
+        assert f"RPC-OK-{r}" in out, out[-2000:]
