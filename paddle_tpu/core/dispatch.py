@@ -35,6 +35,12 @@ _JIT_CACHE: dict = {}
 # amp hook: callable (op_name, vals) -> vals, installed by paddle_tpu.amp
 _AMP_HOOK = [None]
 
+# profiler hook: callable (op_name, seconds), installed by paddle_tpu.profiler
+# while a Profiler is recording — the analog of the reference's auto-wrapped
+# per-op RecordEvents (paddle/fluid/platform/profiler). One list-index
+# check when off.
+_PROFILER_HOOK = [None]
+
 
 def set_amp_hook(fn):
     _AMP_HOOK[0] = fn
@@ -120,6 +126,19 @@ def apply(name, fn, args, kw=None, cache=True, nondiff=False):
     ``nondiff=True`` declares the op non-differentiable (bool/int outputs):
     no GradNode is recorded and no vjp residuals are kept.
     """
+    hook = _PROFILER_HOOK[0]
+    if hook is not None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return _apply(name, fn, args, kw, cache, nondiff)
+        finally:
+            hook(name, _time.perf_counter() - t0)
+    return _apply(name, fn, args, kw, cache, nondiff)
+
+
+def _apply(name, fn, args, kw=None, cache=True, nondiff=False):
     kw = kw or {}
     vals = [_unwrap(a) for a in args]
     if _AMP_HOOK[0] is not None:

@@ -1,16 +1,34 @@
-"""paddle.profiler over the jax/XPlane profiler.
+"""paddle.profiler over the jax/XPlane profiler + host op tracer.
 
-Reference parity: python/paddle/profiler/ + the CUPTI tracer
-(paddle/fluid/platform/profiler/ — unverified, mount empty). TPU redesign:
-device timelines come from the XLA/XPlane profiler (TensorBoard-viewable);
-``RecordEvent`` spans map onto jax.profiler.TraceAnnotation so user-code
-regions appear in the same trace. Summary tables are host-side timers.
+Reference parity: python/paddle/profiler/ + the host/CUPTI tracers and
+summary machinery (paddle/fluid/platform/profiler/ — unverified, mount
+empty). TPU redesign, three layers:
+
+- **Device timelines**: the XLA/XPlane profiler (TensorBoard-viewable)
+  captures real kernel times; ``RecordEvent`` spans map onto
+  jax.profiler.TraceAnnotation so user regions appear in that trace.
+- **Per-op host tracer**: while a Profiler is recording, every eager op
+  dispatch is timed through a hook in core.dispatch (the analog of the
+  reference auto-wrapping ops with RecordEvents) — no user code changes.
+  Inside compiled steps individual ops are fused away by XLA; their cost
+  lives in the device timeline, which is the correct attribution.
+- **Summary tables + chrome trace**: ``Profiler.summary()`` prints
+  sortable op/event tables (calls, total, avg, max, min, ratio) and
+  ``export_chrome_tracing`` writes a chrome://tracing JSON of the host
+  spans next to the XPlane dump.
+
+The reference scheduler states are honored: ``make_scheduler(closed=,
+ready=, record=, repeat=, skip_first=)`` drives ``Profiler.step()``
+through CLOSED -> READY -> RECORD windows, invoking ``on_trace_ready``
+at the end of every RECORD window.
 """
 from __future__ import annotations
 
 import collections
 import contextlib
+import json
 import os
+import threading
 import time
 
 
@@ -19,6 +37,36 @@ class ProfilerTarget:
     GPU = "gpu"  # accepted for reference compat; maps to the accelerator
     TPU = "tpu"
     CUSTOM_DEVICE = "custom_device"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+_LOCK = threading.Lock()
+_HOST_TIMES: dict = collections.defaultdict(list)
+_OP_TIMES: dict = collections.defaultdict(list)
+_EVENTS: list = []  # (name, kind, t_start, dur) for chrome export
+_EPOCH = time.perf_counter()
+# set while some Profiler is in a RECORD window; gates all appends so a
+# bare RecordEvent in a profiler-less training loop cannot grow memory
+_RECORDING = threading.Event()
+
+
+def _record_op(name, dur):
+    with _LOCK:
+        _OP_TIMES[name].append(dur)
+        _EVENTS.append((name, "op", time.perf_counter() - _EPOCH - dur, dur))
+
+
+def reset_profiler_data():
+    with _LOCK:
+        _HOST_TIMES.clear()
+        _OP_TIMES.clear()
+        _EVENTS.clear()
 
 
 class RecordEvent:
@@ -39,7 +87,14 @@ class RecordEvent:
 
     def end(self):
         if self._ann is not None:
-            _HOST_TIMES[self.name].append(time.perf_counter() - self._t0)
+            if _RECORDING.is_set():
+                dur = time.perf_counter() - self._t0
+                with _LOCK:
+                    _HOST_TIMES[self.name].append(dur)
+                    _EVENTS.append(
+                        (self.name, "user",
+                         self._t0 - _EPOCH, dur)
+                    )
             self._ann.__exit__(None, None, None)
             self._ann = None
 
@@ -51,25 +106,59 @@ class RecordEvent:
         return False
 
 
-_HOST_TIMES: dict = collections.defaultdict(list)
-
-
 def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
-    """Simplified scheduler: returns the config; Profiler uses record count."""
-    return {
-        "closed": closed,
-        "ready": ready,
-        "record": record,
-        "repeat": repeat,
-        "skip_first": skip_first,
+    """Step-phase schedule (reference semantics): after ``skip_first``
+    steps, cycle [closed | ready | record]; ``repeat=0`` = cycle
+    forever."""
+    cfg = {
+        "closed": int(closed), "ready": int(ready), "record": int(record),
+        "repeat": int(repeat), "skip_first": int(skip_first),
     }
+
+    def schedule(step: int) -> int:
+        s = step - cfg["skip_first"]
+        if s < 0:
+            return ProfilerState.CLOSED
+        cycle = cfg["closed"] + cfg["ready"] + cfg["record"]
+        if cycle == 0:
+            return ProfilerState.RECORD
+        if cfg["repeat"] and s >= cycle * cfg["repeat"]:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < cfg["closed"]:
+            return ProfilerState.CLOSED
+        if pos < cfg["closed"] + cfg["ready"]:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    schedule._config = cfg
+    return schedule
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
-    def handler(prof):
-        pass
+    """on_trace_ready handler writing a chrome://tracing JSON of the
+    recorded host spans (XPlane device dumps land in the same dir)."""
 
-    # read by Profiler.start() BEFORE the trace begins
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        window = getattr(prof, "_window", 0)
+        name = (worker_name or f"host_{os.getpid()}") + f".w{window}"
+        events = []
+        with _LOCK:
+            snapshot = list(_EVENTS)
+        for ev_name, kind, t0, dur in snapshot:
+            events.append({
+                "name": ev_name, "cat": kind, "ph": "X",
+                "ts": t0 * 1e6, "dur": dur * 1e6,
+                "pid": os.getpid(), "tid": 0 if kind == "user" else 1,
+            })
+        path = os.path.join(dir_name, f"{name}.chrome_trace.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        handler.last_path = path
+
     handler._export_dir = dir_name
     return handler
 
@@ -79,40 +168,90 @@ class Profiler:
                  timer_only=False, record_shapes=False, profile_memory=False,
                  with_flops=False):
         self.targets = targets
+        if isinstance(scheduler, dict):
+            scheduler = make_scheduler(**scheduler)
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler  # reference (start, end) step-range form
+            scheduler = make_scheduler(
+                closed=0, ready=0, record=hi - lo, skip_first=lo, repeat=1
+            )
         self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
         self.timer_only = timer_only
         self._export_dir = None
-        self._running = False
-        self._logdir = None
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._tracing = False
+        self._t0 = None
+        self._window = 0
 
-    def start(self):
+    # ------------------------------------------------------------ tracing
+    def _start_tracing(self):
+        from ..core import dispatch
+
+        reset_profiler_data()  # each RECORD window reports its own data
+        self._window += 1
+        _RECORDING.set()
+        dispatch._PROFILER_HOOK[0] = _record_op
         if not self.timer_only:
             import jax
 
             handler_dir = getattr(self.on_trace_ready, "_export_dir", None)
             self._logdir = self._export_dir or handler_dir or "./profiler_log"
             os.makedirs(self._logdir, exist_ok=True)
-            try:
+            with contextlib.suppress(Exception):
                 jax.profiler.start_trace(self._logdir)
-                self._running = True
-            except Exception:
-                self._running = False
+        self._tracing = True
+
+    def _stop_tracing(self, fire_handler=True):
+        from ..core import dispatch
+
+        dispatch._PROFILER_HOOK[0] = None
+        _RECORDING.clear()
+        if self._tracing and not self.timer_only:
+            import jax
+
+            with contextlib.suppress(Exception):
+                jax.profiler.stop_trace()
+        self._tracing = False
+        if fire_handler and self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    # ------------------------------------------------------------- control
+    def start(self):
         self._t0 = time.perf_counter()
+        if self.scheduler is None:
+            self._state = ProfilerState.RECORD
+            self._start_tracing()
+        else:
+            self._apply_state(self.scheduler(self._step))
         return self
 
     def stop(self):
-        if self._running:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._running = False
-        self.elapsed = time.perf_counter() - self._t0
-        if self.on_trace_ready is not None:
-            self.on_trace_ready(self)
+        if self._tracing:
+            self._stop_tracing(fire_handler=True)
+        self.elapsed = time.perf_counter() - (self._t0 or time.perf_counter())
 
     def step(self, num_samples=None):
-        pass
+        """Advance the scheduler one training step."""
+        self._step += 1
+        if self.scheduler is not None:
+            self._apply_state(self.scheduler(self._step))
+
+    def _apply_state(self, new):
+        old = self._state
+        self._state = new
+        recording = new in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN
+        )
+        if old == ProfilerState.RECORD_AND_RETURN and self._tracing:
+            # a RECORD window just completed — close it even if the next
+            # window starts immediately (closed=0, ready=0 schedules)
+            self._stop_tracing(fire_handler=True)
+        if recording and not self._tracing:
+            self._start_tracing()
+        elif not recording and self._tracing:
+            self._stop_tracing(fire_handler=True)
 
     def __enter__(self):
         return self.start()
@@ -121,16 +260,55 @@ class Profiler:
         self.stop()
         return False
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+    # ------------------------------------------------------------- summary
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        lines = ["host span summary (RecordEvent):"]
-        for name, times in sorted(_HOST_TIMES.items()):
-            total = sum(times) * 1000
-            lines.append(
-                f"  {name}: calls={len(times)} total={total:.2f}ms "
-                f"avg={total / max(len(times), 1):.3f}ms"
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+
+        def table(title, data):
+            rows = []
+            grand = sum(sum(v) for v in data.values()) or 1e-12
+            for name, times in data.items():
+                tot = sum(times)
+                rows.append((
+                    name, len(times), tot * unit,
+                    tot / len(times) * unit, max(times) * unit,
+                    min(times) * unit, 100.0 * tot / grand,
+                ))
+            key = {"total": 2, "calls": 1, "avg": 3, "max": 4,
+                   "min": 5}.get(
+                sorted_by if isinstance(sorted_by, str) else "total", 2
             )
-        s = "\n".join(lines)
+            rows.sort(key=lambda r: r[key], reverse=(key != 5))
+            w = max([len(r[0]) for r in rows] + [len("name")])
+            head = (
+                f"{'name':<{w}}  {'calls':>6}  {'total':>10}  "
+                f"{'avg':>9}  {'max':>9}  {'min':>9}  {'ratio':>6}"
+            )
+            lines = [title, "-" * len(head), head, "-" * len(head)]
+            for r in rows:
+                lines.append(
+                    f"{r[0]:<{w}}  {r[1]:>6}  {r[2]:>10.3f}  {r[3]:>9.3f}"
+                    f"  {r[4]:>9.3f}  {r[5]:>9.3f}  {r[6]:>5.1f}%"
+                )
+            return lines
+
+        out = []
+        with _LOCK:
+            host = dict(_HOST_TIMES)
+            ops = dict(_OP_TIMES)
+        if host:
+            out += table(f"UserEvent Summary ({time_unit})", host)
+            out.append("")
+        if op_detail and ops:
+            out += table(f"Operator Summary — host dispatch ({time_unit})",
+                         ops)
+            out.append("")
+            out.append(
+                "(compiled-step internals are in the XPlane device trace; "
+                "open the log dir in TensorBoard)"
+            )
+        s = "\n".join(out) if out else "no profiler data recorded"
         print(s)
         return s
 

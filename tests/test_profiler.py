@@ -1,0 +1,136 @@
+"""Profiler: op tables, scheduler phases, chrome export, RecordEvent.
+
+Reference parity target: python/paddle/profiler tests (unverified, mount
+empty): scheduler state machine, auto per-op spans, summary tables.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    profiler.reset_profiler_data()
+    yield
+    dispatch._PROFILER_HOOK[0] = None
+
+
+def test_op_tracer_records_dispatches():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    x = paddle.randn([8, 8])
+    y = (x @ x).sum()
+    p.stop()
+    s = p.summary()
+    assert "matmul" in s
+    assert "Operator Summary" in s
+    # hook uninstalled after stop: new ops aren't recorded
+    before = len(profiler._OP_TIMES.get("matmul", []))
+    _ = x @ x
+    assert len(profiler._OP_TIMES.get("matmul", [])) == before
+
+
+def test_record_event_table():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    with profiler.RecordEvent("my_region"):
+        _ = paddle.ones([4]) + 1.0
+    p.stop()
+    s = p.summary()
+    assert "my_region" in s
+    assert "UserEvent Summary" in s
+
+
+def test_scheduler_state_machine():
+    sched = profiler.make_scheduler(
+        closed=1, ready=1, record=2, repeat=1, skip_first=1
+    )
+    S = profiler.ProfilerState
+    states = [sched(i) for i in range(6)]
+    assert states == [
+        S.CLOSED,  # skip_first
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,
+        S.CLOSED,  # repeat exhausted
+    ]
+
+
+def test_profiler_scheduler_windows_fire_handler(tmp_path):
+    fired = []
+
+    def handler(prof):
+        fired.append(prof._step)
+
+    p = profiler.Profiler(
+        scheduler=profiler.make_scheduler(
+            closed=1, ready=0, record=1, repeat=2
+        ),
+        on_trace_ready=handler, timer_only=True,
+    )
+    p.start()
+    for _ in range(5):
+        _ = paddle.ones([2]) * 2
+        p.step()
+    p.stop()
+    assert len(fired) == 2  # two RECORD windows completed
+
+
+def test_back_to_back_record_windows_fire_each():
+    """closed=0/ready=0 schedules must close a window per step
+    (regression: recording->recording transition never fired)."""
+    fired = []
+    p = profiler.Profiler(
+        scheduler=profiler.make_scheduler(record=1, repeat=3),
+        on_trace_ready=lambda prof: fired.append(prof._window),
+        timer_only=True,
+    )
+    p.start()
+    for _ in range(3):
+        _ = paddle.ones([2]) + 1
+        p.step()
+    p.stop()
+    assert len(fired) == 3
+    assert fired == sorted(set(fired))  # distinct windows
+
+
+def test_record_event_without_profiler_does_not_accumulate():
+    base = sum(len(v) for v in profiler._HOST_TIMES.values())
+    with profiler.RecordEvent("orphan"):
+        pass
+    assert sum(len(v) for v in profiler._HOST_TIMES.values()) == base
+    assert len(profiler._EVENTS) == 0
+
+
+def test_chrome_trace_export(tmp_path):
+    handler = profiler.export_chrome_tracing(str(tmp_path))
+    p = profiler.Profiler(on_trace_ready=handler, timer_only=True)
+    p.start()
+    with profiler.RecordEvent("step0"):
+        _ = paddle.randn([4, 4]) @ paddle.randn([4, 4])
+    p.stop()
+    path = handler.last_path
+    assert os.path.exists(path)
+    trace = json.load(open(path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "step0" in names
+    assert "matmul" in names
+    for e in trace["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_summary_sorting_and_units():
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        _ = paddle.ones([4]) + 1.0
+    p.stop()
+    s_total = p.summary(sorted_by="total", time_unit="us")
+    assert "(us)" in s_total
+    s_calls = p.summary(sorted_by="calls")
+    assert "(ms)" in s_calls
