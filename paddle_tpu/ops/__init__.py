@@ -7,9 +7,19 @@ bound as Tensor methods. Reference parity: python/paddle/tensor/__init__.py
 """
 from __future__ import annotations
 
-from . import creation, linalg, logic, manipulation, math, reduction, search
+from . import (
+    creation,
+    extras,
+    linalg,
+    logic,
+    manipulation,
+    math,
+    reduction,
+    search,
+)
 
-_MODULES = [creation, math, reduction, manipulation, search, logic, linalg]
+_MODULES = [creation, math, reduction, manipulation, search, logic, linalg,
+            extras]
 
 # helper/infra names that are callable but are NOT ops
 _EXCLUDE = {

@@ -1,0 +1,584 @@
+"""Long-tail tensor ops (reference parity: python/paddle/tensor/* rows
+not covered by the core modules — unverified, mount empty).
+
+Every op is one pure jnp function through core.dispatch (eager per-op
+jit + autograd via jax.vjp; fused inside whole-step jit). Ops with
+integer/bool outputs are declared nondiff.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import binary, normalize_axis, static_int_list, unary
+
+# ----------------------------------------------------------- elementwise
+rad2deg = unary("rad2deg", jnp.rad2deg)
+deg2rad = unary("deg2rad", jnp.deg2rad)
+sinc = unary("sinc", jnp.sinc)
+i1 = unary("i1", lambda x: jax.scipy.special.i1(x))
+sgn = unary("sgn", jnp.sign)
+signbit = unary("signbit", jnp.signbit, nondiff=True)
+isneginf = unary("isneginf", jnp.isneginf, nondiff=True)
+isposinf = unary("isposinf", jnp.isposinf, nondiff=True)
+nextafter = binary("nextafter", jnp.nextafter)
+ldexp = binary("ldexp", lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+heaviside = binary("heaviside", jnp.heaviside)
+gcd = binary("gcd", jnp.gcd, nondiff=True)
+lcm = binary("lcm", jnp.lcm, nondiff=True)
+
+
+def _polygamma(x, *, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def polygamma(x, n, name=None):
+    return dispatch.apply("polygamma", _polygamma, (x,), {"n": int(n)})
+
+
+def _frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+def frexp(x, name=None):
+    m, e = dispatch.apply("frexp", _frexp, (x,), nondiff=True)
+    return m, e
+
+
+# -------------------------------------------------------------- stacking
+def _along(fn):
+    def impl(*xs):
+        return fn(xs)
+
+    return impl
+
+
+def _stack_op(name, fn):
+    impl = _along(fn)  # stable identity -> per-op jit cache hits
+
+    def op(x, name=None):
+        return dispatch.apply(op_name, impl, tuple(x))
+
+    op_name = name
+    op.__name__ = op.__qualname__ = name
+    return op
+
+
+hstack = _stack_op("hstack", jnp.hstack)
+vstack = _stack_op("vstack", jnp.vstack)
+dstack = _stack_op("dstack", jnp.dstack)
+column_stack = _stack_op("column_stack", jnp.column_stack)
+row_stack = _stack_op("row_stack", jnp.vstack)
+
+
+def atleast_1d(*xs, name=None):
+    outs = [
+        dispatch.apply("atleast_1d", jnp.atleast_1d, (x,)) for x in xs
+    ]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = [
+        dispatch.apply("atleast_2d", jnp.atleast_2d, (x,)) for x in xs
+    ]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = [
+        dispatch.apply("atleast_3d", jnp.atleast_3d, (x,)) for x in xs
+    ]
+    return outs[0] if len(outs) == 1 else outs
+
+
+_block_diag_impl = _along(lambda xs: jax.scipy.linalg.block_diag(*xs))
+
+
+def block_diag(inputs, name=None):
+    return dispatch.apply("block_diag", _block_diag_impl, tuple(inputs))
+
+
+# ---------------------------------------------------------- manipulation
+def _rot90(x, *, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch.apply(
+        "rot90", _rot90, (x,), {"k": int(k), "axes": tuple(axes)}
+    )
+
+
+def fliplr(x, name=None):
+    return dispatch.apply("fliplr", jnp.fliplr, (x,))
+
+
+def flipud(x, name=None):
+    return dispatch.apply("flipud", jnp.flipud, (x,))
+
+
+def _unflatten(x, *, axis, shape):
+    s = list(x.shape)
+    return jnp.reshape(x, tuple(s[:axis]) + tuple(shape) + tuple(s[axis + 1:]))
+
+
+def unflatten(x, axis, shape, name=None):
+    ax = int(axis) % max(len(x.shape), 1)
+    return dispatch.apply(
+        "unflatten", _unflatten, (x,),
+        {"axis": ax, "shape": static_int_list(shape)},
+    )
+
+
+def _unfold(x, *, axis, size, step):
+    # sliding windows along axis (torch/paddle Tensor.unfold semantics):
+    # result appends a window dim of length `size`
+    n = (x.shape[axis] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, 0)
+    win = moved[idx]  # [n, size, ...rest]
+    win = jnp.moveaxis(win, (0, 1), (axis, len(x.shape)))
+    return win
+
+
+def unfold(x, axis, size, step, name=None):
+    ax = int(axis) % len(x.shape)
+    return dispatch.apply(
+        "unfold", _unfold, (x,),
+        {"axis": ax, "size": int(size), "step": int(step)},
+    )
+
+
+def _diagflat(x, *, offset):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return dispatch.apply("diagflat", _diagflat, (x,), {"offset": int(offset)})
+
+
+def vander(x, n=None, increasing=False, name=None):
+    cols = int(n) if n is not None else int(x.shape[0])
+
+    def _vander(v, *, cols, increasing):
+        return jnp.vander(v, N=cols, increasing=increasing)
+
+    return dispatch.apply(
+        "vander", _vander, (x,),
+        {"cols": cols, "increasing": bool(increasing)},
+    )
+
+
+def _slice_scatter(x, value, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    return dispatch.apply(
+        "slice_scatter", _slice_scatter, (x, value),
+        {
+            "axes": static_int_list(axes),
+            "starts": static_int_list(starts),
+            "ends": static_int_list(ends),
+            "strides": static_int_list(strides),
+        },
+    )
+
+
+def index_add(x, index, value, axis=0, name=None):
+    def _impl(xv, iv, vv, *, axis):
+        moved = jnp.moveaxis(xv, axis, 0)
+        vmoved = jnp.moveaxis(vv, axis, 0)
+        out = moved.at[iv.astype(jnp.int32)].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return dispatch.apply(
+        "index_add", _impl, (x, index, value),
+        {"axis": int(axis) % len(x.shape)},
+    )
+
+
+def _index_fill(x, index, *, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index.astype(jnp.int32)].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    return dispatch.apply(
+        "index_fill", _index_fill, (x, index),
+        {"axis": int(axis) % len(x.shape), "value": float(value)},
+    )
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of ``mask`` with consecutive values (paddle
+    semantics: value is consumed in row-major order)."""
+
+    def _impl(xv, mv, vv):
+        flat_x = xv.reshape(-1)
+        flat_m = mv.reshape(-1)
+        flat_v = vv.reshape(-1)
+        # position k in x takes value[#True before k]
+        rank = jnp.cumsum(flat_m) - 1
+        take = jnp.clip(rank, 0, flat_v.shape[0] - 1)
+        return jnp.where(
+            flat_m, flat_v[take], flat_x
+        ).reshape(xv.shape)
+
+    return dispatch.apply("masked_scatter", _impl, (x, mask, value))
+
+
+def take(x, index, mode="raise", name=None):
+    def _take(xv, iv, *, mode):
+        m = {"raise": "clip"}.get(mode, mode)  # no host-side raise in XLA
+        return jnp.take(xv.reshape(-1), iv.astype(jnp.int32), mode=m)
+
+    return dispatch.apply("take", _take, (x, index), {"mode": mode})
+
+
+# ------------------------------------------------------------ reductions
+def _cumextreme_impl(x, *, axis, combine):
+    fn = jnp.maximum if combine == "max" else jnp.minimum
+    vals = jax.lax.associative_scan(fn, x, axis=axis)
+    n = x.shape[axis]
+    ar = jnp.expand_dims(
+        jnp.arange(n, dtype=jnp.int32),
+        [d for d in range(x.ndim) if d != axis],
+    )
+    hit = (x == vals)
+    # last index achieving the running extreme (paddle ties-to-last)
+    idx = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(hit, ar, -1), axis=axis
+    )
+    return vals, idx
+
+
+def _cumextreme(name, x, axis, combine):
+    xv = x
+    if axis is None:
+        xv = xv.reshape([-1])
+        axis = 0
+    return dispatch.apply(
+        name, _cumextreme_impl, (xv,),
+        {"axis": int(axis) % max(len(xv.shape), 1), "combine": combine},
+    )
+
+
+def cummax(x, axis=None, name=None):
+    return _cumextreme("cummax", x, axis, "max")
+
+
+def cummin(x, axis=None, name=None):
+    return _cumextreme("cummin", x, axis, "min")
+
+
+def _trapezoid(y, x, *, dx, axis):
+    if x is None:
+        return jnp.trapezoid(y, dx=dx, axis=axis)
+    return jnp.trapezoid(y, x, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is None:
+        return dispatch.apply(
+            "trapezoid", lambda yv, *, dx, axis: jnp.trapezoid(
+                yv, dx=dx, axis=axis
+            ),
+            (y,), {"dx": 1.0 if dx is None else float(dx), "axis": int(axis)},
+        )
+    return dispatch.apply(
+        "trapezoid_x", lambda yv, xv, *, axis: jnp.trapezoid(
+            yv, xv, axis=axis
+        ),
+        (y, x), {"axis": int(axis)},
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return dispatch.apply(
+        "nanquantile",
+        lambda xv, *, q, axis, keepdim: jnp.nanquantile(
+            xv, jnp.asarray(q), axis=axis, keepdims=keepdim
+        ),
+        (x,),
+        {"q": float(q) if not isinstance(q, (list, tuple)) else tuple(q),
+         "axis": normalize_axis(axis), "keepdim": bool(keepdim)},
+    )
+
+
+# ----------------------------------------------------------- statistics
+def _histogram(x, *, bins, lo, hi):
+    h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return h
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    import numpy as _np
+
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        # data-dependent range must be static for the compiled op:
+        # resolve it host-side (eager semantics, as in the reference)
+        v = _np.asarray(
+            input.value if isinstance(input, Tensor) else input
+        )
+        lo, hi = float(v.min()), float(v.max())
+    return dispatch.apply(
+        "histogram", _histogram, (input,),
+        {"bins": int(bins), "lo": lo, "hi": hi}, nondiff=True,
+    )
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    rng = None
+    if ranges is not None:
+        flat = [float(v) for v in _host_list(ranges)]
+        rng = tuple(
+            (flat[i], flat[i + 1]) for i in range(0, len(flat), 2)
+        )
+
+    def _impl(*vals, bins, density, rng):
+        xv = vals[0]
+        wv = vals[1] if len(vals) > 1 else None
+        h, edges = jnp.histogramdd(
+            xv, bins=bins, range=rng, density=density, weights=wv
+        )
+        return (h,) + tuple(edges)
+
+    args = (x,) if weights is None else (x, weights)
+    out = dispatch.apply(
+        "histogramdd", _impl, args,
+        {"bins": bins if isinstance(bins, int) else tuple(bins),
+         "density": bool(density), "rng": rng}, nondiff=True,
+    )
+    return out[0], list(out[1:])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as _np
+
+    # output length is data-dependent: resolve host-side for the static
+    # shape the compiled op needs (eager semantics, as in the reference)
+    v = _np.asarray(x.value if isinstance(x, Tensor) else x)
+    length = max(int(minlength), int(v.max()) + 1 if v.size else 0, 1)
+    if weights is None:
+        return dispatch.apply(
+            "bincount",
+            lambda xv, *, length: jnp.bincount(
+                xv.astype(jnp.int32), length=length
+            ),
+            (x,), {"length": length}, nondiff=True,
+        )
+    return dispatch.apply(
+        "bincount_w",
+        lambda xv, wv, *, length: jnp.bincount(
+            xv.astype(jnp.int32), weights=wv, length=length
+        ),
+        (x, weights), {"length": length},
+    )
+
+
+def _cov(x, *, rowvar, ddof):
+    return jnp.cov(x, rowvar=rowvar, ddof=ddof)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    if fweights is not None or aweights is not None:
+        return dispatch.apply(
+            "cov_w",
+            lambda xv, *, rowvar, ddof, fw, aw: jnp.cov(
+                xv, rowvar=rowvar, ddof=ddof,
+                fweights=None if fw is None else jnp.asarray(fw),
+                aweights=None if aw is None else jnp.asarray(aw),
+            ),
+            (x,),
+            {"rowvar": bool(rowvar), "ddof": 1 if ddof else 0,
+             "fw": None if fweights is None else tuple(
+                 int(v) for v in _host_list(fweights)),
+             "aw": None if aweights is None else tuple(
+                 float(v) for v in _host_list(aweights))},
+            cache=False,
+        )
+    return dispatch.apply(
+        "cov", _cov, (x,), {"rowvar": bool(rowvar), "ddof": 1 if ddof else 0}
+    )
+
+
+def _host_list(v):
+    import numpy as _np
+
+    return _np.asarray(
+        v.numpy() if hasattr(v, "numpy") else v
+    ).reshape(-1).tolist()
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch.apply(
+        "corrcoef",
+        lambda xv, *, rowvar: jnp.corrcoef(xv, rowvar=rowvar),
+        (x,), {"rowvar": bool(rowvar)},
+    )
+
+
+# ------------------------------------------------------------- distance
+def dist(x, y, p=2, name=None):
+    def _dist(xv, yv, *, p):
+        import math as _math
+
+        d = (xv - yv).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(xv.dtype)
+        if _math.isinf(p):
+            return jnp.max(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return dispatch.apply("dist", _dist, (x, y), {"p": float(p)})
+
+
+def cdist(x, y, p=2.0, compute_mode=None, name=None):
+    def _cdist(xv, yv, *, p):
+        diff = xv[..., :, None, :] - yv[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return dispatch.apply("cdist", _cdist, (x, y), {"p": float(p)})
+
+
+def pdist(x, p=2.0, name=None):
+    def _pdist(xv, *, p):
+        n = xv.shape[0]
+        diff = xv[:, None, :] - xv[None, :, :]
+        if p == 2.0:
+            d = jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        else:
+            d = jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+
+    return dispatch.apply("pdist", _pdist, (x,), {"p": float(p)})
+
+
+# ------------------------------------------------------------ misc/logic
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return dispatch.apply(
+        "isin",
+        lambda xv, tv, *, invert: jnp.isin(xv, tv, invert=invert),
+        (x, test_x), {"invert": bool(invert)}, nondiff=True,
+    )
+
+
+def mv(x, vec, name=None):
+    return dispatch.apply("mv", lambda a, b: a @ b, (x, vec))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(
+            tuple(static_int_list(a)) if isinstance(a, (list, tuple))
+            else int(a)
+            for a in ax
+        )
+    return dispatch.apply(
+        "tensordot",
+        lambda a, b, *, axes: jnp.tensordot(a, b, axes=axes),
+        (x, y), {"axes": ax},
+    )
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def _renorm(xv, *, p, axis, max_norm):
+        moved = jnp.moveaxis(xv, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(
+            norms > max_norm, max_norm / (norms + 1e-12), 1.0
+        )
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return dispatch.apply(
+        "renorm", _renorm, (x,),
+        {"p": float(p), "axis": int(axis) % len(x.shape),
+         "max_norm": float(max_norm)},
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+
+    return list(
+        _np.broadcast_shapes(tuple(x_shape), tuple(y_shape))
+    )
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+
+    import numpy as _np
+
+    n = int(x.shape[0])
+    pool = (
+        itertools.combinations_with_replacement(range(n), r)
+        if with_replacement else itertools.combinations(range(n), r)
+    )
+    idx = _np.asarray(list(pool), dtype=_np.int32)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+
+    def _comb(xv, *, idx_tuple, r):
+        iarr = jnp.asarray(idx_tuple, jnp.int32).reshape(-1, r)
+        return xv[iarr]
+
+    return dispatch.apply(
+        "combinations", _comb, (x,),
+        {"idx_tuple": tuple(map(tuple, idx.tolist())), "r": int(r)},
+    )
+
+
+def polar(abs, angle, name=None):
+    return dispatch.apply(
+        "polar",
+        lambda a, t: (a * jnp.cos(t) + 1j * a * jnp.sin(t)).astype(
+            jnp.complex64
+        ),
+        (abs, angle),
+    )
+
+
+def view_as_complex(x, name=None):
+    return dispatch.apply(
+        "view_as_complex",
+        lambda v: jax.lax.complex(v[..., 0], v[..., 1]),
+        (x,),
+    )
+
+
+def view_as_real(x, name=None):
+    return dispatch.apply(
+        "view_as_real",
+        lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+        (x,),
+    )
+
+
+def poisson(x, name=None):
+    from ..core import random as random_mod
+
+    def _poisson(lam, *, key):
+        return jax.random.poisson(key, lam).astype(lam.dtype)
+
+    return dispatch.apply(
+        "poisson", _poisson, (x,), {"key": random_mod.next_key()},
+        cache=False, nondiff=True,
+    )

@@ -272,3 +272,19 @@ def _householder_product(a, tau):
 
 def householder_product(x, tau, name=None):
     return dispatch.apply("householder_product", _householder_product, (x, tau))
+
+
+def _eigvals(a):
+    return jnp.linalg.eigvals(a)
+
+
+def eigvals(x, name=None):
+    return dispatch.apply("eigvals", _eigvals, (x,), nondiff=True)
+
+
+def _svdvals(a):
+    return jnp.linalg.svdvals(a)
+
+
+def svdvals(x, name=None):
+    return dispatch.apply("svdvals", _svdvals, (x,))
