@@ -3,6 +3,8 @@ from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from .fleet import Fleet, HybridParallelOptimizer, fleet  # noqa: F401
 
+from ..ps import PaddleCloudRoleMaker  # noqa: F401
+
 init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
@@ -11,6 +13,11 @@ worker_index = fleet.worker_index
 worker_num = fleet.worker_num
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
+is_server = fleet.is_server
+is_worker = fleet.is_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
 
 
 def __getattr__(name):

@@ -66,6 +66,21 @@ class Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
+        # PS mode: a role_maker with server/worker roles switches fleet
+        # into the parameter-server runtime. The reference call shape is
+        # fleet.init(role) (its is_collective defaults False), so detect
+        # the role maker itself rather than keying on our default.
+        if (
+            role_maker is not None
+            and hasattr(role_maker, "is_server")
+            and not getattr(role_maker, "_is_collective", False)
+        ):
+            from ..ps import PSContext
+
+            self._role = role_maker
+            self._ps = PSContext(role_maker)
+            self._initialized = True
+            return self
         init_parallel_env()
         n_chips = len(jax.devices())
         hc = dict(self._strategy.hybrid_configs)
@@ -98,22 +113,62 @@ class Fleet:
         return self._hcg
 
     def is_first_worker(self):
+        r = getattr(self, "_role", None)
+        if r is not None:
+            return r.is_first_worker()
         return dist_env.get_rank() == 0
 
     def worker_index(self):
+        r = getattr(self, "_role", None)
+        if r is not None:
+            return r.trainer_id
         return dist_env.get_rank()
 
     def worker_num(self):
+        r = getattr(self, "_role", None)
+        if r is not None:
+            return r.trainers_num
         return dist_env.get_world_size()
 
     def is_worker(self):
-        return True
+        r = getattr(self, "_role", None)
+        return True if r is None else r.is_worker()
+
+    # ------------------------------------------------------------- PS mode
+    def is_server(self):
+        r = getattr(self, "_role", None)
+        return False if r is None else r.is_server()
+
+    @property
+    def ps(self):
+        return getattr(self, "_ps", None)
+
+    def init_server(self, *args, **kwargs):
+        """Tables are created lazily by the first worker push in this
+        build; kept for reference-call-sequence parity."""
+        assert self.is_server(), "init_server on a non-server role"
+
+    def run_server(self):
+        assert self.is_server(), "run_server on a non-server role"
+        self._ps.run_server()
+
+    def stop_worker(self):
+        if getattr(self, "_ps", None) is not None:
+            self._ps.stop_servers()
 
     def worker_endpoints(self, to_string=False):
-        eps = dist_env.get_trainer_endpoints()
+        if getattr(self, "_ps", None) is not None:
+            eps = self._ps.trainer_endpoints()
+        else:
+            eps = dist_env.get_trainer_endpoints()
         return ",".join(eps) if to_string else eps
 
     def barrier_worker(self):
+        if getattr(self, "_ps", None) is not None:
+            # PS mode has no collective runtime; barrier through server 0
+            if self.is_worker():
+                self._ps.barrier()
+            return
         from ..communication import barrier
 
         barrier()

@@ -36,7 +36,14 @@ def _parse_args(argv):
                         "(defaults to the master host for every node)")
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--gpus", "--devices", dest="devices", type=str, default="")
-    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--run_mode", type=str, default="collective",
+                   help="collective (default) or ps (parameter-server)")
+    p.add_argument("--server_num", type=int,
+                   default=int(os.environ.get("PADDLE_SERVER_NUM", "1")),
+                   help="ps mode: number of server processes")
+    p.add_argument("--trainer_num", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_NUM", "2")),
+                   help="ps mode: number of trainer processes")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--max_restart", type=int,
                    default=int(os.environ.get("PADDLE_ELASTIC_MAX_RESTART", "0")))
@@ -170,8 +177,50 @@ def _kill(procs):
         logf.close()
 
 
+def _spawn_ps(args):
+    """PS controller: server + trainer processes with the reference env
+    contract (TRAINING_ROLE / PADDLE_PSERVERS_IP_PORT_LIST / ...)."""
+    master = args.master or "127.0.0.1:49920"
+    host = master.split(":")[0]
+    base_port = int(master.split(":")[1]) if ":" in master else 49920
+    server_eps = ",".join(
+        f"{host}:{base_port + i}" for i in range(args.server_num)
+    )
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+
+    def child(role, idx):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": role,
+            "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+            "PADDLE_TRAINERS_NUM": str(args.trainer_num),
+            "PADDLE_TRAINER_ID": str(idx if role == "TRAINER" else 0),
+            "PADDLE_SERVER_ID": str(idx if role == "PSERVER" else 0),
+            "PADDLE_MASTER": master,
+        })
+        tag = f"{role.lower()}.{idx}"
+        log_path = os.path.join(args.log_dir, f"workerlog.{tag}")
+        logf = open(log_path, "w")
+        cmd = [sys.executable, "-u", args.training_script] \
+            + args.training_script_args
+        procs.append((
+            subprocess.Popen(cmd, env=env, stdout=logf,
+                             stderr=subprocess.STDOUT),
+            logf, log_path,
+        ))
+
+    for i in range(args.server_num):
+        child("PSERVER", i)
+    for i in range(args.trainer_num):
+        child("TRAINER", i)
+    return procs
+
+
 def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.run_mode == "ps":
+        return _watch(_spawn_ps(args))
     manager = None
     if ":" in args.nnodes:
         lo, _, hi = args.nnodes.partition(":")

@@ -1,0 +1,251 @@
+"""Parameter-server mode (minimal, reference §2.1 'Parameter server' row).
+
+Reference parity: paddle/fluid/distributed/ps/ + fleet PS runtime
+(unverified, mount empty): dedicated server processes host parameter
+tables; trainer processes pull fresh parameters, compute gradients on
+their own data shards, and push gradients back; the server applies
+updates immediately (fully asynchronous SGD — the recommender-system
+training mode).
+
+TPU build scope: PS mode exists in the reference for sparse recommender
+workloads that don't fit accelerators; none of the BASELINE configs use
+it, so this is a faithful SKELETON over paddle_tpu.distributed.rpc —
+dense tables, pull/push-grad with server-side SGD/Adam application,
+round-robin table sharding across multiple servers, and the fleet role
+surface (PaddleCloudRoleMaker env contract, is_server/is_worker,
+init_server/run_server/stop_worker). Numpy end to end: PS traffic is
+host-side by design.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .. import rpc
+
+
+class DenseTable:
+    """One parameter tensor + its server-side optimizer state."""
+
+    def __init__(self, name, value, optimizer="sgd", lr=0.01,
+                 beta1=0.9, beta2=0.999, eps=1e-8):
+        self.name = name
+        self.value = np.asarray(value, np.float32)
+        self.optimizer = optimizer
+        self.lr = lr
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._m = np.zeros_like(self.value)
+        self._v = np.zeros_like(self.value)
+        self._t = 0
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push_grad(self, grad):
+        g = np.asarray(grad, np.float32)
+        with self._lock:
+            if self.optimizer == "adam":
+                self._t += 1
+                self._m = self.beta1 * self._m + (1 - self.beta1) * g
+                self._v = self.beta2 * self._v + (1 - self.beta2) * g * g
+                mh = self._m / (1 - self.beta1 ** self._t)
+                vh = self._v / (1 - self.beta2 ** self._t)
+                self.value -= self.lr * mh / (np.sqrt(vh) + self.eps)
+            else:  # async SGD
+                self.value -= self.lr * g
+
+
+class ParameterServer:
+    """Process-global table host (one per PSERVER process)."""
+
+    def __init__(self):
+        self.tables = {}
+        self._stop = threading.Event()
+        self._create_lock = threading.Lock()
+        self._barriers = {}
+
+    def create(self, name, value, **kw):
+        # rpc handlers run on a thread pool: the check-then-insert must
+        # be atomic or a second create could replace a live table
+        with self._create_lock:
+            if name not in self.tables:
+                self.tables[name] = DenseTable(name, value, **kw)
+        return name
+
+
+_SERVER: ParameterServer | None = None
+
+
+# ---- RPC-executed functions (run inside the server process) -----------
+def _ps_create(name, value, kw):
+    _SERVER.create(name, value, **kw)
+    return True
+
+
+def _ps_pull(name):
+    return _SERVER.tables[name].pull()
+
+
+def _ps_push(name, grad):
+    _SERVER.tables[name].push_grad(grad)
+    return True
+
+
+def _ps_pull_many(names):
+    return {n: _SERVER.tables[n].pull() for n in names}
+
+
+def _ps_push_many(grads):
+    for n, g in grads.items():
+        _SERVER.tables[n].push_grad(g)
+    return True
+
+
+def _ps_stop():
+    _SERVER._stop.set()
+    return True
+
+
+def _ps_barrier(tag, worker, n):
+    """Arrive + poll: returns True once all n workers arrived at tag."""
+    with _SERVER._create_lock:
+        arrived = _SERVER._barriers.setdefault(tag, set())
+        arrived.add(worker)
+        return len(arrived) >= n
+
+
+def _server_names():
+    infos = rpc.get_all_worker_infos()
+    return [w.name for w in infos if w.name.startswith("ps_server")]
+
+
+def _shard_of(name):
+    import zlib
+
+    # stable across processes (hash() is salted per interpreter)
+    servers = _server_names()
+    return servers[zlib.crc32(name.encode()) % len(servers)]
+
+
+# ------------------------------------------------------------ role maker
+class PaddleCloudRoleMaker:
+    """Reads the reference PS env contract: TRAINING_ROLE
+    (PSERVER/TRAINER), PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_TRAINERS_NUM,
+    PADDLE_TRAINER_ID, POD_IP/PADDLE_PORT."""
+
+    def __init__(self, is_collective=False, **kw):
+        self._is_collective = bool(is_collective)
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        self.server_endpoints = [
+            e for e in os.environ.get(
+                "PADDLE_PSERVERS_IP_PORT_LIST", ""
+            ).split(",") if e
+        ]
+        self.trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.server_index = int(os.environ.get("PADDLE_SERVER_ID", 0))
+
+    def is_server(self):
+        return self.role == "PSERVER"
+
+    def is_worker(self):
+        return self.role == "TRAINER"
+
+    def is_first_worker(self):
+        return self.is_worker() and self.trainer_id == 0
+
+
+class PSContext:
+    """The fleet-facing PS runtime for one process."""
+
+    def __init__(self, role: PaddleCloudRoleMaker,
+                 master_endpoint=None):
+        self.role = role
+        n_servers = max(len(role.server_endpoints), 1)
+        world = n_servers + role.trainers_num
+        if role.is_server():
+            name = f"ps_server{role.server_index}"
+            rank = role.server_index
+        else:
+            name = f"ps_worker{role.trainer_id}"
+            rank = n_servers + role.trainer_id
+        master = master_endpoint or os.environ.get(
+            "PADDLE_MASTER",
+            (role.server_endpoints[0] if role.server_endpoints
+             else "127.0.0.1:49920"),
+        )
+        global _SERVER
+        if role.is_server():
+            _SERVER = ParameterServer()
+        rpc.init_rpc(name, rank=rank, world_size=world,
+                     master_endpoint=master)
+        self.name = name
+
+    # ---------------------------------------------------------- server
+    def run_server(self):
+        """Serve until a worker calls stop (reference run_server blocks)."""
+        _SERVER._stop.wait()
+        rpc.shutdown()
+
+    # ---------------------------------------------------------- worker
+    def create_tables(self, named_params, optimizer="sgd", lr=0.01):
+        for n, v in named_params.items():
+            rpc.rpc_sync(
+                _shard_of(n), _ps_create,
+                args=(n, np.asarray(v, np.float32),
+                      {"optimizer": optimizer, "lr": lr}),
+            )
+
+    def pull(self, names):
+        by_server = {}
+        for n in names:
+            by_server.setdefault(_shard_of(n), []).append(n)
+        out = {}
+        futs = [
+            (rpc.rpc_async(s, _ps_pull_many, args=(ns,)))
+            for s, ns in by_server.items()
+        ]
+        for f in futs:
+            out.update(f.result())
+        return out
+
+    def push(self, grads):
+        by_server = {}
+        for n, g in grads.items():
+            by_server.setdefault(_shard_of(n), {})[n] = np.asarray(g)
+        futs = [
+            rpc.rpc_async(s, _ps_push_many, args=(gs,))
+            for s, gs in by_server.items()
+        ]
+        for f in futs:
+            f.result()
+
+    def barrier(self, tag="default"):
+        """Synchronize all trainers through server 0 (PS-mode analog of
+        fleet.barrier_worker — gloo in the reference)."""
+        import time
+
+        server = _server_names()[0]
+        n = self.role.trainers_num
+        self._barrier_gen = getattr(self, "_barrier_gen", 0) + 1
+        full_tag = f"{tag}:{self._barrier_gen}"
+        while not rpc.rpc_sync(
+            server, _ps_barrier, args=(full_tag, self.name, n)
+        ):
+            time.sleep(0.05)
+
+    def trainer_endpoints(self):
+        return [
+            f"{w.ip}:{w.port}"
+            for w in rpc.get_all_worker_infos()
+            if w.name.startswith("ps_worker")
+        ]
+
+    def stop_servers(self):
+        for s in _server_names():
+            rpc.rpc_sync(s, _ps_stop)
+        rpc.shutdown()
