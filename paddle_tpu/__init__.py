@@ -130,3 +130,4 @@ from .utils.flags import get_flags, set_flags  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import models  # noqa: E402
+from . import sparse  # noqa: E402

@@ -1,0 +1,228 @@
+"""paddle.sparse parity (minimal): COO/CSR tensors over jax BCOO.
+
+Reference parity: python/paddle/sparse + phi sparse kernels
+(SparseCooTensor/SparseCsrTensor — unverified, mount empty). TPU scope:
+sparse formats exist in the reference mainly for recommender embeddings
+and sparse research ops; none of the BASELINE configs exercise them, so
+this module provides the core surface — construction, conversion,
+elementwise + matmul compute — over `jax.experimental.sparse.BCOO`
+(XLA-compilable scatter/gather under the hood), not the full ~100-op
+sparse library.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+def _val(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        # dense materialization for paths without a sparse kernel
+        return _coo(x)._bcoo.todense()
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (wraps a jax BCOO)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    # ------------------------------------------------------- conversion
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    # ---------------------------------------------------------- compute
+    def matmul(self, other):
+        return matmul(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (
+            f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self.dtype})"
+        )
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (2-D): stored as crows/cols/values; compute
+    routes through COO."""
+
+    def __init__(self, crows, cols, values, shape):
+        self.crows = jnp.asarray(_val(crows), jnp.int32)
+        self.cols = jnp.asarray(_val(cols), jnp.int32)
+        self.data = _val(values)
+        self._shape = [int(s) for s in shape]
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def crows_cols_values(self):
+        return Tensor(self.crows), Tensor(self.cols), Tensor(self.data)
+
+    def to_sparse_coo(self, sparse_dim=2):
+        counts = jnp.diff(self.crows)
+        rows = jnp.repeat(
+            jnp.arange(self._shape[0], dtype=jnp.int32), counts,
+            total_repeat_length=self.nnz(),
+        )
+        idx = jnp.stack([rows, self.cols], axis=1)
+        return SparseCooTensor(
+            jsparse.BCOO((self.data, idx), shape=tuple(self._shape))
+        )
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (
+            f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+            f"dtype={self.dtype})"
+        )
+
+
+# -------------------------------------------------------------- creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [ndim, nnz] (paddle layout); values: [nnz, ...]."""
+    idx = jnp.asarray(_val(indices), jnp.int32).T  # -> [nnz, ndim]
+    vals = _val(values)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        if idx.shape[0] == 0:
+            raise ValueError(
+                "shape is required for an empty (nnz=0) sparse tensor"
+            )
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(
+        jsparse.BCOO((vals, idx), shape=tuple(int(s) for s in shape))
+    )
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = _val(values)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> SparseCooTensor (reference Tensor.to_sparse_coo).
+    ``sparse_dim`` keeps trailing dims dense (hybrid COO: values become
+    [nnz, *dense_dims])."""
+    v = _val(x)
+    n_dense = 0 if sparse_dim is None else v.ndim - int(sparse_dim)
+    if n_dense < 0 or n_dense > v.ndim:
+        raise ValueError(
+            f"sparse_dim {sparse_dim} out of range for {v.ndim}-d tensor"
+        )
+    return SparseCooTensor(jsparse.BCOO.fromdense(v, n_dense=n_dense))
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+# --------------------------------------------------------------- compute
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (the reference's spmm); dense @ dense passes
+    through."""
+    x = _coo(x)
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ _val(y)
+        return Tensor(out)
+    return Tensor(_val(x) @ _val(y))
+
+
+def add(x, y, name=None):
+    x, y = _coo(x), _coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor((x._bcoo + y._bcoo).sum_duplicates())
+    if isinstance(x, SparseCooTensor):
+        return Tensor(x._bcoo.todense() + _val(y))
+    return Tensor(_val(x) + _val(y))
+
+
+def multiply(x, y, name=None):
+    """Elementwise; sparse * scalar keeps sparsity."""
+    x = _coo(x)
+    if isinstance(x, SparseCooTensor) and np.isscalar(y):
+        return SparseCooTensor(
+            jsparse.BCOO((x._bcoo.data * y, x._bcoo.indices),
+                         shape=x._bcoo.shape)
+        )
+    if isinstance(x, SparseCooTensor):
+        return Tensor(x._bcoo.todense() * _val(y))
+    return Tensor(_val(x) * _val(y))
+
+
+def relu(x, name=None):
+    x = _coo(x)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(
+            jsparse.BCOO(
+                (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+                shape=x._bcoo.shape,
+            )
+        )
+    return Tensor(jnp.maximum(_val(x), 0))
