@@ -39,6 +39,20 @@ class HybridParallelOptimizer:
     def step(self):
         if self._model is not None and hasattr(self._model, "sync_gradients"):
             self._model.sync_gradients()
+        elif (
+            self._hcg is not None
+            and dist_env.get_world_size() > 1
+            and self._hcg.get_data_parallel_world_size() > 1
+        ):
+            # non-DataParallel wrappers (PipelineParallel, bare TP nets)
+            # still need the dp-axis grad reduction in multi-process runs
+            from .utils.hybrid_parallel_util import (
+                fused_allreduce_gradients,
+            )
+
+            fused_allreduce_gradients(
+                [p for _, p in self._inner._all_params()], hcg=self._hcg
+            )
         self._inner.step()
 
     def minimize(self, loss, **kw):

@@ -5,3 +5,4 @@ path for activation recomputation.
 """
 from ..recompute.recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401

@@ -20,9 +20,7 @@ import os
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
-from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 from . import env as dist_env
 
@@ -132,44 +130,14 @@ class DataParallel(Layer):
             p for p in self._layers.parameters() if not p.stop_gradient
         ]
 
-    # fused-buffer cap per collective, mirroring the reference reducer's
-    # comm_buffer_size_MB default (reducer.cc — unverified, mount empty)
-    _COMM_BUCKET_BYTES = 25 * 1024 * 1024
-
     def sync_gradients(self):
         if dist_env.get_world_size() <= 1:
-            return
-        group = self._dp_group
-        params = [p for p in self._dp_params if p.grad is not None]
-        if not params:
-            return
-        # bucket by dtype (no silent promotion on concat; grads come back
-        # in their own dtype) and by size (bounds peak fused-buffer memory)
-        buckets: dict = {}
-        for p in params:
-            buckets.setdefault(str(p.grad.value.dtype), []).append(p)
-        for _, plist in buckets.items():
-            chunk, chunk_bytes = [], 0
-            for p in plist:
-                nbytes = p.grad.size * p.grad.value.dtype.itemsize
-                if chunk and chunk_bytes + nbytes > self._COMM_BUCKET_BYTES:
-                    self._reduce_bucket(group, chunk)
-                    chunk, chunk_bytes = [], 0
-                chunk.append(p)
-                chunk_bytes += nbytes
-            if chunk:
-                self._reduce_bucket(group, chunk)
+            return  # hooks (and _dp_params) only exist multi-process
+        from .fleet.utils.hybrid_parallel_util import (
+            fused_allreduce_gradients,
+        )
 
-    @staticmethod
-    def _reduce_bucket(group, params):
-        flat = jnp.concatenate([p.grad.value.reshape(-1) for p in params])
-        t = Tensor(flat)
-        group.all_reduce(t, op="mean")
-        off = 0
-        for p in params:
-            n = p.grad.size
-            p.grad = Tensor(t.value[off : off + n].reshape(p.grad.value.shape))
-            off += n
+        fused_allreduce_gradients(self._dp_params, group=self._dp_group)
 
     # delegate attribute access to the wrapped layers (paddle parity)
     def __getattr__(self, name):

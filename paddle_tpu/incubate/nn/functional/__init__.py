@@ -73,6 +73,14 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
 
 # --------------------------------------------------------------------- rope
 def _rope_neox(tv, c, s):
+    if str(tv.dtype) == "float16":
+        # Mosaic TPU rejects f16 ('Unsupported type in mosaic dialect');
+        # composed rotation instead — XLA fuses it
+        half = tv.shape[-1] // 2
+        x1, x2 = tv[..., :half], tv[..., half:]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        return jnp.concatenate([o1, o2], axis=-1).astype(tv.dtype)
     from ....kernels.rope import rope_fused
 
     return rope_fused(tv, c, s)

@@ -40,12 +40,18 @@ class CompiledTrainStep:
     )
 
     def __init__(self, network, loss_fn, optimizer, amp_level=None,
-                 amp_dtype="bfloat16"):
+                 amp_dtype="bfloat16", scaler=None):
         self.network = network
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+        # fp16 dynamic loss scaling fused INTO the compiled step: scale
+        # the loss, unscale grads, skip the update on inf/nan, and grow/
+        # shrink the scale — all in-trace (reference GradScaler + fp16)
+        self.scaler = scaler if (
+            scaler is not None and getattr(scaler, "_enable", True)
+        ) else None
         self._kind = None
         for cls in self.SUPPORTED:
             if type(optimizer) is cls or isinstance(optimizer, cls):
@@ -163,10 +169,36 @@ class CompiledTrainStep:
         # layout; XLA realizes the reduce-scatter + sharded-update pattern
         grad_placements = getattr(opt, "_grad_placements", None) or {}
 
-        def step(params, opt_state, buffers, lr, t, rng, inputs, labels):
-            (loss, (new_buffers, out_vals)), grads = jax.value_and_grad(
-                loss_of, has_aux=True
-            )(params, buffers, rng, inputs, labels)
+        scaler = self.scaler
+
+        def step(params, opt_state, buffers, lr, t, rng, inputs, labels,
+                 scale=None, good=None, bad=None):
+            if scaler is not None:
+                def scaled_loss_of(params, buffers, rng, inputs, labels):
+                    loss, aux = loss_of(params, buffers, rng, inputs,
+                                        labels)
+                    return loss * scale, (aux, loss)
+
+                (
+                    (_, ((new_buffers, out_vals), loss)),
+                    grads,
+                ) = jax.value_and_grad(scaled_loss_of, has_aux=True)(
+                    params, buffers, rng, inputs, labels
+                )
+                inv = (1.0 / scale).astype(jnp.float32)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype),
+                    grads,
+                )
+                finite = jnp.all(jnp.asarray([
+                    jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)
+                ]))
+            else:
+                (loss, (new_buffers, out_vals)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True
+                )(params, buffers, rng, inputs, labels)
+                finite = None
 
             if grad_placements:
                 grads = {
@@ -239,6 +271,37 @@ class CompiledTrainStep:
                     )
                     new_params[k] = np_
                     new_state[k] = (m2, v2)
+
+            if scaler is not None:
+                # non-finite grads: keep params/state, adjust the scale
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old
+                )
+                new_params = keep(new_params, params)
+                new_state = keep(new_state, opt_state)
+                good2 = jnp.where(finite, good + 1, 0)
+                bad2 = jnp.where(finite, 0, bad + 1)
+                if scaler._dynamic:
+                    scale2 = jnp.where(
+                        good2 >= scaler._incr_every,
+                        scale * scaler._incr_ratio, scale,
+                    )
+                    good2 = jnp.where(
+                        good2 >= scaler._incr_every, 0, good2
+                    )
+                    # decrease floors at 1.0 (eager update() parity):
+                    # an unfloored scale decays to 0 and 1/scale poisons
+                    # every later step
+                    scale2 = jnp.where(
+                        bad2 >= scaler._decr_every,
+                        jnp.maximum(scale * scaler._decr_ratio, 1.0),
+                        scale2,
+                    )
+                    bad2 = jnp.where(bad2 >= scaler._decr_every, 0, bad2)
+                else:
+                    scale2 = scale  # static-scale mode: never adjusted
+                return (new_params, new_state, new_buffers, loss, out_vals,
+                        scale2, good2, bad2, finite)
             return new_params, new_state, new_buffers, loss, out_vals
 
         self._step = step
@@ -281,10 +344,11 @@ class CompiledTrainStep:
             or any(s for pins in state_pins.values() for s in pins)
         )
         if any_pin:
-            def step(params, opt_state, buffers, lr, t, rng, inputs, labels):
-                new_params, new_state, new_buffers, loss, out_vals = base(
-                    params, opt_state, buffers, lr, t, rng, inputs, labels
-                )
+            def step(params, opt_state, buffers, lr, t, rng, inputs, labels,
+                     *extra):
+                new_params, new_state, new_buffers, loss, out_vals, *rest = \
+                    base(params, opt_state, buffers, lr, t, rng, inputs,
+                         labels, *extra)
                 new_params = {
                     k: (
                         jax.lax.with_sharding_constraint(v, param_pins[k])
@@ -312,7 +376,8 @@ class CompiledTrainStep:
                     )
                     for k, v in new_buffers.items()
                 }
-                return new_params, new_state, new_buffers, loss, out_vals
+                return (new_params, new_state, new_buffers, loss, out_vals,
+                        *rest)
         else:
             step = base
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
@@ -332,9 +397,28 @@ class CompiledTrainStep:
         rng = random_mod.next_key()
         in_vals = tuple(_unwrap(x) for x in inputs)
         lbl_vals = tuple(_unwrap(y) for y in labels)
-        new_params, new_state, new_buffers, loss, out_vals = self._step_fn(
-            params, opt_state, buffers, lr, t, rng, in_vals, lbl_vals
-        )
+        if self.scaler is not None:
+            sc = self.scaler
+            (new_params, new_state, new_buffers, loss, out_vals,
+             scale2, good2, bad2, finite) = self._step_fn(
+                params, opt_state, buffers, lr, t, rng, in_vals, lbl_vals,
+                jnp.float32(sc._scale), jnp.int32(sc._good_steps),
+                jnp.int32(sc._bad_steps),
+            )
+            sc._scale = float(scale2)
+            sc._good_steps = int(good2)
+            sc._bad_steps = int(bad2)
+            sc._found_inf = not bool(finite)
+            if sc._found_inf:
+                # the update was skipped: bias-correction time must not
+                # advance (reference optimizers see no step either)
+                self.optimizer._step_count -= 1
+        else:
+            new_params, new_state, new_buffers, loss, out_vals = \
+                self._step_fn(
+                    params, opt_state, buffers, lr, t, rng, in_vals,
+                    lbl_vals,
+                )
         # write back: imperative objects stay the source of truth
         lookup = dict(self.network.named_parameters())
         for k, v in new_params.items():

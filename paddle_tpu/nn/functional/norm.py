@@ -123,10 +123,15 @@ def _rms_norm(x, w, b, *, eps, begin_axis):
 
 
 def _fused_rms_available(x, weight, bias, begin_axis):
-    """Pallas fused path: TPU, last-axis norm, weight-only."""
+    """Pallas fused path: TPU, last-axis norm, weight-only. fp16 is
+    excluded — the Mosaic TPU dialect rejects f16 ('Unsupported type in
+    mosaic dialect'); fp16 AMP runs use the composed path, which XLA
+    fuses anyway."""
     if bias is not None or weight is None:
         return False
     if begin_axis != x.ndim - 1:
+        return False
+    if str(getattr(x, "dtype", "")) == "float16":
         return False
     import jax as _j
 

@@ -275,3 +275,50 @@ def test_grad_accumulation_average_and_flush():
         np.asarray(net.weight.grad.numpy()), 0.0
     )
     assert not np.allclose(np.asarray(net.weight.numpy()), w_before)
+
+
+def test_compiled_step_with_grad_scaler():
+    """fp16-style dynamic loss scaling fused into the compiled step:
+    good steps grow the scale, non-finite grads skip the update and
+    shrink it (reference GradScaler semantics)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(
+        init_loss_scaling=256.0, incr_every_n_steps=2,
+        decr_every_n_nan_or_inf=1,
+    )
+    step = CompiledTrainStep(net, lambda o, y: ((o - y) ** 2).mean(),
+                             opt, scaler=scaler)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 4), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 4), jnp.float32)
+
+    losses = [float(np.asarray(
+        step([Tensor(x)], [Tensor(y)])[0].numpy()
+    )) for _ in range(4)]
+    assert losses[-1] < losses[0]          # actually trains
+    assert scaler._scale == 256.0 * 4      # grew every 2 good steps
+    assert not scaler._found_inf
+
+    # poison one batch: update must be SKIPPED and the scale halved
+    w_before = np.asarray(net.weight.numpy())
+    t_before = opt._step_count
+    bad = jnp.asarray(np.full((8, 4), np.nan, np.float32))
+    step([Tensor(bad)], [Tensor(y)])
+    assert scaler._found_inf
+    assert scaler._scale == 256.0 * 4 * 0.5
+    np.testing.assert_array_equal(np.asarray(net.weight.numpy()), w_before)
+    assert opt._step_count == t_before  # bias correction did not advance
+
+    # recovery: training continues from the unpoisoned state
+    l2 = float(np.asarray(step([Tensor(x)], [Tensor(y)])[0].numpy()))
+    assert np.isfinite(l2) and l2 <= losses[-1] * 1.5
