@@ -14,4 +14,5 @@ from .bert import (  # noqa: F401
     BertModel,
     BertPretrainingCriterion,
 )
+from .gpt_moe import GPTMoEConfig, GPTMoEForCausalLM  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401

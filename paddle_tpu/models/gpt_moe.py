@@ -1,0 +1,155 @@
+"""GPT-MoE decoder (BASELINE config #5: GPT-MoE under Fleet EP).
+
+Reference parity: the GPT + MoE pairing of the reference's incubate MoE
+stack (python/paddle/incubate/distributed/models/moe — unverified, mount
+empty; the GPT trunk itself lives in the ecosystem repos). TPU-first
+design: pre-LN GPT blocks (learned positions, GELU) where every
+``moe_every``-th block swaps its dense FFN for a MoELayer — experts
+stacked [E, ...] and sharded over the ep mesh axes, GShard top-2 gating
+with capacity/drop, einsum dispatch lowering to the all-to-all under
+SPMD. The summed gate aux losses are exposed for the training loss.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..incubate.distributed.models.moe import MoELayer
+
+
+@dataclass
+class GPTMoEConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    num_experts: int = 8
+    moe_every: int = 2  # every 2nd block uses the MoE FFN
+    gate: str = "gshard"
+    capacity_factor: tuple = (1.25, 2.0)
+    layer_norm_eps: float = 1e-5
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(
+            vocab_size=128, hidden_size=32, num_hidden_layers=4,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, num_experts=4,
+        )
+        base.update(kw)
+        return GPTMoEConfig(**base)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTMoEConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.heads = cfg.num_attention_heads
+        self.head_dim = h // self.heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+
+    def forward(self, x):
+        b, s = int(x.shape[0]), int(x.shape[1])
+        qkv = self.qkv(x).reshape([b, s, 3, self.heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, training=self.training
+        )
+        return self.proj(out.reshape([b, s, -1]))
+
+
+class GPTMoEBlock(nn.Layer):
+    def __init__(self, cfg: GPTMoEConfig, use_moe: bool):
+        super().__init__()
+        h = cfg.hidden_size
+        self.ln1 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(h, epsilon=cfg.layer_norm_eps)
+        self.use_moe = use_moe
+        if use_moe:
+            self.mlp = MoELayer(
+                d_model=h, num_expert=cfg.num_experts,
+                d_hidden=cfg.intermediate_size,
+                gate={"type": cfg.gate,
+                      "capacity_factor": cfg.capacity_factor},
+            )
+        else:
+            self.mlp = nn.Sequential(
+                nn.Linear(h, cfg.intermediate_size),
+                nn.GELU(),
+                nn.Linear(cfg.intermediate_size, h),
+            )
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        return x + self.mlp(self.ln2(x))
+
+
+class GPTMoEForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTMoEConfig):
+        super().__init__()
+        if cfg.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1, got {cfg.moe_every} (use the "
+                "plain GPT/Llama families for an all-dense model)"
+            )
+        if cfg.num_hidden_layers < cfg.moe_every:
+            raise ValueError(
+                f"num_hidden_layers {cfg.num_hidden_layers} < moe_every "
+                f"{cfg.moe_every}: no block would be MoE — this is the "
+                "MoE model family"
+            )
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size
+        )
+        self.blocks = nn.LayerList([
+            GPTMoEBlock(cfg, use_moe=(i % cfg.moe_every == cfg.moe_every - 1))
+            for i in range(cfg.num_hidden_layers)
+        ])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_eps)
+        self.lm_head = nn.Linear(
+            cfg.hidden_size, cfg.vocab_size, bias_attr=False
+        )
+
+    def forward(self, input_ids):
+        s = int(input_ids.shape[1])
+        if s > int(self.wpe.weight.shape[0]):
+            raise ValueError(
+                f"sequence length {s} exceeds max_position_embeddings "
+                f"{int(self.wpe.weight.shape[0])}"
+            )
+        pos = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        h = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            h = blk(h)
+        return self.lm_head(self.ln_f(h))
+
+    def aux_loss(self):
+        """Summed gate load-balance losses of the MoE blocks (add
+        ``cfg.aux_loss_weight * model.aux_loss()`` into the training
+        loss inside the same step/trace)."""
+        total = None
+        for blk in self.blocks:
+            if blk.use_moe and blk.mlp.l_aux is not None:
+                total = blk.mlp.l_aux if total is None \
+                    else total + blk.mlp.l_aux
+        if total is None:
+            raise RuntimeError(
+                "aux_loss() before any forward: gate losses are recorded "
+                "per step"
+            )
+        return total
+
+    def num_params(self):
+        return sum(int(p.size) for p in self.parameters())

@@ -1,0 +1,89 @@
+"""GPT-MoE model family (config #5): dense/MoE block mix, aux loss,
+ep-sharded compiled training parity.
+
+Reference parity target: the GPT-MoE Fleet EP acceptance config
+(BASELINE.json #5).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.jit.trainer import CompiledTrainStep
+from paddle_tpu.models import GPTMoEConfig, GPTMoEForCausalLM
+
+CFG = GPTMoEConfig.tiny()
+B, S = 4, 16
+
+
+@pytest.fixture(scope="module")
+def hcg():
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [2, 1, 1, 1, 4]
+    )
+    return HybridCommunicateGroup(topo)
+
+
+def test_structure_and_forward(hcg):
+    paddle.seed(0)
+    net = GPTMoEForCausalLM(CFG)
+    net.eval()
+    moe_flags = [blk.use_moe for blk in net.blocks]
+    assert moe_flags == [False, True, False, True]  # moe_every=2
+    ids = Tensor(jnp.asarray(
+        np.random.RandomState(0).randint(0, CFG.vocab_size, (B, S))
+    ))
+    out = net(ids)
+    assert list(out.shape) == [B, S, CFG.vocab_size]
+    aux = net.aux_loss()
+    assert np.isfinite(float(aux.numpy()))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        net(Tensor(jnp.zeros(
+            (1, CFG.max_position_embeddings + 1), jnp.int32)))
+    with pytest.raises(ValueError, match="moe_every"):
+        GPTMoEForCausalLM(GPTMoEConfig.tiny(moe_every=0))
+    with pytest.raises(ValueError, match="no block would be MoE"):
+        GPTMoEForCausalLM(GPTMoEConfig.tiny(moe_every=8))
+
+
+def _losses(seed, steps=5):
+    paddle.seed(seed)
+    net = GPTMoEForCausalLM(CFG)
+    opt = paddle.optimizer.AdamW(5e-3, parameters=net.parameters())
+
+    def loss_fn(logits, labels):
+        ce = F.cross_entropy(
+            logits.reshape([-1, CFG.vocab_size]), labels.reshape([-1])
+        )
+        return ce + CFG.aux_loss_weight * net.aux_loss()
+
+    step = CompiledTrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, (B, S)))
+    return [
+        float(np.asarray(step([Tensor(ids)], [Tensor(ids)])[0].numpy()))
+        for _ in range(steps)
+    ]
+
+
+def test_compiled_training_with_aux_loss(hcg):
+    losses = _losses(42)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_ep_sharding_installed(hcg):
+    paddle.seed(1)
+    net = GPTMoEForCausalLM(CFG)
+    from jax.sharding import NamedSharding
+
+    moe = net.blocks[1].mlp
+    s = moe.w1.value.sharding
+    assert isinstance(s, NamedSharding) and s.spec[0] == "dp"
