@@ -37,7 +37,9 @@ def _parse_args(argv):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--gpus", "--devices", dest="devices", type=str, default="")
     p.add_argument("--run_mode", type=str, default="collective",
-                   help="collective (default) or ps (parameter-server)")
+                   choices=["collective", "ps", "rpc"],
+                   help="collective (default), ps (parameter-server), or "
+                        "rpc (named-worker RPC group)")
     p.add_argument("--server_num", type=int,
                    default=int(os.environ.get("PADDLE_SERVER_NUM", "1")),
                    help="ps mode: number of server processes")
@@ -221,6 +223,18 @@ def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     if args.run_mode == "ps":
         return _watch(_spawn_ps(args))
+    if args.run_mode == "rpc":
+        # rpc controller: the collective env contract (PADDLE_TRAINER_ID /
+        # PADDLE_TRAINERS_NUM / PADDLE_MASTER) is exactly what
+        # distributed.rpc.init_rpc reads for its defaults. Elasticity is
+        # a collective-mode feature: a named rpc group cannot be resized
+        # in place, so an elastic range is rejected rather than ignored.
+        if ":" in args.nnodes:
+            raise SystemExit(
+                "--run_mode rpc does not support an elastic --nnodes "
+                "range (named rpc groups are fixed-size)"
+            )
+        return _watch(_spawn(args, int(args.nnodes)))
     manager = None
     if ":" in args.nnodes:
         lo, _, hi = args.nnodes.partition(":")

@@ -104,3 +104,44 @@ def test_two_process_rpc():
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, out[-2000:]
         assert f"RPC-OK-{r}" in out, out[-2000:]
+
+
+RPC_LAUNCH_SCRIPT = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed.rpc as rpc
+
+    def ping():
+        return "pong"
+
+    rpc.init_rpc(f"w{{__import__('os').environ['PADDLE_TRAINER_ID']}}")
+    peers = [w.name for w in rpc.get_all_worker_infos()]
+    assert len(peers) == 2, peers
+    other = [n for n in peers if n != rpc.get_worker_info().name][0]
+    assert rpc.rpc_sync(other, ping) == "pong"
+    print("RPC-LAUNCH-OK")
+    rpc.shutdown()
+""")
+
+
+def test_rpc_controller_via_launcher(tmp_path):
+    """--run_mode rpc: the launcher's env contract feeds init_rpc
+    defaults (reference controllers/rpc.py role)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(RPC_LAUNCH_SCRIPT.format(repo=repo))
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--run_mode", "rpc", "--nproc_per_node", "2",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        cwd=repo, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    logs = "".join(
+        open(os.path.join(tmp_path, "log", f)).read()
+        for f in sorted(os.listdir(tmp_path / "log"))
+    )
+    assert logs.count("RPC-LAUNCH-OK") == 2, logs[-1500:]
