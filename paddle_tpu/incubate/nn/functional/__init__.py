@@ -213,7 +213,10 @@ _ACTS = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "none": lambda v: v}
 def _linear_act_fn(xv, yv, bv, *, trans_x, trans_y, act):
     a = xv.T if trans_x else xv
     b = yv.T if trans_y else yv
-    return _ACTS[act](jnp.matmul(a, b) + bv)
+    y = jnp.matmul(a, b)
+    if bv is not None:  # None keeps the activation dtype (no f32 zeros)
+        y = y + bv
+    return _ACTS[act](y)
 
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
@@ -254,3 +257,100 @@ def ulysses_attention(q, k, v, causal=True, axis=None, name=None):
     from ....parallel.sep_ops import ulysses_attention as _uly
 
     return _uly(q, k, v, causal=causal, axis=axis)
+
+
+def fused_multi_head_attention(
+    x, qkv_weight, linear_weight, pre_layer_norm=False, pre_ln_scale=None,
+    pre_ln_bias=None, ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+    qkv_bias=None, linear_bias=None, cache_kv=None, attn_mask=None,
+    dropout_rate=0.5, attn_dropout_rate=0.5, ln_epsilon=1e-5,
+    training=True, mode="upscale_in_train", ring_id=-1, add_residual=True,
+    num_heads=None, name=None,
+):
+    """paddle.incubate.nn.functional.fused_multi_head_attention parity:
+    (pre/post-LN) -> one QKV gemm -> attention -> out proj -> dropout +
+    residual. qkv_weight accepts the reference [3, H, D, E] layout or a
+    flat [E, 3E] (qkv_bias correspondingly [3, H, D] or [3E])."""
+    from ....nn import functional as F
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cache_kv (decode-time KV caching) is not supported here"
+        )
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "ring_id tensor parallelism: use the fleet mp_layers instead"
+        )
+    e = int(x.shape[-1])
+    qw = qkv_weight
+    if len(qw.shape) == 4:  # [3, H, D, E] -> [E, 3E]
+        if num_heads is None:
+            num_heads = int(qw.shape[1])
+        qw = qw.reshape([3 * num_heads * int(qw.shape[2]), e]).t()
+        if qkv_bias is not None and len(qkv_bias.shape) == 3:
+            qkv_bias = qkv_bias.reshape([-1])  # [3, H, D] -> [3E]
+    elif num_heads is None:
+        raise ValueError("num_heads is required with a flat qkv_weight")
+    head_dim = e // num_heads
+
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, (e,), weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    b, s = int(h.shape[0]), int(h.shape[1])
+    qkv = fused_linear(h, qw, qkv_bias)
+    qkv = qkv.reshape([b, s, 3, num_heads, head_dim])
+    out = F.scaled_dot_product_attention(
+        qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate, training=training,
+    )
+    out = fused_linear(out.reshape([b, s, e]), linear_weight, linear_bias)
+    if add_residual:
+        out = fused_dropout_add(out, residual, p=dropout_rate,
+                                training=training, mode=mode)
+    else:
+        out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (e,), weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(
+    x, linear1_weight, linear2_weight, linear1_bias=None, linear2_bias=None,
+    ln1_scale=None, ln1_bias=None, ln2_scale=None, ln2_bias=None,
+    dropout1_rate=0.5, dropout2_rate=0.5, activation="relu",
+    ln1_epsilon=1e-5, ln2_epsilon=1e-5, pre_layer_norm=False,
+    training=True, mode="upscale_in_train", ring_id=-1,
+    add_residual=True, name=None,
+):
+    """paddle.incubate.nn.functional.fused_feedforward parity:
+    (pre/post-LN) -> linear+act -> dropout -> linear -> dropout +
+    residual."""
+    from ....nn import functional as F
+
+    if activation not in ("gelu", "relu"):
+        raise ValueError(
+            f"fused_feedforward supports gelu/relu, got {activation!r}"
+        )
+    e = int(x.shape[-1])
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, (e,), weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    h = fused_linear_activation(
+        h, linear1_weight, linear1_bias, activation=activation
+    )
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    if add_residual:
+        out = fused_dropout_add(h, residual, p=dropout2_rate,
+                                training=training, mode=mode)
+    else:
+        out = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    if not pre_layer_norm:
+        out = F.layer_norm(out, (e,), weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out

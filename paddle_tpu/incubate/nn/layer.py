@@ -74,29 +74,18 @@ class FusedMultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         from . import functional as IF
 
-        residual = query
-        x = query
-        if self.normalize_before:
-            x = F.layer_norm(x, (self.embed_dim,), weight=self.pre_ln_scale,
-                             bias=self.pre_ln_bias, epsilon=self._epsilon)
-        B, S = int(x.shape[0]), int(x.shape[1])
-        qkv = IF.fused_linear(x, self.qkv_weight, self.qkv_bias)
-        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
-        q, k, v = (
-            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            attn_dropout_rate=self._attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            num_heads=self.num_heads,
         )
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self._attn_dropout_rate, training=self.training,
-        )
-        out = out.reshape([B, S, self.embed_dim])
-        out = IF.fused_linear(out, self.linear_weight, self.linear_bias)
-        out = IF.fused_dropout_add(out, residual, p=self._dropout_rate,
-                                   training=self.training)
-        if not self.normalize_before:
-            out = F.layer_norm(out, (self.embed_dim,), weight=self.ln_scale,
-                               bias=self.ln_bias, epsilon=self._epsilon)
-        return out
 
 
 class FusedFeedForward(Layer):
@@ -152,20 +141,15 @@ class FusedFeedForward(Layer):
     def forward(self, src, cache=None):
         from . import functional as IF
 
-        residual = src
-        x = src
-        if self.normalize_before:
-            x = F.layer_norm(x, (self._d_model,), weight=self.ln1_scale,
-                             bias=self.ln1_bias, epsilon=self._epsilon)
-        h = IF.fused_linear_activation(
-            x, self.linear1_weight, self.linear1_bias,
-            activation=self._act if self._act in ("gelu", "relu") else "none",
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._act, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training,
         )
-        h = F.dropout(h, p=self._act_dropout_rate, training=self.training)
-        h = IF.fused_linear(h, self.linear2_weight, self.linear2_bias)
-        out = IF.fused_dropout_add(h, residual, p=self._dropout_rate,
-                                   training=self.training)
-        if not self.normalize_before:
-            out = F.layer_norm(out, (self._d_model,), weight=self.ln2_scale,
-                               bias=self.ln2_bias, epsilon=self._epsilon)
-        return out

@@ -300,3 +300,111 @@ class TestLlama:
             for _ in range(3)
         ]
         assert losses[-1] < losses[0]
+
+
+def test_fused_mha_functional_matches_layer():
+    """incubate.nn.functional.fused_multi_head_attention must compute the
+    same function as the FusedMultiHeadAttention layer (weights shared)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.nn.layer import FusedMultiHeadAttention
+
+    E, H, B, S = 32, 4, 2, 6
+    paddle.seed(0)
+    layer = FusedMultiHeadAttention(
+        E, H, dropout_rate=0.0, attn_dropout_rate=0.0,
+        normalize_before=False,
+    )
+    layer.eval()
+    x = paddle.randn([B, S, E])
+    want = np.asarray(layer(x).numpy())
+    got = IF.fused_multi_head_attention(
+        x, layer.qkv_weight, layer.linear_weight,
+        pre_layer_norm=False, ln_scale=layer.ln_scale,
+        ln_bias=layer.ln_bias, qkv_bias=layer.qkv_bias,
+        linear_bias=layer.linear_bias, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False, num_heads=H,
+    )
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+    # reference [3, H, D, E] qkv layout accepted too
+    qkv_4d = Tensor(jnp.transpose(
+        layer.qkv_weight.value.reshape(E, 3, H, E // H), (1, 2, 3, 0)
+    ))
+    got2 = IF.fused_multi_head_attention(
+        x, qkv_4d, layer.linear_weight, ln_scale=layer.ln_scale,
+        ln_bias=layer.ln_bias, qkv_bias=layer.qkv_bias,
+        linear_bias=layer.linear_bias, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False,
+    )
+    np.testing.assert_allclose(np.asarray(got2.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ffn_functional_matches_layer():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.incubate.nn.layer import FusedFeedForward
+
+    E, FF, B, S = 32, 64, 2, 6
+    paddle.seed(1)
+    layer = FusedFeedForward(E, FF, dropout_rate=0.0, activation="gelu",
+                             normalize_before=True)
+    layer.eval()
+    x = paddle.randn([B, S, E])
+    want = np.asarray(layer(x).numpy())
+    got = IF.fused_feedforward(
+        x, layer.linear1_weight, layer.linear2_weight,
+        linear1_bias=layer.linear1_bias, linear2_bias=layer.linear2_bias,
+        ln1_scale=layer.ln1_scale, ln1_bias=layer.ln1_bias,
+        dropout1_rate=0.0, dropout2_rate=0.0, activation="gelu",
+        pre_layer_norm=True, training=False,
+    )
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_mha_reference_bias_layout():
+    """[3, H, D] qkv_bias (the reference pairing of the 4D weight) must
+    flatten with the weight."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.incubate.nn.layer import FusedMultiHeadAttention
+
+    E, H = 32, 4
+    paddle.seed(2)
+    layer = FusedMultiHeadAttention(E, H, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0)
+    layer.eval()
+    x = paddle.randn([2, 5, E])
+    want = np.asarray(layer(x).numpy())
+    qkv_4d = Tensor(jnp.transpose(
+        layer.qkv_weight.value.reshape(E, 3, H, E // H), (1, 2, 3, 0)
+    ))
+    bias_3d = Tensor(layer.qkv_bias.value.reshape(3, H, E // H))
+    got = IF.fused_multi_head_attention(
+        x, qkv_4d, layer.linear_weight, ln_scale=layer.ln_scale,
+        ln_bias=layer.ln_bias, qkv_bias=bias_3d,
+        linear_bias=layer.linear_bias, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False,
+    )
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+    import pytest
+
+    with pytest.raises(NotImplementedError, match="cache_kv"):
+        IF.fused_multi_head_attention(
+            x, qkv_4d, layer.linear_weight, cache_kv=x, num_heads=H)
+    with pytest.raises(ValueError, match="gelu/relu"):
+        IF.fused_feedforward(x, layer.linear_weight, layer.linear_weight,
+                             activation="swish")
