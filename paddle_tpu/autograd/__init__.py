@@ -4,6 +4,7 @@ Reference parity: python/paddle/autograd/ (unverified, mount empty).
 """
 from ..core.tape import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
 from .backward import backward, grad, run_backward
+from .functional import hessian, jacobian, jvp, vjp
 from .py_layer import PyLayer, PyLayerContext
 
 __all__ = [
@@ -13,6 +14,10 @@ __all__ = [
     "is_grad_enabled",
     "backward",
     "grad",
+    "jacobian",
+    "hessian",
+    "jvp",
+    "vjp",
     "PyLayer",
     "PyLayerContext",
 ]

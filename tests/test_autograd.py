@@ -2,8 +2,11 @@
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 import paddle_tpu as paddle
 from paddle_tpu.autograd import PyLayer
+from paddle_tpu.core.tensor import Tensor
 
 
 def _leaf(data):
@@ -196,3 +199,84 @@ def test_clear_grad_and_zero():
     np.testing.assert_allclose(x.grad.numpy(), [0.0])
     x.clear_grad()
     assert x.grad is None
+
+
+class TestFunctionalAutograd:
+    """paddle.autograd.jacobian/hessian/jvp/vjp vs numpy oracles."""
+
+    def test_jacobian_single_and_tuple(self):
+        A = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        x = Tensor(jnp.asarray([1.0, -1.0], dtype=jnp.float32))
+
+        def f(v):
+            return (Tensor(jnp.asarray(A)) @ v) * 2.0
+
+        J = paddle.autograd.jacobian(f, x)
+        np.testing.assert_allclose(np.asarray(J.numpy()), 2 * A, rtol=1e-6)
+
+        def g(a, b):
+            return a * b  # elementwise
+
+        Ja, Jb = paddle.autograd.jacobian(
+            g, [Tensor(jnp.asarray([2.0, 3.0])),
+                Tensor(jnp.asarray([5.0, 7.0]))]
+        )
+        np.testing.assert_allclose(
+            np.asarray(Ja.numpy()), np.diag([5.0, 7.0]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(Jb.numpy()), np.diag([2.0, 3.0]), rtol=1e-6
+        )
+
+    def test_hessian_quadratic(self):
+        Q = np.array([[2.0, 1.0], [1.0, 4.0]], np.float32)
+
+        def f(v):
+            return 0.5 * (v @ (Tensor(jnp.asarray(Q)) @ v))
+
+        H = paddle.autograd.hessian(f, Tensor(jnp.asarray([1.0, 2.0])))
+        np.testing.assert_allclose(np.asarray(H.numpy()), Q, rtol=1e-5)
+
+    def test_jvp_vjp(self):
+        x = Tensor(jnp.asarray([1.0, 2.0, 3.0]))
+        v = Tensor(jnp.asarray([1.0, 0.0, -1.0]))
+
+        def f(t):
+            return (t * t).sum()
+
+        out, tang = paddle.autograd.jvp(f, x, v)
+        assert float(out.numpy()) == 14.0
+        assert float(tang.numpy()) == float(2 * 1 - 2 * 3)
+        out2, grad = paddle.autograd.vjp(f, x)
+        np.testing.assert_allclose(
+            np.asarray(grad.numpy()), [2.0, 4.0, 6.0], rtol=1e-6
+        )
+
+    def test_hessian_rejects_vector_output(self):
+        with pytest.raises(ValueError, match="scalar"):
+            paddle.autograd.hessian(
+                lambda v: v * 2.0, Tensor(jnp.asarray([1.0, 2.0]))
+            )
+
+    def test_multi_output_jvp_vjp(self):
+        x = Tensor(jnp.asarray([1.0, 2.0]))
+
+        def f(t):
+            return (t * 2.0, (t * t).sum())
+
+        outs, tangs = paddle.autograd.jvp(f, x, Tensor(jnp.asarray([1.0, 0.0])))
+        np.testing.assert_allclose(np.asarray(tangs[0].numpy()), [2.0, 0.0])
+        assert float(tangs[1].numpy()) == 2.0  # d(sum t^2) dir [1,0] = 2t_0
+        outs2, grad = paddle.autograd.vjp(f, x)  # ones cotangents
+        np.testing.assert_allclose(
+            np.asarray(grad.numpy()), [2.0 + 2.0, 2.0 + 4.0], rtol=1e-6
+        )
+
+    def test_unsupported_kwargs_raise(self):
+        x = Tensor(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(NotImplementedError, match="create_graph"):
+            paddle.autograd.jacobian(lambda t: t * 2, x, create_graph=True)
+        with pytest.raises(NotImplementedError, match="batch_axis"):
+            paddle.autograd.hessian(
+                lambda t: (t * t).sum(), x, batch_axis=0
+            )
