@@ -1,0 +1,87 @@
+"""paddle.fft over jnp.fft: numpy oracles + grads.
+
+Reference parity target: test/legacy_test fft op tests (unverified,
+mount empty).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(0)
+X = RNG.randn(4, 16).astype(np.float32)
+XC = (RNG.randn(4, 16) + 1j * RNG.randn(4, 16)).astype(np.complex64)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+def A(t):
+    return np.asarray(t.numpy())
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft_ifft_roundtrip(norm):
+    f = paddle.fft.fft(T(XC), norm=norm)
+    np.testing.assert_allclose(
+        A(f), np.fft.fft(XC, norm=norm), rtol=1e-4, atol=1e-4
+    )
+    back = paddle.fft.ifft(f, norm=norm)
+    np.testing.assert_allclose(A(back), XC, rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_family():
+    r = paddle.fft.rfft(T(X))
+    np.testing.assert_allclose(A(r), np.fft.rfft(X), rtol=1e-4, atol=1e-4)
+    back = paddle.fft.irfft(r, n=16)
+    np.testing.assert_allclose(A(back), X, rtol=1e-4, atol=1e-4)
+    h = paddle.fft.hfft(T(XC[:, :9]), n=16)
+    np.testing.assert_allclose(
+        A(h), np.fft.hfft(XC[:, :9], n=16), rtol=1e-3, atol=1e-3
+    )
+
+
+def test_2d_and_nd():
+    img = RNG.randn(3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        A(paddle.fft.fft2(T(img.astype(np.complex64)))),
+        np.fft.fft2(img), rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        A(paddle.fft.rfftn(T(img), axes=[1, 2])),
+        np.fft.rfftn(img, axes=[1, 2]), rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_freq_shift_helpers():
+    np.testing.assert_allclose(
+        A(paddle.fft.fftfreq(8, d=0.5)), np.fft.fftfreq(8, d=0.5)
+    )
+    np.testing.assert_allclose(
+        A(paddle.fft.rfftfreq(8)), np.fft.rfftfreq(8)
+    )
+    np.testing.assert_allclose(
+        A(paddle.fft.fftshift(T(X))), np.fft.fftshift(X)
+    )
+    np.testing.assert_allclose(
+        A(paddle.fft.ifftshift(T(np.fft.fftshift(X)))), X
+    )
+
+
+def test_norm_validation():
+    with pytest.raises(ValueError, match="norm"):
+        paddle.fft.fft(T(XC), norm="bogus")
+
+
+def test_rfft_grad_flows():
+    x = T(X)
+    x.stop_gradient = False
+    y = paddle.fft.rfft(x)
+    (y.abs() ** 2).sum().backward()
+    g = A(x.grad)
+    assert g.shape == X.shape and np.isfinite(g).all()
+    assert np.abs(g).sum() > 0
