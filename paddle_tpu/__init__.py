@@ -74,7 +74,10 @@ _TENSOR_METHODS = (
     "less_than less_equal greater_than greater_equal logical_and logical_or "
     "logical_xor logical_not bitwise_and bitwise_or bitwise_xor bitwise_not "
     "isclose allclose equal_all norm det inv pinv cholesky matrix_power "
-    "slice pad index_put"
+    "slice pad index_put copysign gammaln gammainc gammaincc positive "
+    "negative vecdot reduce_as view view_as as_strided select_scatter "
+    "diagonal_scatter tensor_split hsplit vsplit dsplit isreal crop "
+    "matrix_exp lu_unpack"
 ).split()
 
 for _name in _TENSOR_METHODS:

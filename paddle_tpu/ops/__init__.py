@@ -16,10 +16,11 @@ from . import (
     math,
     reduction,
     search,
+    tail,
 )
 
 _MODULES = [creation, math, reduction, manipulation, search, logic, linalg,
-            extras]
+            extras, tail]
 
 # helper/infra names that are callable but are NOT ops
 _EXCLUDE = {

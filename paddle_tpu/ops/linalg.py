@@ -252,17 +252,23 @@ def multi_dot(x, name=None):
     return dispatch.apply("multi_dot", _multi_dot, tuple(x))
 
 
+def _reflector(a, i):
+    """i-th geqrf Householder vector: unit at i, a[i+1:, i] below."""
+    m = a.shape[-2]
+    v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
+    return v.at[..., i].set(1.0)
+
+
 def _householder_product(a, tau):
-    # form Q from householder reflectors (geqrf layout)
+    # form Q from householder reflectors (geqrf layout): H = I - tau v v^H
     m, n = a.shape[-2], a.shape[-1]
     q = jnp.eye(m, dtype=a.dtype)
     q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
 
     def body(i, q):
-        v = jnp.where(jnp.arange(m) > i, a[..., :, i], 0.0)
-        v = v.at[..., i].set(1.0)
+        v = _reflector(a, i)
         t = tau[..., i]
-        vvt = jnp.einsum("...i,...j->...ij", v, v)
+        vvt = jnp.einsum("...i,...j->...ij", v, jnp.conj(v))
         h = jnp.eye(m, dtype=a.dtype) - t[..., None, None] * vvt
         return jnp.matmul(q, h)
 
@@ -288,3 +294,93 @@ def _svdvals(a):
 
 def svdvals(x, name=None):
     return dispatch.apply("svdvals", _svdvals, (x,))
+
+
+def _matrix_exp(a):
+    return jax.scipy.linalg.expm(a)
+
+
+def matrix_exp(x, name=None):
+    return dispatch.apply("matrix_exp", _matrix_exp, (x,))
+
+
+def _lu_perm(piv, m):
+    """LAPACK sequential-swap pivots -> permutation vector over rows."""
+    perm = jnp.arange(m, dtype=jnp.int32)
+
+    def body(i, perm):
+        j = piv[i]
+        pi, pj = perm[i], perm[j]
+        return perm.at[i].set(pj).at[j].set(pi)
+
+    return jax.lax.fori_loop(0, piv.shape[0], body, perm)
+
+
+def _lu_unpack(lu_mat, piv, *, unpack_ludata, unpack_pivots):
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    outs = []
+    if unpack_pivots:
+        perm_fn = _lu_perm
+        for _ in range(piv.ndim - 1):  # batched pivots
+            perm_fn = jax.vmap(perm_fn, in_axes=(0, None))
+        perm = perm_fn(piv, m)
+        # rows perm of A equal L@U, so A = P @ L @ U with P[perm[i], i]=1
+        p = jnp.swapaxes(
+            jnp.take(jnp.eye(m, dtype=lu_mat.dtype), perm, axis=0), -2, -1
+        )
+        outs.append(p)
+    else:
+        outs.append(jnp.zeros((0,), lu_mat.dtype))
+    if unpack_ludata:
+        lower = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(
+            m, k, dtype=lu_mat.dtype
+        )
+        upper = jnp.triu(lu_mat[..., :k, :])
+        outs.extend([lower, upper])
+    else:
+        z = jnp.zeros((0,), lu_mat.dtype)
+        outs.extend([z, z])
+    return tuple(outs)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack ``paddle.linalg.lu`` output into (P, L, U) with A = P @ L @ U."""
+    return dispatch.apply(
+        "lu_unpack", _lu_unpack, (x, y),
+        {"unpack_ludata": bool(unpack_ludata),
+         "unpack_pivots": bool(unpack_pivots)},
+    )
+
+
+def _ormqr(a, tau, other, *, left, transpose):
+    # Apply the k reflectors H_i = I - tau_i v_i v_i^H directly to `other`
+    # (O(k*m*p)) instead of materialising the full m x m Q. Q = H_0...H_{k-1};
+    # Q^H applies conjugated taus in the opposite order.
+    k = tau.shape[-1]
+
+    def step(i, x):
+        idx = k - 1 - i if (left != transpose) else i
+        v = _reflector(a, idx)
+        t = jnp.conj(tau[..., idx]) if transpose else tau[..., idx]
+        if left:
+            # x <- x - t * v (v^H x)
+            vx = jnp.einsum("...m,...mp->...p", jnp.conj(v), x)
+            return x - t[..., None, None] * jnp.einsum(
+                "...m,...p->...mp", v, vx
+            )
+        # x <- x - t * (x v) v^H
+        xv = jnp.einsum("...pm,...m->...p", x, v)
+        return x - t[..., None, None] * jnp.einsum(
+            "...p,...m->...pm", xv, jnp.conj(v)
+        )
+
+    return jax.lax.fori_loop(0, k, step, other)
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by the Q of a geqrf-style (x, tau) factorization."""
+    return dispatch.apply(
+        "ormqr", _ormqr, (x, tau, other),
+        {"left": bool(left), "transpose": bool(transpose)},
+    )

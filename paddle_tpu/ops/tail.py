@@ -1,0 +1,234 @@
+"""Remaining long-tail tensor ops: view/scatter surgery, split family,
+special functions (reference parity: python/paddle/tensor/{manipulation,
+math}.py rows — unverified, mount empty).
+
+Same contract as ops/extras.py: each op is one pure jnp function routed
+through core.dispatch (per-op jit + vjp autograd; fused inside whole-step
+jit). All jax fns are module-level (stable identity) so dispatch's
+fn-keyed jit cache hits across calls. View-like ops (``view``/
+``as_strided``) are gathers on TPU — XLA has no aliasing views across jit
+boundaries, so semantics are value-level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ._helpers import binary, static_int_list, unary
+
+# ----------------------------------------------------------- elementwise
+copysign = binary("copysign", jnp.copysign)
+gammaln = unary("gammaln", lambda x: jax.scipy.special.gammaln(x))
+gammainc = binary("gammainc", lambda a, x: jax.scipy.special.gammainc(a, x))
+gammaincc = binary("gammaincc", lambda a, x: jax.scipy.special.gammaincc(a, x))
+isreal = unary("isreal", jnp.isreal, nondiff=True)
+positive = unary("positive", jnp.positive)
+negative = unary("negative", jnp.negative)
+
+
+def _vecdot(xv, yv, *, axis):
+    return jnp.sum(jnp.conj(xv) * yv, axis=axis)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return dispatch.apply("vecdot", _vecdot, (x, y), {"axis": int(axis)})
+
+
+def _reduce_as(xv, *, axes, ts):
+    out = jnp.sum(xv, axis=axes) if axes else xv
+    return out.reshape(ts)
+
+
+def reduce_as(x, target, name=None):
+    """Sum ``x`` down to ``target``'s shape (the broadcast adjoint)."""
+    xs, ts = tuple(x.shape), tuple(target.shape)
+    lead = len(xs) - len(ts)
+    axes = tuple(range(lead)) + tuple(
+        lead + i for i, t in enumerate(ts) if t == 1 and xs[lead + i] != 1
+    )
+    return dispatch.apply(
+        "reduce_as", _reduce_as, (x,), {"axes": axes, "ts": ts}
+    )
+
+
+# ------------------------------------------------------- view-like ops
+def _view_dtype(xv, *, dt):
+    return xv.view(dt)
+
+
+def view(x, shape_or_dtype, name=None):
+    """Value-level view: reshape, or dtype reinterpretation (bitcast)."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        from .manipulation import reshape
+
+        return reshape(x, shape_or_dtype)
+    from ..core.dtypes import convert_dtype
+
+    dt = jnp.dtype(convert_dtype(shape_or_dtype))
+    return dispatch.apply(
+        "view_dtype", _view_dtype, (x,), {"dt": dt}, nondiff=True
+    )
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+
+    return reshape(x, list(other.shape))
+
+
+def _as_strided(xv, *, shape, stride, offset):
+    flat = xv.reshape(-1)
+    idx = jnp.asarray(offset, jnp.int32)
+    for n, s in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(n, dtype=jnp.int32) * s
+    return flat[idx.reshape(shape)]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided gather over x's contiguous flat buffer.
+
+    TPU/XLA has no aliasing views; this materialises the strided window
+    as a gather (differentiable via scatter-add in the vjp).
+    """
+    shape = static_int_list(shape)
+    stride = static_int_list(stride)
+    if isinstance(shape, int):
+        shape = (shape,)
+    if isinstance(stride, int):
+        stride = (stride,)
+    if len(shape) != len(stride):
+        raise ValueError(
+            "as_strided: shape and stride must have equal length, got "
+            f"{shape} vs {stride}"
+        )
+    size = 1
+    for d in x.shape:
+        size *= int(d)
+    lo = hi = int(offset)
+    for n, s in zip(shape, stride):
+        span = (n - 1) * s
+        lo, hi = lo + min(0, span), hi + max(0, span)
+    if shape and (lo < 0 or hi >= size):
+        raise ValueError(
+            f"as_strided: window [{lo}, {hi}] out of bounds for tensor of "
+            f"{size} elements (shape={shape}, stride={stride}, "
+            f"offset={offset})"
+        )
+    return dispatch.apply(
+        "as_strided", _as_strided, (x,),
+        {"shape": shape, "stride": stride, "offset": int(offset)},
+    )
+
+
+def _crop(xv, *, offsets, shape):
+    return jax.lax.slice(xv, offsets, [o + s for o, s in zip(offsets, shape)])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    nd = len(x.shape)
+    shape = list(static_int_list(shape)) if shape is not None else list(x.shape)
+    offsets = (
+        list(static_int_list(offsets)) if offsets is not None else [0] * nd
+    )
+    # -1 in shape: take everything from the offset to the end of that dim
+    for i in range(nd):
+        if shape[i] == -1:
+            shape[i] = int(x.shape[i]) - offsets[i]
+    return dispatch.apply(
+        "crop", _crop, (x,), {"offsets": tuple(offsets), "shape": tuple(shape)}
+    )
+
+
+# ------------------------------------------------------ scatter surgery
+def _select_scatter(xv, vv, *, axis, index):
+    moved = jnp.moveaxis(xv, axis, 0)
+    moved = moved.at[index].set(vv.astype(xv.dtype))
+    return jnp.moveaxis(moved, 0, axis)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    axis = int(axis) % len(x.shape)
+    index = int(index) % int(x.shape[axis])
+    return dispatch.apply(
+        "select_scatter", _select_scatter, (x, values),
+        {"axis": axis, "index": index},
+    )
+
+
+def _diagonal_scatter(xv, yv, *, offset, axis1, axis2):
+    moved = jnp.moveaxis(xv, (axis1, axis2), (-2, -1))
+    m, n = moved.shape[-2], moved.shape[-1]
+    if offset >= 0:
+        length = min(m, n - offset)
+        rows = jnp.arange(length)
+        cols = rows + offset
+    else:
+        length = min(m + offset, n)
+        rows = jnp.arange(length) - offset
+        cols = jnp.arange(length)
+    moved = moved.at[..., rows, cols].set(yv.astype(xv.dtype))
+    return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    nd = len(x.shape)
+    return dispatch.apply(
+        "diagonal_scatter", _diagonal_scatter, (x, y),
+        {"offset": int(offset), "axis1": int(axis1) % nd,
+         "axis2": int(axis2) % nd},
+    )
+
+
+# --------------------------------------------------------- split family
+def _tensor_split(xv, *, starts, sizes, axis):
+    return tuple(
+        jax.lax.slice_in_dim(xv, st, st + sz, axis=axis)
+        for st, sz in zip(starts, sizes)
+    )
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    axis = int(axis) % len(x.shape)
+    dim = int(x.shape[axis])
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, extra = divmod(dim, n)
+        sizes = [base + 1] * extra + [base] * (n - extra)
+        starts = []
+        s = 0
+        for sz in sizes:
+            starts.append(s)
+            s += sz
+    else:
+        pts = [int(p) for p in static_int_list(num_or_indices)]
+        # numpy semantics: negative indices wrap, out-of-range clamps,
+        # reversed pairs produce empty segments at the clamped start
+        pts = [min(max(p + dim if p < 0 else p, 0), dim) for p in pts]
+        bounds = [0] + pts + [dim]
+        starts = bounds[:-1]
+        sizes = [max(0, b - a) for a, b in zip(bounds[:-1], bounds[1:])]
+    out = dispatch.apply(
+        "tensor_split", _tensor_split, (x,),
+        {"starts": tuple(starts), "sizes": tuple(sizes), "axis": axis},
+    )
+    return list(out)
+
+
+def hsplit(x, num_or_indices, name=None):
+    if len(x.shape) < 1:
+        raise ValueError("hsplit expects at least a 1-D tensor")
+    return tensor_split(x, num_or_indices, axis=0 if len(x.shape) == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    if len(x.shape) < 2:
+        raise ValueError("vsplit expects at least a 2-D tensor")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    if len(x.shape) < 3:
+        raise ValueError("dsplit expects at least a 3-D tensor")
+    return tensor_split(x, num_or_indices, axis=2)

@@ -1,0 +1,261 @@
+"""Numpy/scipy/torch-oracle checks for ops/tail.py + the new linalg ops
+(lu_unpack / ormqr / matrix_exp). Same OpTest pattern as test_ops_extras.
+"""
+import numpy as np
+import pytest
+import scipy.linalg as sl
+import scipy.special as sp
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+def A(t):
+    return np.asarray(t.numpy())
+
+
+RNG = np.random.RandomState(7)
+X = RNG.randn(4, 6).astype(np.float32)
+Y = RNG.randn(4, 6).astype(np.float32)
+POS = np.abs(X) + 0.5
+
+
+@pytest.mark.parametrize("name,args,ref", [
+    ("copysign", (X, Y), lambda: np.copysign(X, Y)),
+    ("gammaln", (POS,), lambda: sp.gammaln(POS)),
+    ("gammainc", (POS, np.abs(Y)), lambda: sp.gammainc(POS, np.abs(Y))),
+    ("gammaincc", (POS, np.abs(Y)), lambda: sp.gammaincc(POS, np.abs(Y))),
+    ("positive", (X,), lambda: X),
+    ("negative", (X,), lambda: -X),
+    ("vecdot", (X, Y), lambda: np.sum(X * Y, -1)),
+])
+def test_tail_elementwise_oracle(name, args, ref):
+    out = A(getattr(paddle, name)(*[T(a) for a in args]))
+    np.testing.assert_allclose(out, ref(), rtol=1e-5, atol=1e-5)
+
+
+def test_isreal():
+    assert A(paddle.isreal(T(X))).all()
+    z = np.array([1 + 1j, 2 + 0j], dtype=np.complex64)
+    np.testing.assert_array_equal(A(paddle.isreal(T(z))), np.isreal(z))
+
+
+def test_reduce_as():
+    big = T(RNG.randn(3, 4, 6).astype(np.float32))
+    out = paddle.reduce_as(big, T(np.zeros((4, 6), np.float32)))
+    np.testing.assert_allclose(A(out), A(big).sum(0), rtol=1e-5)
+    out2 = paddle.reduce_as(T(X), T(np.zeros((4, 1), np.float32)))
+    np.testing.assert_allclose(A(out2), X.sum(1, keepdims=True), rtol=1e-5)
+
+
+def test_view_reshape_and_bitcast():
+    v = paddle.view(T(X), [6, 4])
+    np.testing.assert_array_equal(A(v), X.reshape(6, 4))
+    vd = paddle.view(T(X), "int32")
+    np.testing.assert_array_equal(A(vd), X.view(np.int32))
+    va = paddle.view_as(T(X), T(np.zeros(24, np.float32)))
+    assert tuple(va.shape) == (24,)
+
+
+def test_as_strided():
+    base = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(T(base), [3, 4], [4, 1])
+    np.testing.assert_array_equal(A(out), base.reshape(3, 4))
+    out2 = paddle.as_strided(T(base), [2, 3], [1, 2], offset=1)
+    gold = np.lib.stride_tricks.as_strided(base[1:], (2, 3), (4, 8))
+    np.testing.assert_array_equal(A(out2), gold)
+    with pytest.raises(ValueError):
+        paddle.as_strided(T(base), [2, 3], [1])
+
+
+def test_as_strided_grad_is_scatter_add():
+    x = T(np.arange(4, dtype=np.float32))
+    x.stop_gradient = False
+    # overlapping window: every element except the last appears twice
+    out = paddle.as_strided(x, [3, 2], [1, 1]).sum()
+    out.backward()
+    np.testing.assert_allclose(A(x.grad), [1.0, 2.0, 2.0, 1.0])
+
+
+def test_crop():
+    big = RNG.randn(3, 4, 6).astype(np.float32)
+    out = paddle.crop(T(big), shape=[2, -1, 3], offsets=[1, 0, 2])
+    np.testing.assert_array_equal(A(out), big[1:3, :, 2:5])
+
+
+def test_select_scatter():
+    v = np.ones(6, np.float32)
+    out = paddle.select_scatter(T(X), T(v), 0, 2)
+    gold = X.copy()
+    gold[2] = 1
+    np.testing.assert_array_equal(A(out), gold)
+    out2 = paddle.select_scatter(T(X), T(np.ones(4, np.float32)), 1, -1)
+    gold2 = X.copy()
+    gold2[:, -1] = 1
+    np.testing.assert_array_equal(A(out2), gold2)
+
+
+def test_diagonal_scatter():
+    for off in (0, 1, -1, 2):
+        m, n = 4, 6
+        length = min(m, n - off) if off >= 0 else min(m + off, n)
+        out = paddle.diagonal_scatter(
+            T(X), T(np.full(length, 9.0, np.float32)), offset=off
+        )
+        gold = X.copy()
+        for i in range(length):
+            r, c = (i, i + off) if off >= 0 else (i - off, i)
+            gold[r, c] = 9.0
+        np.testing.assert_array_equal(A(out), gold)
+
+
+def test_select_scatter_grad():
+    x = T(X)
+    x.stop_gradient = False
+    paddle.select_scatter(x, T(np.ones(6, np.float32)), 0, 1).sum().backward()
+    g = A(x.grad)
+    assert g[1].sum() == 0 and g[0].sum() == 6
+
+
+@pytest.mark.parametrize("arg", [3, [2, 7]])
+def test_tensor_split(arg):
+    base = np.arange(10, dtype=np.float32)
+    parts = paddle.tensor_split(T(base), arg)
+    golds = (
+        np.array_split(base, arg) if isinstance(arg, int)
+        else np.split(base, arg)
+    )
+    assert len(parts) == len(golds)
+    for p, g in zip(parts, golds):
+        np.testing.assert_array_equal(A(p), g)
+
+
+def test_hvd_split():
+    big = RNG.randn(4, 6, 2).astype(np.float32)
+    for p, g in zip(paddle.hsplit(T(big), 3), np.split(big, 3, 1)):
+        np.testing.assert_array_equal(A(p), g)
+    for p, g in zip(paddle.vsplit(T(big), 2), np.split(big, 2, 0)):
+        np.testing.assert_array_equal(A(p), g)
+    for p, g in zip(paddle.dsplit(T(big), 2), np.split(big, 2, 2)):
+        np.testing.assert_array_equal(A(p), g)
+    one_d = np.arange(6, dtype=np.float32)
+    for p, g in zip(paddle.hsplit(T(one_d), 2), np.split(one_d, 2)):
+        np.testing.assert_array_equal(A(p), g)
+    with pytest.raises(ValueError):
+        paddle.vsplit(T(one_d), 2)
+
+
+@pytest.mark.parametrize("shape", [(5, 5), (6, 4), (4, 6)])
+def test_lu_unpack_reconstructs(shape):
+    a = RNG.randn(*shape).astype(np.float32)
+    lu_, piv = paddle.linalg.lu(T(a))
+    p, lower, upper = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(
+        A(p) @ A(lower) @ A(upper), a, rtol=1e-4, atol=1e-5
+    )
+    # P is a permutation matrix
+    pm = A(p)
+    assert ((pm == 0) | (pm == 1)).all()
+    np.testing.assert_array_equal(pm.sum(0), np.ones(shape[0]))
+
+
+def test_matrix_exp():
+    a = (RNG.randn(5, 5) * 0.2).astype(np.float32)
+    np.testing.assert_allclose(
+        A(paddle.linalg.matrix_exp(T(a))), sl.expm(a), rtol=1e-4, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("left,transpose", [
+    (True, False), (True, True), (False, False), (False, True),
+])
+def test_ormqr_vs_torch(left, transpose):
+    a = RNG.randn(6, 4).astype(np.float32)
+    tq, tau = torch.geqrf(torch.tensor(a))
+    other = (
+        RNG.randn(6, 3).astype(np.float32) if left
+        else RNG.randn(3, 6).astype(np.float32)
+    )
+    gold = torch.ormqr(
+        tq, tau, torch.tensor(other), left=left, transpose=transpose
+    ).numpy()
+    mine = paddle.linalg.ormqr(
+        T(tq.numpy()), T(tau.numpy()), T(other),
+        left=left, transpose=transpose,
+    )
+    np.testing.assert_allclose(A(mine), gold, rtol=1e-4, atol=1e-4)
+
+
+def test_tensor_methods_bound():
+    x = T(X)
+    assert hasattr(x, "copysign") and hasattr(x, "view")
+    np.testing.assert_array_equal(A(x.view([6, 4])), X.reshape(6, 4))
+    assert len(x.tensor_split(2)) == 2
+
+
+def test_tensor_split_negative_and_oob_indices():
+    base = np.arange(10, dtype=np.float32)
+    for idx in ([-2], [12], [-2, 12], [3, -3]):
+        parts = paddle.tensor_split(T(base), idx)
+        golds = np.split(base, idx)
+        assert len(parts) == len(golds)
+        for p, g in zip(parts, golds):
+            np.testing.assert_array_equal(A(p), g)
+
+
+def test_lu_unpack_batched():
+    a = RNG.randn(3, 4, 4).astype(np.float32)
+    lu_, piv = paddle.linalg.lu(T(a))
+    p, lower, upper = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(
+        A(p) @ A(lower) @ A(upper), a, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ormqr_complex():
+    a = (RNG.randn(5, 3) + 1j * RNG.randn(5, 3)).astype(np.complex64)
+    tq, tau = torch.geqrf(torch.tensor(a))
+    other = (RNG.randn(5, 2) + 1j * RNG.randn(5, 2)).astype(np.complex64)
+    for tr in (False, True):
+        gold = torch.ormqr(
+            tq, tau, torch.tensor(other), left=True, transpose=tr
+        ).numpy()
+        mine = paddle.linalg.ormqr(
+            T(tq.numpy()), T(tau.numpy()), T(other), left=True, transpose=tr
+        )
+        np.testing.assert_allclose(A(mine), gold, rtol=1e-4, atol=1e-4)
+
+
+def test_tensor_split_unsorted_indices():
+    base = np.arange(10, dtype=np.float32)
+    parts = paddle.tensor_split(T(base), [7, 3])
+    golds = np.split(base, [7, 3])
+    assert len(parts) == len(golds)
+    for p, g in zip(parts, golds):
+        np.testing.assert_array_equal(A(p), g)
+
+
+def test_as_strided_rejects_out_of_bounds():
+    base = np.arange(12, dtype=np.float32)
+    with pytest.raises(ValueError):
+        paddle.as_strided(T(base), [4, 4], [4, 1])
+
+
+def test_tail_ops_hit_jit_cache():
+    from paddle_tpu.core import dispatch as _dispatch
+
+    x = T(X)
+    paddle.vecdot(x, x)
+    paddle.crop(x, shape=[2, 3], offsets=[0, 0])
+    n0 = len(_dispatch._JIT_CACHE)
+    for _ in range(4):
+        paddle.vecdot(x, x)
+        paddle.crop(x, shape=[2, 3], offsets=[0, 0])
+    assert len(_dispatch._JIT_CACHE) == n0
