@@ -19,6 +19,7 @@ mish = unary("mish", jax.nn.mish)
 softsign = unary("softsign", jax.nn.soft_sign)
 tanhshrink = unary("tanhshrink", lambda x: x - jnp.tanh(x))
 hardswish = unary("hardswish", jax.nn.hard_swish)
+log_sigmoid = unary("log_sigmoid", jax.nn.log_sigmoid)
 hardsigmoid = unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
 
 

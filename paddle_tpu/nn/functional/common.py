@@ -293,3 +293,47 @@ def _bilinear(x1, x2, w, b):
 
 def bilinear(x1, x2, weight, bias=None, name=None):
     return dispatch.apply("bilinear", _bilinear, (x1, x2, weight, bias))
+
+
+def _channel_shuffle(x, *, groups, nchw):
+    if nchw:
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    c_axis = 1 if data_format == "NCHW" else 3
+    if int(x.shape[c_axis]) % int(groups) != 0:
+        raise ValueError(
+            f"channel_shuffle: channels {x.shape[c_axis]} not divisible by "
+            f"groups {groups}"
+        )
+    return dispatch.apply(
+        "channel_shuffle", _channel_shuffle, (x,),
+        {"groups": int(groups), "nchw": data_format == "NCHW"},
+    )
+
+
+def _pairwise_distance(x, y, *, p, eps, keepdim):
+    d = jnp.abs(x - y + eps)
+    if p == float("inf"):
+        return jnp.max(d, axis=-1, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(d, axis=-1, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype), axis=-1, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(d, p), axis=-1, keepdims=keepdim),
+                     1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return dispatch.apply(
+        "pairwise_distance", _pairwise_distance, (x, y),
+        {"p": float(p), "eps": float(epsilon), "keepdim": bool(keepdim)},
+    )

@@ -323,3 +323,175 @@ def log_loss(input, label, epsilon=0.0001, name=None):
     return dispatch.apply(
         "log_loss", _log_loss, (input, label), {"eps": float(epsilon)}
     )
+
+
+def _soft_margin(x, y, *, reduction):
+    # log(1 + exp(-yx)) = -log_sigmoid(yx), stable for large |logits|
+    return _reduce(-jax.nn.log_sigmoid(y.astype(x.dtype) * x), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return dispatch.apply(
+        "soft_margin_loss", _soft_margin, (input, label),
+        {"reduction": reduction},
+    )
+
+
+def _multi_label_soft_margin(x, y, w, *, reduction):
+    yf = y.astype(x.dtype)
+    per_class = -(
+        yf * jax.nn.log_sigmoid(x) + (1 - yf) * jax.nn.log_sigmoid(-x)
+    )
+    if w is not None:
+        per_class = per_class * w
+    return _reduce(jnp.mean(per_class, axis=-1), reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    return dispatch.apply(
+        "multi_label_soft_margin_loss", _multi_label_soft_margin,
+        (input, label, weight), {"reduction": reduction},
+    )
+
+
+def _multi_margin(x, y, w, *, p, margin, reduction):
+    n, c = x.shape
+    correct = jnp.take_along_axis(x, y[:, None], axis=1)
+    viol = jnp.maximum(0.0, margin - correct + x) ** p
+    if w is not None:
+        viol = viol * w[y][:, None]
+    # the true-class term contributes margin^p; numpy-oracle parity drops it
+    viol = viol * (1 - jax.nn.one_hot(y, c, dtype=x.dtype))
+    return _reduce(jnp.sum(viol, axis=1) / c, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return dispatch.apply(
+        "multi_margin_loss", _multi_margin, (input, label, weight),
+        {"p": int(p), "margin": float(margin), "reduction": reduction},
+    )
+
+
+def _poisson_nll(x, y, *, log_input, full, eps, reduction):
+    if log_input:
+        loss = jnp.exp(x) - y * x
+    else:
+        loss = x - y * jnp.log(x + eps)
+    if full:
+        stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+        loss = loss + jnp.where(y > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return dispatch.apply(
+        "poisson_nll_loss", _poisson_nll, (input, label),
+        {"log_input": bool(log_input), "full": bool(full),
+         "eps": float(epsilon), "reduction": reduction},
+    )
+
+
+def _gaussian_nll(x, y, var, *, full, eps, reduction):
+    var = jnp.maximum(var, eps)
+    loss = 0.5 * (jnp.log(var) + jnp.square(x - y) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+    return _reduce(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return dispatch.apply(
+        "gaussian_nll_loss", _gaussian_nll, (input, label, variance),
+        {"full": bool(full), "eps": float(epsilon), "reduction": reduction},
+    )
+
+
+# ------------------------------------------------------------------- CTC
+def _ctc_alpha_scan(logp, ext, ext_mask):
+    """Log-space CTC alpha recursion for one sample.
+
+    logp: [T, C] log-probabilities; ext: [S] blank-interleaved labels
+    (S = 2*Lmax+1); ext_mask[s] = can skip from s-2 to s (ext[s] != blank
+    and ext[s] != ext[s-2]).
+    """
+    T, _ = logp.shape
+    S = ext.shape[0]
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+    alpha0 = jnp.full((S,), neg_inf).at[0].set(logp[0, ext[0]])
+    alpha0 = alpha0.at[1].set(logp[0, ext[1]])
+
+    def step(alpha, lp):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((1,), neg_inf), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+        prev2 = jnp.where(ext_mask, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        alpha_t = merged + lp[ext]
+        return alpha_t, alpha_t
+
+    _, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    return jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, S]
+
+
+def _ctc_loss(logits, labels, in_lens, lbl_lens, *, blank, reduction,
+              norm_by_times):
+    # logits [T, B, C] raw (softmax applied here), labels [B, Lmax]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    T, B, C = logp.shape
+    Lmax = labels.shape[1]
+    S = 2 * Lmax + 1
+    pos = jnp.arange(S)
+    ext = jnp.where(
+        pos[:, None] % 2 == 0, blank,
+        labels[:, jnp.minimum(pos // 2, Lmax - 1)].T
+    ).T.astype(jnp.int32)  # [B, S]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1
+    )
+    ext_mask = (ext != blank) & (ext != ext_prev2)
+
+    alphas = jax.vmap(_ctc_alpha_scan, in_axes=(1, 0, 0))(
+        logp, ext, ext_mask
+    )  # [B, T, S]
+    t_last = jnp.clip(in_lens - 1, 0, T - 1)
+    alpha_last = jnp.take_along_axis(
+        alphas, t_last[:, None, None], axis=1
+    )[:, 0, :]  # [B, S]
+    s_last = 2 * lbl_lens  # index of final blank
+    end_blank = jnp.take_along_axis(alpha_last, s_last[:, None], axis=1)[:, 0]
+    end_label = jnp.take_along_axis(
+        alpha_last, jnp.maximum(s_last - 1, 0)[:, None], axis=1
+    )[:, 0]
+    # empty target: only the all-blank path exists; the clamped s_last-1
+    # index would alias end_blank and double-count it
+    end_label = jnp.where(lbl_lens > 0, end_label, -jnp.inf)
+    ll = jnp.logaddexp(end_blank, end_label)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(in_lens.astype(loss.dtype), 1.0)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(lbl_lens.astype(loss.dtype), 1.0))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss (log-space forward recursion over the blank-interleaved
+    label sequence, lax.scan over time; grads via autodiff).
+
+    ``log_probs``: [max_T, batch, num_classes] raw logits (softmax is
+    applied internally, matching the reference's warpctc contract).
+    Reference parity: python/paddle/nn/functional/loss.py ctc_loss row.
+    """
+    return dispatch.apply(
+        "ctc_loss", _ctc_loss,
+        (log_probs, labels, input_lengths, label_lengths),
+        {"blank": int(blank), "reduction": reduction,
+         "norm_by_times": bool(norm_by_times)},
+    )

@@ -254,3 +254,22 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups = groups
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._kw = dict(p=p, epsilon=epsilon, keepdim=keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, **self._kw)

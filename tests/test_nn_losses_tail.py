@@ -1,0 +1,219 @@
+"""Torch-oracle tests for the tail nn losses/layers: CTC, soft-margin
+family, Poisson/Gaussian NLL, channel shuffle, pairwise distance.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+RNG = np.random.RandomState(3)
+X = RNG.randn(6, 5).astype(np.float32)
+YBIN = (RNG.rand(6, 5) > 0.5).astype(np.float32)
+YSGN = np.where(RNG.rand(6, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_soft_margin_vs_torch(reduction):
+    mine = F.soft_margin_loss(T(X), T(YSGN), reduction=reduction).numpy()
+    gold = torch.nn.functional.soft_margin_loss(
+        torch.tensor(X), torch.tensor(YSGN), reduction=reduction
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_label_soft_margin_vs_torch():
+    mine = F.multi_label_soft_margin_loss(T(X), T(YBIN)).numpy()
+    gold = torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(X), torch.tensor(YBIN)
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_margin_vs_torch():
+    lbl = RNG.randint(0, 5, 6).astype(np.int64)
+    for p in (1, 2):
+        mine = F.multi_margin_loss(T(X), T(lbl), p=p).numpy()
+        gold = torch.nn.functional.multi_margin_loss(
+            torch.tensor(X), torch.tensor(lbl), p=p
+        ).numpy()
+        np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("log_input,full", [
+    (True, False), (True, True), (False, False),
+])
+def test_poisson_nll_vs_torch(log_input, full):
+    tgt = RNG.poisson(2.0, (6, 5)).astype(np.float32)
+    rate = np.abs(X) + 0.1 if not log_input else X
+    mine = F.poisson_nll_loss(
+        T(rate), T(tgt), log_input=log_input, full=full
+    ).numpy()
+    gold = torch.nn.functional.poisson_nll_loss(
+        torch.tensor(rate), torch.tensor(tgt), log_input=log_input, full=full
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-5)
+
+
+def test_gaussian_nll_vs_torch():
+    var = RNG.rand(6, 5).astype(np.float32) + 0.1
+    mine = F.gaussian_nll_loss(T(X), T(YBIN), T(var)).numpy()
+    gold = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(X), torch.tensor(YBIN), torch.tensor(var)
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-5)
+
+
+CTC_T, CTC_B, CTC_C, CTC_L = 12, 3, 7, 4
+CTC_LOGITS = RNG.randn(CTC_T, CTC_B, CTC_C).astype(np.float32)
+CTC_IN_LENS = np.array([12, 10, 8], np.int64)
+CTC_LBL_LENS = np.array([4, 3, 2], np.int64)
+
+
+def _torch_ctc(labels, reduction):
+    return torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(CTC_LOGITS), -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(CTC_IN_LENS), torch.tensor(CTC_LBL_LENS),
+        blank=0, reduction=reduction,
+    )
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_ctc_loss_vs_torch(reduction):
+    labels = RNG.randint(1, CTC_C, (CTC_B, CTC_L)).astype(np.int32)
+    mine = F.ctc_loss(
+        T(CTC_LOGITS), T(labels), T(CTC_IN_LENS), T(CTC_LBL_LENS),
+        reduction=reduction,
+    ).numpy()
+    np.testing.assert_allclose(
+        mine, _torch_ctc(labels, reduction).numpy(), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_ctc_loss_repeated_labels():
+    labels = np.array(
+        [[2, 2, 3, 3], [1, 1, 1, 1], [4, 5, 4, 5]], np.int32
+    )
+    mine = F.ctc_loss(
+        T(CTC_LOGITS), T(labels), T(CTC_IN_LENS), T(CTC_LBL_LENS),
+        reduction="none",
+    ).numpy()
+    np.testing.assert_allclose(
+        mine, _torch_ctc(labels, "none").numpy(), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_ctc_loss_grad_vs_torch():
+    labels = RNG.randint(1, CTC_C, (CTC_B, CTC_L)).astype(np.int32)
+    lg = T(CTC_LOGITS)
+    lg.stop_gradient = False
+    F.ctc_loss(lg, T(labels), T(CTC_IN_LENS), T(CTC_LBL_LENS)).backward()
+    tlg = torch.tensor(CTC_LOGITS, requires_grad=True)
+    torch.nn.functional.ctc_loss(
+        torch.log_softmax(tlg, -1), torch.tensor(labels.astype(np.int64)),
+        torch.tensor(CTC_IN_LENS), torch.tensor(CTC_LBL_LENS),
+        blank=0, reduction="mean",
+    ).backward()
+    np.testing.assert_allclose(
+        lg.grad.numpy(), tlg.grad.numpy(), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_ctc_layer():
+    labels = RNG.randint(1, CTC_C, (CTC_B, CTC_L)).astype(np.int32)
+    loss = paddle.nn.CTCLoss(blank=0)(
+        T(CTC_LOGITS), T(labels), T(CTC_IN_LENS), T(CTC_LBL_LENS)
+    )
+    assert float(loss.numpy()) > 0
+
+
+def test_channel_shuffle_vs_torch():
+    xin = RNG.randn(2, 8, 3, 3).astype(np.float32)
+    mine = F.channel_shuffle(T(xin), 4).numpy()
+    gold = torch.nn.functional.channel_shuffle(torch.tensor(xin), 4).numpy()
+    np.testing.assert_array_equal(mine, gold)
+    nhwc = F.channel_shuffle(
+        T(np.transpose(xin, (0, 2, 3, 1)).copy()), 4, data_format="NHWC"
+    ).numpy()
+    np.testing.assert_array_equal(np.transpose(nhwc, (0, 3, 1, 2)), gold)
+    with pytest.raises(ValueError):
+        F.channel_shuffle(T(xin), 3)
+
+
+def test_pairwise_distance_vs_torch():
+    a = RNG.randn(5, 3).astype(np.float32)
+    b = RNG.randn(5, 3).astype(np.float32)
+    for p in (1.0, 2.0):
+        mine = F.pairwise_distance(T(a), T(b), p=p).numpy()
+        gold = torch.nn.functional.pairwise_distance(
+            torch.tensor(a), torch.tensor(b), p=p
+        ).numpy()
+        np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-5)
+    layer = paddle.nn.PairwiseDistance(keepdim=True)
+    assert tuple(layer(T(a), T(b)).shape) == (5, 1)
+
+
+def test_loss_layer_classes():
+    lbl = RNG.randint(0, 5, 6).astype(np.int64)
+    var = RNG.rand(6, 5).astype(np.float32) + 0.1
+    assert float(paddle.nn.SoftMarginLoss()(T(X), T(YSGN)).numpy()) > 0
+    assert float(
+        paddle.nn.MultiLabelSoftMarginLoss()(T(X), T(YBIN)).numpy()
+    ) > 0
+    assert float(paddle.nn.MultiMarginLoss()(T(X), T(lbl)).numpy()) > 0
+    assert float(
+        paddle.nn.PoissonNLLLoss()(T(X), T(YBIN)).numpy()
+    ) == pytest.approx(
+        float(F.poisson_nll_loss(T(X), T(YBIN)).numpy())
+    )
+    assert np.isfinite(
+        float(paddle.nn.GaussianNLLLoss()(T(X), T(YBIN), T(var)).numpy())
+    )
+    assert isinstance(
+        paddle.nn.ChannelShuffle(2)(T(RNG.randn(1, 4, 2, 2).astype(
+            np.float32
+        ))), Tensor
+    )
+
+
+def test_soft_margin_stable_at_large_logits():
+    big = np.array([100.0, -100.0], np.float32)
+    lbl = np.array([-1.0, 1.0], np.float32)
+    out = F.soft_margin_loss(T(big), T(lbl), reduction="none").numpy()
+    np.testing.assert_allclose(out, [100.0, 100.0], rtol=1e-5)
+
+
+def test_ctc_loss_empty_target():
+    lens0 = np.array([12, 10, 8], np.int64)
+    lbls0 = np.array([0, 0, 0], np.int64)
+    labels = RNG.randint(1, CTC_C, (CTC_B, CTC_L)).astype(np.int32)
+    mine = F.ctc_loss(
+        T(CTC_LOGITS), T(labels), T(lens0), T(lbls0), reduction="none"
+    ).numpy()
+    gold = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(CTC_LOGITS), -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(lens0), torch.tensor(lbls0),
+        blank=0, reduction="none",
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+
+
+def test_pairwise_distance_inf_norms():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(3, 4).astype(np.float32)
+    mine = F.pairwise_distance(T(a), T(b), p=float("inf")).numpy()
+    gold = torch.nn.functional.pairwise_distance(
+        torch.tensor(a), torch.tensor(b), p=float("inf")
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-5)
