@@ -1,0 +1,68 @@
+"""MobileNetV1 (python/paddle/vision/models/mobilenetv1.py parity —
+unverified): depthwise-separable conv stacks. Depthwise convs lower to
+XLA grouped convolutions, which TPU handles natively."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNLayer(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride, padding, groups=1):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.ReLU(),
+        )
+
+
+class DepthwiseSeparable(nn.Sequential):
+    def __init__(self, in_c, mid_c, out_c, stride, scale):
+        super().__init__(
+            ConvBNLayer(int(in_c * scale), int(mid_c * scale), 3, stride, 1,
+                        groups=int(in_c * scale)),
+            ConvBNLayer(int(mid_c * scale), int(out_c * scale), 1, 1, 0),
+        )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        cfg = [
+            # in, mid, out, stride
+            (32, 32, 64, 1),
+            (64, 64, 128, 2),
+            (128, 128, 128, 1),
+            (128, 128, 256, 2),
+            (256, 256, 256, 1),
+            (256, 256, 512, 2),
+            *[(512, 512, 512, 1)] * 5,
+            (512, 512, 1024, 2),
+            (1024, 1024, 1024, 1),
+        ]
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, 2, 1)
+        self.blocks = nn.Sequential(*[
+            DepthwiseSeparable(i, m, o, s, scale) for i, m, o, s in cfg
+        ])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,153 @@
+"""MobileNetV3 small/large (python/paddle/vision/models/mobilenetv3.py
+parity — unverified): inverted residuals + squeeze-excite, hardswish."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // reduction)
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class InvertedResidualV3(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act_layer = nn.Hardswish if act == "hardswish" else nn.ReLU
+        layers = []
+        if exp_c != in_c:
+            layers += [
+                nn.Conv2D(in_c, exp_c, 1, bias_attr=False),
+                nn.BatchNorm2D(exp_c),
+                act_layer(),
+            ]
+        layers += [
+            nn.Conv2D(exp_c, exp_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=exp_c,
+                      bias_attr=False),
+            nn.BatchNorm2D(exp_c),
+            act_layer(),  # reference order: conv -> BN -> act -> SE
+        ]
+        if use_se:
+            layers.append(SqueezeExcite(exp_c))
+        layers += [
+            nn.Conv2D(exp_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, act, stride) per reference config tables
+_LARGE_CFG = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL_CFG = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        self.conv = nn.Sequential(
+            nn.Conv2D(3, in_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(in_c),
+            nn.Hardswish(),
+        )
+        blocks = []
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(
+                InvertedResidualV3(in_c, exp_c, out_c, k, s, se, act)
+            )
+            in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        last_c = _make_divisible(last_exp * scale)
+        self.lastconv = nn.Sequential(
+            nn.Conv2D(in_c, last_c, 1, bias_attr=False),
+            nn.BatchNorm2D(last_c),
+            nn.Hardswish(),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            head_c = 1280 if last_exp == 960 else 1024
+            self.classifier = nn.Sequential(
+                nn.Linear(last_c, head_c),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(head_c, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.lastconv(self.blocks(self.conv(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops.manipulation import flatten
+
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE_CFG, 960, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL_CFG, 576, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

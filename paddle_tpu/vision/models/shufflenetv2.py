@@ -1,0 +1,135 @@
+"""ShuffleNetV2 (python/paddle/vision/models/shufflenetv2.py parity —
+unverified): channel-split residual units with channel shuffle."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ...ops.manipulation import concat, flatten, split
+
+
+class ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride, groups=1, act="relu"):
+        layers = [
+            nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        if act == "relu":
+            layers.append(nn.ReLU())
+        elif act == "swish":
+            layers.append(nn.Swish())
+        super().__init__(*layers)
+
+
+class InvertedResidualUnit(nn.Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        half = channels // 2
+        self.branch = nn.Sequential(
+            ConvBNAct(half, half, 1, 1, act=act),
+            ConvBNAct(half, half, 3, 1, groups=half, act=None),
+            ConvBNAct(half, half, 1, 1, act=act),
+        )
+
+    def forward(self, x):
+        x1, x2 = split(x, 2, axis=1)
+        out = concat([x1, self.branch(x2)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """stride-2 down-sampling unit: both branches transformed."""
+
+    def __init__(self, in_c, out_c, act):
+        super().__init__()
+        half = out_c // 2
+        self.branch1 = nn.Sequential(
+            ConvBNAct(in_c, in_c, 3, 2, groups=in_c, act=None),
+            ConvBNAct(in_c, half, 1, 1, act=act),
+        )
+        self.branch2 = nn.Sequential(
+            ConvBNAct(in_c, half, 1, 1, act=act),
+            ConvBNAct(half, half, 3, 2, groups=half, act=None),
+            ConvBNAct(half, half, 1, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return F.channel_shuffle(out, 2)
+
+
+_STAGE_REPEATS = (4, 8, 4)
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        chans = _STAGE_OUT[scale]
+        self.stem = nn.Sequential(
+            ConvBNAct(3, chans[0], 3, 2, act=act),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        stages = []
+        in_c = chans[0]
+        for stage_i, repeats in enumerate(_STAGE_REPEATS):
+            out_c = chans[stage_i + 1]
+            stages.append(InvertedResidualDS(in_c, out_c, act))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidualUnit(out_c, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.last_conv = ConvBNAct(in_c, chans[-1], 1, 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.last_conv(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

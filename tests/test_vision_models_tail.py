@@ -1,0 +1,93 @@
+"""Forward shape/behavior checks for the tail vision-zoo families
+(mobilenet v1/v3, densenet, googlenet, inception_v3, squeezenet,
+shufflenet_v2, resnext). Small scales + small inputs keep CI fast; the
+full-size variants share the same code paths.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import models as M
+
+RNG = np.random.RandomState(11)
+
+
+def batch(hw):
+    return Tensor(jnp.asarray(RNG.randn(2, 3, hw, hw).astype(np.float32)))
+
+
+@pytest.mark.parametrize("factory,kwargs,hw", [
+    (M.mobilenet_v1, {"scale": 0.25}, 64),
+    (M.mobilenet_v3_small, {"scale": 0.5}, 64),
+    (M.shufflenet_v2_x0_25, {}, 64),
+    (M.squeezenet1_1, {}, 64),
+])
+def test_small_zoo_forward(factory, kwargs, hw):
+    model = factory(num_classes=10, **kwargs)
+    model.eval()
+    out = model(batch(hw))
+    assert tuple(out.shape) == (2, 10)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_densenet_forward():
+    model = M.densenet121(num_classes=10)
+    model.eval()
+    out = model(batch(64))
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_googlenet_returns_aux_heads():
+    model = M.googlenet(num_classes=10)
+    model.eval()
+    out, aux1, aux2 = model(batch(64))
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(aux1.shape) == (2, 10) and tuple(aux2.shape) == (2, 10)
+
+
+def test_inception_v3_forward():
+    model = M.inception_v3(num_classes=10)
+    model.eval()
+    out = model(batch(96))
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_resnext_groups_wire_through():
+    model = M.resnext50_32x4d(num_classes=10)
+    model.eval()
+    out = model(batch(64))
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_zoo_trains_one_step():
+    model = M.mobilenet_v1(scale=0.25, num_classes=10)
+    model.train()
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()
+    )
+    x = batch(64)
+    label = Tensor(jnp.asarray(RNG.randint(0, 10, 2).astype(np.int64)))
+    loss = paddle.nn.functional.cross_entropy(model(x), label)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    loss2 = paddle.nn.functional.cross_entropy(model(x), label)
+    assert np.isfinite(float(loss2.numpy()))
+
+
+def test_full_zoo_surface_importable():
+    for name in [
+        "mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small",
+        "mobilenet_v3_large", "densenet121", "densenet161", "densenet169",
+        "densenet201", "densenet264", "googlenet", "inception_v3",
+        "squeezenet1_0", "squeezenet1_1", "shufflenet_v2_x0_25",
+        "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+        "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+        "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+        "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
+        "wide_resnet50_2", "wide_resnet101_2",
+    ]:
+        assert callable(getattr(M, name)), name
