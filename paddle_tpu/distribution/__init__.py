@@ -1,0 +1,38 @@
+"""paddle.distribution namespace (python/paddle/distribution/__init__.py
+parity — unverified): distributions over the framework's Tensor/autograd
+stack, a transform family, and the KL registry."""
+from .continuous import (  # noqa: F401
+    Beta,
+    Cauchy,
+    Chi2,
+    Dirichlet,
+    Exponential,
+    Gamma,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Normal,
+    StudentT,
+    Uniform,
+)
+from .discrete import (  # noqa: F401
+    Bernoulli,
+    Binomial,
+    Categorical,
+    Geometric,
+    Multinomial,
+    Poisson,
+)
+from .distribution import Distribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .multivariate import MultivariateNormal  # noqa: F401
+from .transform import (  # noqa: F401
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)

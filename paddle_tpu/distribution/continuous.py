@@ -1,0 +1,634 @@
+"""Continuous distributions (python/paddle/distribution/{normal,uniform,
+beta,cauchy,chi2,dirichlet,exponential,gamma,gumbel,laplace,lognormal,
+student_t}.py parity — unverified).
+
+Densities are module-level pure-jnp fns routed through core.dispatch
+(autograd to parameters + value); samplers use jax.random with keys from
+core.random (``cache=False`` — each call draws a fresh key). Samplers for
+gamma/beta/dirichlet are jax's implicitly-reparameterized versions, so
+``rsample`` gradients flow to parameters where jax supports it.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as random_mod
+from .distribution import Distribution, _as_tensor, _shape_tuple
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def _sampler(name, fn, args, shape, extra=None, nondiff=True):
+    kw = {"key": random_mod.next_key(), "shape": shape}
+    if extra:
+        kw.update(extra)
+    return dispatch.apply(name, fn, args, kw, cache=False, nondiff=nondiff)
+
+
+# ------------------------------------------------------------------ Normal
+def _normal_sample(loc, scale, *, key, shape):
+    eps = jax.random.normal(key, shape, dtype=jnp.result_type(loc))
+    return loc + scale * eps
+
+
+def _normal_logp(loc, scale, v, *, _):
+    return (
+        -jnp.square(v - loc) / (2.0 * jnp.square(scale))
+        - jnp.log(scale) - _HALF_LOG_2PI
+    )
+
+
+def _normal_entropy(scale, *, _):
+    return 0.5 + _HALF_LOG_2PI + jnp.log(scale)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.loc.shape), tuple(self.scale.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "normal_sample", _normal_sample, (self.loc, self.scale),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "normal_logp", _normal_logp,
+            (self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "normal_entropy", _normal_entropy, (self.scale,), {"_": 0}
+        )
+
+
+# ----------------------------------------------------------------- Uniform
+def _uniform_sample(low, high, *, key, shape):
+    u = jax.random.uniform(key, shape, dtype=jnp.result_type(low))
+    return low + (high - low) * u
+
+
+def _uniform_logp(low, high, v, *, _):
+    inside = (v >= low) & (v < high)
+    return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.low.shape), tuple(self.high.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "uniform_sample", _uniform_sample, (self.low, self.high),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "uniform_logp", _uniform_logp,
+            (self.low, self.high, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(self.high - self.low)
+
+
+# -------------------------------------------------------------------- Beta
+def _beta_sample(a, b, *, key, shape):
+    return jax.random.beta(key, a, b, shape)
+
+
+def _beta_logp(a, b, v, *, _):
+    lbeta = (
+        jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+        - jax.scipy.special.gammaln(a + b)
+    )
+    return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+
+def _beta_entropy(a, b, *, _):
+    dg = jax.scipy.special.digamma
+    lbeta = (
+        jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+        - jax.scipy.special.gammaln(a + b)
+    )
+    return (
+        lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+        + (a + b - 2) * dg(a + b)
+    )
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _as_tensor(alpha)
+        self.beta = _as_tensor(beta)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.alpha.shape), tuple(self.beta.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "beta_sample", _beta_sample, (self.alpha, self.beta),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "beta_logp", _beta_logp,
+            (self.alpha, self.beta, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "beta_entropy", _beta_entropy, (self.alpha, self.beta), {"_": 0}
+        )
+
+
+# ------------------------------------------------------------------- Gamma
+def _gamma_sample(conc, rate, *, key, shape):
+    return jax.random.gamma(key, conc, shape) / rate
+
+
+def _gamma_logp(conc, rate, v, *, _):
+    return (
+        conc * jnp.log(rate) + (conc - 1) * jnp.log(v) - rate * v
+        - jax.scipy.special.gammaln(conc)
+    )
+
+
+def _gamma_entropy(conc, rate, *, _):
+    dg = jax.scipy.special.digamma
+    return (
+        conc - jnp.log(rate) + jax.scipy.special.gammaln(conc)
+        + (1.0 - conc) * dg(conc)
+    )
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _as_tensor(concentration)
+        self.rate = _as_tensor(rate)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.concentration.shape), tuple(self.rate.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate * self.rate)
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "gamma_sample", _gamma_sample, (self.concentration, self.rate),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "gamma_logp", _gamma_logp,
+            (self.concentration, self.rate, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "gamma_entropy", _gamma_entropy,
+            (self.concentration, self.rate), {"_": 0},
+        )
+
+
+# ------------------------------------------------------------- Exponential
+class Exponential(Gamma):
+    def __init__(self, rate, name=None):
+        rate = _as_tensor(rate)
+        super().__init__(jnp.ones_like(rate.value), rate)
+        self.rate = rate
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return 1.0 - log(self.rate)
+
+
+# -------------------------------------------------------------------- Chi2
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _as_tensor(df)
+        super().__init__(
+            df / 2.0, _as_tensor(jnp.full_like(df.value, 0.5))
+        )
+        self.df = df
+
+
+# --------------------------------------------------------------- Dirichlet
+def _dirichlet_sample(conc, *, key, shape):
+    return jax.random.dirichlet(key, conc, shape)
+
+
+def _dirichlet_logp(conc, v, *, _):
+    norm = jax.scipy.special.gammaln(jnp.sum(conc, -1)) - jnp.sum(
+        jax.scipy.special.gammaln(conc), -1
+    )
+    return jnp.sum((conc - 1) * jnp.log(v), -1) + norm
+
+
+def _dirichlet_entropy(conc, *, _):
+    dg = jax.scipy.special.digamma
+    a0 = jnp.sum(conc, -1)
+    k = conc.shape[-1]
+    lnB = jnp.sum(
+        jax.scipy.special.gammaln(conc), -1
+    ) - jax.scipy.special.gammaln(a0)
+    return (
+        lnB + (a0 - k) * dg(a0) - jnp.sum((conc - 1) * dg(conc), -1)
+    )
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _as_tensor(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        from ..ops.reduction import sum as _sum
+
+        return self.concentration / _sum(
+            self.concentration, axis=-1, keepdim=True
+        )
+
+    @property
+    def variance(self):
+        from ..ops.reduction import sum as _sum
+
+        a0 = _sum(self.concentration, axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "dirichlet_sample", _dirichlet_sample, (self.concentration,),
+            _shape_tuple(shape) + self._batch_shape, nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "dirichlet_logp", _dirichlet_logp,
+            (self.concentration, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "dirichlet_entropy", _dirichlet_entropy,
+            (self.concentration,), {"_": 0},
+        )
+
+
+# ----------------------------------------------------------------- Laplace
+def _laplace_sample(loc, scale, *, key, shape):
+    return loc + scale * jax.random.laplace(
+        key, shape, dtype=jnp.result_type(loc)
+    )
+
+
+def _laplace_logp(loc, scale, v, *, _):
+    return -jnp.abs(v - loc) / scale - jnp.log(2.0 * scale)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.loc.shape), tuple(self.scale.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return (2.0 ** 0.5) * self.scale
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "laplace_sample", _laplace_sample, (self.loc, self.scale),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "laplace_logp", _laplace_logp,
+            (self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return 1.0 + log(2.0 * self.scale)
+
+
+# ------------------------------------------------------------------ Gumbel
+def _gumbel_sample(loc, scale, *, key, shape):
+    return loc + scale * jax.random.gumbel(
+        key, shape, dtype=jnp.result_type(loc)
+    )
+
+
+def _gumbel_logp(loc, scale, v, *, _):
+    z = (v - loc) / scale
+    return -(z + jnp.exp(-z)) - jnp.log(scale)
+
+
+_EULER = 0.5772156649015329
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.loc.shape), tuple(self.scale.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.loc + _EULER * self.scale
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "gumbel_sample", _gumbel_sample, (self.loc, self.scale),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "gumbel_logp", _gumbel_logp,
+            (self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(self.scale) + 1.0 + _EULER
+
+
+# ------------------------------------------------------------------ Cauchy
+def _cauchy_sample(loc, scale, *, key, shape):
+    return loc + scale * jax.random.cauchy(
+        key, shape, dtype=jnp.result_type(loc)
+    )
+
+
+def _cauchy_logp(loc, scale, v, *, _):
+    z = (v - loc) / scale
+    return -jnp.log(math.pi * scale * (1.0 + z * z))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.loc.shape), tuple(self.scale.shape)
+            )
+        )
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return _sampler(
+            "cauchy_sample", _cauchy_sample, (self.loc, self.scale),
+            self._extend_shape(shape), nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "cauchy_logp", _cauchy_logp,
+            (self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return log(4.0 * math.pi * self.scale)
+
+
+# --------------------------------------------------------------- LogNormal
+def _lognormal_logp(loc, scale, v, *, _):
+    logv = jnp.log(v)
+    return (
+        -jnp.square(logv - loc) / (2.0 * jnp.square(scale))
+        - jnp.log(scale) - _HALF_LOG_2PI - logv
+    )
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.loc.shape), tuple(self.scale.shape)
+            )
+        )
+
+    @property
+    def mean(self):
+        from ..ops.math import exp
+
+        return exp(self.loc + self.scale * self.scale / 2.0)
+
+    @property
+    def variance(self):
+        from ..ops.math import exp
+
+        s2 = self.scale * self.scale
+        return (exp(s2) - 1.0) * exp(2.0 * self.loc + s2)
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        from ..ops.math import exp
+
+        base = _sampler(
+            "normal_sample", _normal_sample, (self.loc, self.scale),
+            self._extend_shape(shape), nondiff=False,
+        )
+        return exp(base)
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "lognormal_logp", _lognormal_logp,
+            (self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        return 0.5 + _HALF_LOG_2PI + log(self.scale) + self.loc
+
+
+# ---------------------------------------------------------------- StudentT
+def _student_t_sample(df, loc, scale, *, key, shape):
+    return loc + scale * jax.random.t(
+        key, df, shape, dtype=jnp.result_type(loc)
+    )
+
+
+def _student_t_logp(df, loc, scale, v, *, _):
+    z = (v - loc) / scale
+    lg = jax.scipy.special.gammaln
+    return (
+        lg((df + 1.0) / 2.0) - lg(df / 2.0)
+        - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+        - ((df + 1.0) / 2.0) * jnp.log1p(z * z / df)
+    )
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _as_tensor(df)
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(
+            jnp.broadcast_shapes(
+                tuple(self.df.shape), tuple(self.loc.shape),
+                tuple(self.scale.shape),
+            )
+        )
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return (
+            self.scale * self.scale * self.df / (self.df - 2.0)
+        )
+
+    def sample(self, shape=()):
+        out = _sampler(
+            "student_t_sample", _student_t_sample,
+            (self.df, self.loc, self.scale), self._extend_shape(shape),
+        )
+        return out
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "student_t_logp", _student_t_logp,
+            (self.df, self.loc, self.scale, _as_tensor(value)), {"_": 0},
+        )

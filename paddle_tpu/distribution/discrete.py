@@ -1,0 +1,314 @@
+"""Discrete distributions (python/paddle/distribution/{bernoulli,binomial,
+categorical,geometric,multinomial,poisson}.py parity — unverified).
+
+Same contracts as continuous.py: dispatch-routed densities, jax.random
+samplers keyed from core.random. All discrete samples are nondiff.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as random_mod
+from .distribution import Distribution, _as_tensor, _shape_tuple
+
+
+def _xlogy(x, y):
+    return jnp.where(x == 0, 0.0, x * jnp.log(jnp.where(x == 0, 1.0, y)))
+
+
+# --------------------------------------------------------------- Bernoulli
+def _bernoulli_sample(p, *, key, shape):
+    return jax.random.bernoulli(key, p, shape).astype(p.dtype)
+
+
+def _bernoulli_logp(p, v, *, _):
+    return _xlogy(v, p) + _xlogy(1.0 - v, 1.0 - p)
+
+
+def _bernoulli_entropy(p, *, _):
+    return -(_xlogy(p, p) + _xlogy(1.0 - p, 1.0 - p))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_param = _as_tensor(probs)
+        super().__init__(tuple(self.probs_param.shape))
+
+    @property
+    def mean(self):
+        return self.probs_param
+
+    @property
+    def variance(self):
+        return self.probs_param * (1.0 - self.probs_param)
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "bernoulli_sample", _bernoulli_sample, (self.probs_param,),
+            {"key": random_mod.next_key(),
+             "shape": self._extend_shape(shape)},
+            cache=False, nondiff=True,
+        )
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax style relaxed sample (paddle exposes this)."""
+        from ..ops.math import log, sigmoid
+
+        u = dispatch.apply(
+            "uniform_raw",
+            lambda p, *, key, shape: jax.random.uniform(key, shape),
+            (self.probs_param,),
+            {"key": random_mod.next_key(),
+             "shape": self._extend_shape(shape)},
+            cache=False, nondiff=True,
+        )
+        logits = log(self.probs_param) - log(1.0 - self.probs_param)
+        noise = log(u) - log(1.0 - u)
+        return sigmoid((logits + noise) / float(temperature))
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "bernoulli_logp", _bernoulli_logp,
+            (self.probs_param, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "bernoulli_entropy", _bernoulli_entropy,
+            (self.probs_param,), {"_": 0},
+        )
+
+
+# ------------------------------------------------------------- Categorical
+def _categorical_sample(logits, *, key, shape):
+    return jax.random.categorical(key, logits, shape=shape).astype(jnp.int64)
+
+
+def _categorical_logp(logits, v, *, _):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(
+        logp, v[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+
+
+def _categorical_entropy(logits, *, _):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+        shape = tuple(self.logits.shape)
+        super().__init__(shape[:-1])
+        self._num_categories = shape[-1]
+
+    @property
+    def probs_tensor(self):
+        from ..nn.functional.activation import softmax
+
+        return softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "categorical_sample", _categorical_sample, (self.logits,),
+            {"key": random_mod.next_key(),
+             "shape": _shape_tuple(shape) + self._batch_shape},
+            cache=False, nondiff=True,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "categorical_logp", _categorical_logp,
+            (self.logits, _as_tensor(value)), {"_": 0},
+        )
+
+    def probs(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        return dispatch.apply(
+            "categorical_entropy", _categorical_entropy,
+            (self.logits,), {"_": 0},
+        )
+
+
+# --------------------------------------------------------------- Geometric
+def _geometric_sample(p, *, key, shape):
+    u = jax.random.uniform(key, shape, dtype=p.dtype)
+    # trials-until-first-success parameterization, support {0, 1, ...}
+    return jnp.floor(jnp.log1p(-u) / jnp.log1p(-p))
+
+
+def _geometric_logp(p, v, *, _):
+    return v * jnp.log1p(-p) + jnp.log(p)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_param = _as_tensor(probs)
+        super().__init__(tuple(self.probs_param.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs_param) / self.probs_param
+
+    @property
+    def variance(self):
+        return (
+            (1.0 - self.probs_param)
+            / (self.probs_param * self.probs_param)
+        )
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "geometric_sample", _geometric_sample, (self.probs_param,),
+            {"key": random_mod.next_key(),
+             "shape": self._extend_shape(shape)},
+            cache=False, nondiff=True,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "geometric_logp", _geometric_logp,
+            (self.probs_param, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        from ..ops.math import log
+
+        p = self.probs_param
+        return -((1.0 - p) * log(1.0 - p) + p * log(p)) / p
+
+
+# ----------------------------------------------------------------- Poisson
+def _poisson_sample(rate, *, key, shape):
+    return jax.random.poisson(key, rate, shape).astype(rate.dtype)
+
+
+def _poisson_logp(rate, v, *, _):
+    return (
+        v * jnp.log(rate) - rate - jax.scipy.special.gammaln(v + 1.0)
+    )
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _as_tensor(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "poisson_dist_sample", _poisson_sample, (self.rate,),
+            {"key": random_mod.next_key(),
+             "shape": self._extend_shape(shape)},
+            cache=False, nondiff=True,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "poisson_logp", _poisson_logp,
+            (self.rate, _as_tensor(value)), {"_": 0},
+        )
+
+
+# ---------------------------------------------------------------- Binomial
+def _binomial_sample(p, *, key, shape, n):
+    return jax.random.binomial(key, n, p, shape).astype(p.dtype)
+
+
+def _binomial_logp(p, v, *, n):
+    lg = jax.scipy.special.gammaln
+    logc = lg(n + 1.0) - lg(v + 1.0) - lg(n - v + 1.0)
+    return logc + _xlogy(v, p) + _xlogy(n - v, 1.0 - p)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = _as_tensor(probs)
+        super().__init__(tuple(self.probs_param.shape))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs_param
+
+    @property
+    def variance(self):
+        return (
+            self.total_count * self.probs_param * (1.0 - self.probs_param)
+        )
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "binomial_sample", _binomial_sample, (self.probs_param,),
+            {"key": random_mod.next_key(),
+             "shape": self._extend_shape(shape),
+             "n": float(self.total_count)},
+            cache=False, nondiff=True,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "binomial_logp", _binomial_logp,
+            (self.probs_param, _as_tensor(value)),
+            {"n": float(self.total_count)},
+        )
+
+
+# ------------------------------------------------------------- Multinomial
+def _multinomial_sample(p, *, key, shape, n):
+    return jax.random.multinomial(key, n, p, shape=shape).astype(p.dtype)
+
+
+def _multinomial_logp(p, v, *, n):
+    lg = jax.scipy.special.gammaln
+    logc = lg(n + 1.0) - jnp.sum(lg(v + 1.0), -1)
+    return logc + jnp.sum(_xlogy(v, p), -1)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = _as_tensor(probs)
+        shape = tuple(self.probs_param.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs_param
+
+    @property
+    def variance(self):
+        return (
+            self.total_count * self.probs_param * (1.0 - self.probs_param)
+        )
+
+    def sample(self, shape=()):
+        return dispatch.apply(
+            "multinomial_sample", _multinomial_sample, (self.probs_param,),
+            {"key": random_mod.next_key(),
+             "shape": _shape_tuple(shape) + self._batch_shape
+             + self._event_shape,
+             "n": float(self.total_count)},
+            cache=False, nondiff=True,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "multinomial_logp", _multinomial_logp,
+            (self.probs_param, _as_tensor(value)),
+            {"n": float(self.total_count)},
+        )

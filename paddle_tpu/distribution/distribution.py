@@ -1,0 +1,84 @@
+"""Distribution base class.
+
+Reference parity: python/paddle/distribution/distribution.py (unverified,
+mount empty). Distributions are thin Python objects over the framework's
+Tensor ops: parameters are Tensors, densities/entropies compose
+dispatch-routed ops (so grads flow to parameters), and sampling draws
+trace-safe PRNG keys from core.random (paddle.seed-deterministic).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _as_tensor(v, dtype=None):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(jnp.asarray(v, dtype=dtype or jnp.float32))
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample"
+        )
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops.math import exp
+
+        return exp(self.log_prob(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return (
+            _shape_tuple(sample_shape) + self._batch_shape
+            + self._event_shape
+        )

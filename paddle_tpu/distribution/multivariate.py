@@ -1,0 +1,111 @@
+"""MultivariateNormal (python/paddle/distribution/multivariate_normal.py
+parity — unverified): parameterized by covariance, precision, or
+scale_tril; internally everything runs on the Cholesky factor."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as random_mod
+from .distribution import Distribution, _as_tensor
+
+
+def _mvn_sample(loc, tril, *, key, shape):
+    eps = jax.random.normal(
+        key, shape + loc.shape[-1:], dtype=jnp.result_type(loc)
+    )
+    return loc + jnp.einsum("...ij,...j->...i", tril, eps)
+
+
+def _mvn_logp(loc, tril, v, *, _):
+    d = loc.shape[-1]
+    diff = v - loc
+    y = jax.scipy.linalg.solve_triangular(tril, diff[..., None], lower=True)
+    maha = jnp.sum(jnp.square(y[..., 0]), -1)
+    logdet = jnp.sum(jnp.log(jnp.diagonal(tril, axis1=-2, axis2=-1)), -1)
+    return -0.5 * (d * math.log(2.0 * math.pi) + maha) - logdet
+
+
+def _mvn_entropy(tril, *, _):
+    d = tril.shape[-1]
+    logdet = jnp.sum(jnp.log(jnp.diagonal(tril, axis1=-2, axis2=-1)), -1)
+    return 0.5 * d * (1.0 + math.log(2.0 * math.pi)) + logdet
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _as_tensor(loc)
+        given = [
+            m is not None
+            for m in (covariance_matrix, precision_matrix, scale_tril)
+        ]
+        if sum(given) != 1:
+            raise ValueError(
+                "MultivariateNormal: exactly one of covariance_matrix, "
+                "precision_matrix, scale_tril must be given"
+            )
+        if scale_tril is not None:
+            self.scale_tril = _as_tensor(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _as_tensor(covariance_matrix)
+            from ..ops.linalg import cholesky
+
+            self.scale_tril = cholesky(cov)
+        else:
+            prec = _as_tensor(precision_matrix)
+            from ..ops.linalg import cholesky, inv
+
+            self.scale_tril = cholesky(inv(prec))
+        shape = tuple(self.loc.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        from ..ops.math import matmul
+        from ..ops.manipulation import transpose
+
+        t = self.scale_tril
+        nd = len(t.shape)
+        perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+        return matmul(t, transpose(t, perm))
+
+    @property
+    def variance(self):
+        from ..ops.linalg import matmul  # noqa: F401
+        from ..ops.manipulation import diagonal
+
+        return diagonal(self.covariance_matrix, axis1=-2, axis2=-1)
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        from .distribution import _shape_tuple
+
+        return dispatch.apply(
+            "mvn_sample", _mvn_sample, (self.loc, self.scale_tril),
+            {"key": random_mod.next_key(),
+             "shape": _shape_tuple(shape) + self._batch_shape},
+            cache=False, nondiff=False,
+        )
+
+    def log_prob(self, value):
+        return dispatch.apply(
+            "mvn_logp", _mvn_logp,
+            (self.loc, self.scale_tril, _as_tensor(value)), {"_": 0},
+        )
+
+    def entropy(self):
+        return dispatch.apply(
+            "mvn_entropy", _mvn_entropy, (self.scale_tril,), {"_": 0}
+        )

@@ -1,0 +1,157 @@
+"""Bijective transforms + TransformedDistribution
+(python/paddle/distribution/{transform,transformed_distribution}.py
+parity — unverified). Transforms compose framework Tensor ops, so
+forward/inverse/log_det are all differentiable."""
+from __future__ import annotations
+
+from .distribution import Distribution, _as_tensor
+
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.math import abs as _abs, log
+
+        return log(_abs(self.scale)) + x * 0.0
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        from ..ops.math import exp
+
+        return exp(x)
+
+    def inverse(self, y):
+        from ..ops.math import log
+
+        return log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops.math import abs as _abs, log
+
+        return log(_abs(self.power * x ** (self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        from ..ops.math import sigmoid
+
+        return sigmoid(x)
+
+    def inverse(self, y):
+        from ..ops.math import log
+
+        return log(y) - log(1.0 - y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional.activation import log_sigmoid
+
+        return log_sigmoid(x) + log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        from ..ops.math import tanh
+
+        return tanh(x)
+
+    def inverse(self, y):
+        from ..ops.math import atanh
+
+        return atanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        import math
+
+        from ..nn.functional.activation import softplus
+
+        # log(1 - tanh(x)^2) = 2*(log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = ChainTransform(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        out = self.transforms.forward(self.base.sample(shape))
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        return self.transforms.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        x = self.transforms.inverse(value)
+        return (
+            self.base.log_prob(x)
+            - self.transforms.forward_log_det_jacobian(x)
+        )

@@ -1,0 +1,225 @@
+"""paddle.distribution vs torch.distributions oracles: log_prob/entropy/
+mean/variance parity, KL registry pairs, sampling statistics, rsample
+gradients, and transformed distributions."""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(5)
+LOC = RNG.randn(4).astype(np.float32)
+SCALE = (RNG.rand(4) + 0.5).astype(np.float32)
+A = (RNG.rand(4) + 0.5).astype(np.float32)
+B = (RNG.rand(4) + 0.5).astype(np.float32)
+P = (RNG.rand(4) * 0.8 + 0.1).astype(np.float32)
+V = RNG.randn(4).astype(np.float32)
+VPOS = (RNG.rand(4) + 0.5).astype(np.float32)
+V01 = (RNG.rand(4) * 0.8 + 0.1).astype(np.float32)
+VK = RNG.randint(0, 6, 4).astype(np.float32)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+def close(mine, gold, tol=1e-4):
+    np.testing.assert_allclose(
+        np.asarray(mine.numpy()), gold.numpy(), rtol=1e-4, atol=tol
+    )
+
+
+PAIRS = [
+    ("normal", lambda: D.Normal(LOC, SCALE),
+     lambda: td.Normal(torch.tensor(LOC), torch.tensor(SCALE)), V),
+    ("laplace", lambda: D.Laplace(LOC, SCALE),
+     lambda: td.Laplace(torch.tensor(LOC), torch.tensor(SCALE)), V),
+    ("gumbel", lambda: D.Gumbel(LOC, SCALE),
+     lambda: td.Gumbel(torch.tensor(LOC), torch.tensor(SCALE)), V),
+    ("cauchy", lambda: D.Cauchy(LOC, SCALE),
+     lambda: td.Cauchy(torch.tensor(LOC), torch.tensor(SCALE)), V),
+    ("beta", lambda: D.Beta(A, B),
+     lambda: td.Beta(torch.tensor(A), torch.tensor(B)), V01),
+    ("gamma", lambda: D.Gamma(A, B),
+     lambda: td.Gamma(torch.tensor(A), torch.tensor(B)), VPOS),
+    ("lognormal", lambda: D.LogNormal(LOC, SCALE),
+     lambda: td.LogNormal(torch.tensor(LOC), torch.tensor(SCALE)), VPOS),
+    ("studentt", lambda: D.StudentT(A * 3, LOC, SCALE),
+     lambda: td.StudentT(
+         torch.tensor(A * 3), torch.tensor(LOC), torch.tensor(SCALE)
+     ), V),
+    ("bernoulli", lambda: D.Bernoulli(P),
+     lambda: td.Bernoulli(torch.tensor(P)),
+     (RNG.rand(4) > 0.5).astype(np.float32)),
+    ("geometric", lambda: D.Geometric(P),
+     lambda: td.Geometric(torch.tensor(P)), VK),
+    ("poisson", lambda: D.Poisson(A * 2),
+     lambda: td.Poisson(torch.tensor(A * 2)), VK),
+]
+
+
+@pytest.mark.parametrize(
+    "name,mk,mk_gold,value", PAIRS, ids=[p[0] for p in PAIRS]
+)
+def test_log_prob_parity(name, mk, mk_gold, value):
+    close(mk().log_prob(T(value)), mk_gold().log_prob(torch.tensor(value)))
+
+
+@pytest.mark.parametrize("name,mk,mk_gold", [
+    ("normal", lambda: D.Normal(LOC, SCALE),
+     lambda: td.Normal(torch.tensor(LOC), torch.tensor(SCALE))),
+    ("beta", lambda: D.Beta(A, B),
+     lambda: td.Beta(torch.tensor(A), torch.tensor(B))),
+    ("gamma", lambda: D.Gamma(A, B),
+     lambda: td.Gamma(torch.tensor(A), torch.tensor(B))),
+    ("bernoulli", lambda: D.Bernoulli(P),
+     lambda: td.Bernoulli(torch.tensor(P))),
+    ("cauchy", lambda: D.Cauchy(LOC, SCALE),
+     lambda: td.Cauchy(torch.tensor(LOC), torch.tensor(SCALE))),
+], ids=["normal", "beta", "gamma", "bernoulli", "cauchy"])
+def test_entropy_parity(name, mk, mk_gold):
+    close(mk().entropy(), mk_gold().entropy())
+
+
+def test_uniform():
+    u = D.Uniform(LOC, LOC + 2.0)
+    tu = td.Uniform(torch.tensor(LOC), torch.tensor(LOC + 2.0))
+    vin = LOC + 0.7
+    close(u.log_prob(T(vin)), tu.log_prob(torch.tensor(vin)))
+    close(u.entropy(), tu.entropy())
+    close(u.mean, tu.mean)
+    close(u.variance, tu.variance)
+
+
+def test_dirichlet_and_categorical():
+    conc = (RNG.rand(3, 4) + 0.5).astype(np.float32)
+    dr = D.Dirichlet(conc)
+    tdr = td.Dirichlet(torch.tensor(conc))
+    vd = RNG.dirichlet([1] * 4, 3).astype(np.float32)
+    close(dr.log_prob(T(vd)), tdr.log_prob(torch.tensor(vd)))
+    close(dr.entropy(), tdr.entropy())
+    logits = RNG.randn(3, 5).astype(np.float32)
+    ct = D.Categorical(logits)
+    tct = td.Categorical(logits=torch.tensor(logits))
+    vc = RNG.randint(0, 5, 3).astype(np.int64)
+    close(ct.log_prob(T(vc)), tct.log_prob(torch.tensor(vc)))
+    close(ct.entropy(), tct.entropy())
+
+
+def test_counting_families():
+    bi = D.Binomial(10, P)
+    tbi = td.Binomial(10, torch.tensor(P))
+    vb = RNG.randint(0, 10, 4).astype(np.float32)
+    close(bi.log_prob(T(vb)), tbi.log_prob(torch.tensor(vb)))
+    pm = RNG.dirichlet([1] * 4).astype(np.float32)
+    mu = D.Multinomial(8, pm)
+    tmu = td.Multinomial(8, torch.tensor(pm))
+    vm = RNG.multinomial(8, pm).astype(np.float32)
+    close(mu.log_prob(T(vm)), tmu.log_prob(torch.tensor(vm)))
+
+
+def _mvn_pair():
+    L = np.tril(RNG.randn(3, 3)).astype(np.float32)
+    np.fill_diagonal(L, np.abs(np.diag(L)) + 0.5)
+    loc = RNG.randn(3).astype(np.float32)
+    return (
+        L, loc,
+        D.MultivariateNormal(loc, scale_tril=L),
+        td.MultivariateNormal(torch.tensor(loc), scale_tril=torch.tensor(L)),
+    )
+
+
+def test_multivariate_normal():
+    L, loc, mv, tmv = _mvn_pair()
+    v = RNG.randn(3).astype(np.float32)
+    close(mv.log_prob(T(v)), tmv.log_prob(torch.tensor(v)))
+    close(mv.entropy(), tmv.entropy())
+    mv2 = D.MultivariateNormal(loc, covariance_matrix=L @ L.T)
+    close(mv2.log_prob(T(v)), tmv.log_prob(torch.tensor(v)), tol=1e-3)
+    with pytest.raises(ValueError):
+        D.MultivariateNormal(loc)
+
+
+def test_kl_registry_pairs():
+    n1, n2 = D.Normal(LOC, SCALE), D.Normal(LOC + 1, SCALE * 2)
+    t1 = td.Normal(torch.tensor(LOC), torch.tensor(SCALE))
+    t2 = td.Normal(torch.tensor(LOC + 1), torch.tensor(SCALE * 2))
+    close(D.kl_divergence(n1, n2), td.kl_divergence(t1, t2))
+    close(n1.kl_divergence(n2), td.kl_divergence(t1, t2))
+    close(
+        D.kl_divergence(D.Beta(A, B), D.Beta(B, A)),
+        td.kl_divergence(
+            td.Beta(torch.tensor(A), torch.tensor(B)),
+            td.Beta(torch.tensor(B), torch.tensor(A)),
+        ),
+    )
+    close(
+        D.kl_divergence(D.Gamma(A, B), D.Gamma(B, A)),
+        td.kl_divergence(
+            td.Gamma(torch.tensor(A), torch.tensor(B)),
+            td.Gamma(torch.tensor(B), torch.tensor(A)),
+        ),
+    )
+    logits = RNG.randn(3, 5).astype(np.float32)
+    close(
+        D.kl_divergence(D.Categorical(logits), D.Categorical(logits * 0.5)),
+        td.kl_divergence(
+            td.Categorical(logits=torch.tensor(logits)),
+            td.Categorical(logits=torch.tensor(logits * 0.5)),
+        ),
+    )
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(LOC, SCALE), D.Beta(A, B))
+
+
+def test_sampling_statistics():
+    paddle.seed(7)
+    s = D.Normal(LOC, SCALE).sample([20000])
+    assert tuple(s.shape) == (20000, 4)
+    assert np.abs(s.numpy().mean(0) - LOC).max() < 0.05
+    cs = D.Categorical(np.array([0.0, 1.0, 2.0], np.float32)).sample([30000])
+    freq = np.bincount(cs.numpy().astype(int), minlength=3) / 30000
+    gold = np.exp([0.0, 1.0, 2.0])
+    gold /= gold.sum()
+    assert np.abs(freq - gold).max() < 0.02
+    bs = D.Bernoulli(P).sample([10000])
+    assert np.abs(bs.numpy().mean(0) - P).max() < 0.03
+
+
+def test_rsample_grad_flows_to_params():
+    lt = T(LOC)
+    lt.stop_gradient = False
+    D.Normal(lt, T(SCALE)).rsample([100]).sum().backward()
+    np.testing.assert_allclose(lt.grad.numpy(), 100.0, rtol=1e-5)
+
+
+def test_transformed_distribution_tanh():
+    base = D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32))
+    tdist = D.TransformedDistribution(base, [D.TanhTransform()])
+    gold = td.TransformedDistribution(
+        td.Normal(torch.zeros(4), torch.ones(4)),
+        [td.transforms.TanhTransform()],
+    )
+    v = np.tanh(RNG.randn(4).astype(np.float32)) * 0.9
+    close(tdist.log_prob(T(v)), gold.log_prob(torch.tensor(v)), tol=1e-3)
+    s = tdist.sample([64])
+    assert np.abs(s.numpy()).max() <= 1.0
+
+
+def test_affine_exp_chain_roundtrip():
+    chain = D.ChainTransform([
+        D.AffineTransform(1.0, 2.0), D.ExpTransform()
+    ])
+    x = T(V)
+    y = chain.forward(x)
+    np.testing.assert_allclose(
+        chain.inverse(y).numpy(), V, rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        y.numpy(), np.exp(1.0 + 2.0 * V), rtol=1e-4
+    )
