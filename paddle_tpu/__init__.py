@@ -130,7 +130,9 @@ from . import jit  # noqa: E402
 from . import profiler  # noqa: E402
 from . import utils  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
+from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
+from . import signal  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import models  # noqa: E402

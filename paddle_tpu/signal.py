@@ -1,0 +1,137 @@
+"""paddle.signal: stft / istft (python/paddle/signal.py parity —
+unverified).
+
+Framing + FFT compose jnp primitives through core.dispatch; the FFT
+itself is XLA's native implementation. istft uses the standard
+overlap-add with window-envelope normalization (NOLA), matching the
+reference/torch semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import dispatch
+from .core.tensor import Tensor
+
+
+def _frame(x, n_fft, hop, center, pad_mode):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    n = x.shape[-1]
+    n_frames = 1 + (n - n_fft) // hop
+    starts = jnp.arange(n_frames) * hop
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    return x[..., idx]  # [..., n_frames, n_fft]
+
+
+def _stft(x, window, *, n_fft, hop, center, pad_mode, normalized, onesided):
+    frames = _frame(x, n_fft, hop, center, pad_mode)
+    if window is not None:
+        frames = frames * window
+    if onesided:
+        spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # [..., freq, n_frames] like the reference
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    args = [x]
+    if window is not None:
+        if not isinstance(window, Tensor):
+            window = Tensor(jnp.asarray(window))
+        if int(window.shape[-1]) != win_length:
+            raise ValueError(
+                f"stft: window length {window.shape[-1]} != "
+                f"win_length {win_length}"
+            )
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            window = Tensor(jnp.pad(
+                window.value, (lpad, n_fft - win_length - lpad)
+            ))
+        args.append(window)
+    else:
+        args.append(None)
+    return dispatch.apply(
+        "stft", _stft, tuple(args),
+        {"n_fft": int(n_fft), "hop": hop, "center": bool(center),
+         "pad_mode": pad_mode, "normalized": bool(normalized),
+         "onesided": bool(onesided)},
+    )
+
+
+def _istft(spec, window, *, n_fft, hop, center, normalized, onesided,
+           length, return_complex):
+    frames = jnp.swapaxes(spec, -1, -2)  # [..., n_frames, freq]
+    if normalized:
+        frames = frames * jnp.sqrt(jnp.asarray(n_fft, frames.real.dtype))
+    if onesided:
+        sig = jnp.fft.irfft(frames, n=n_fft, axis=-1)
+    else:
+        sig = jnp.fft.ifft(frames, n=n_fft, axis=-1)
+        if not return_complex:
+            sig = sig.real
+    if window is None:
+        window = jnp.ones((n_fft,), sig.real.dtype)
+    sig = sig * window
+    n_frames = sig.shape[-2]
+    out_len = n_fft + hop * (n_frames - 1)
+    shape = sig.shape[:-2] + (out_len,)
+    out = jnp.zeros(shape, sig.dtype)
+    env = jnp.zeros((out_len,), jnp.asarray(window).real.dtype)
+    idx = (
+        jnp.arange(n_frames)[:, None] * hop
+        + jnp.arange(n_fft)[None, :]
+    )
+    out = out.at[..., idx].add(sig)
+    env = env.at[idx].add(jnp.square(window))
+    out = out / jnp.where(env > 1e-11, env, 1.0)
+    if center:
+        out = out[..., n_fft // 2:]
+        if length is not None:
+            out = out[..., :length]
+        else:
+            out = out[..., : out_len - n_fft]
+    elif length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else int(n_fft)
+    args = [x]
+    if window is not None:
+        if not isinstance(window, Tensor):
+            window = Tensor(jnp.asarray(window))
+        if int(window.shape[-1]) != win_length:
+            raise ValueError(
+                f"istft: window length {window.shape[-1]} != "
+                f"win_length {win_length}"
+            )
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            window = Tensor(jnp.pad(
+                window.value, (lpad, n_fft - win_length - lpad)
+            ))
+        args.append(window)
+    else:
+        args.append(None)
+    return dispatch.apply(
+        "istft", _istft, tuple(args),
+        {"n_fft": int(n_fft), "hop": hop, "center": bool(center),
+         "normalized": bool(normalized), "onesided": bool(onesided),
+         "length": None if length is None else int(length),
+         "return_complex": bool(return_complex)},
+    )

@@ -1,3 +1,3 @@
 """paddle.vision parity (python/paddle/vision/ — unverified)."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet  # noqa: F401

@@ -1,0 +1,404 @@
+"""paddle.vision.ops (python/paddle/vision/ops.py parity — unverified):
+detection primitives. All are pure-jnp compositions through
+core.dispatch; nms uses a fixed-trip lax.while loop (static shapes for
+XLA), roi_align/deform_conv2d are bilinear gathers that lower to XLA
+gather/matmul — TPU-friendly, no dynamic shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+__all__ = [
+    "nms",
+    "roi_align",
+    "roi_pool",
+    "deform_conv2d",
+    "DeformConv2D",
+    "box_coder",
+]
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, i] for i in range(4))
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms(boxes, scores, *, iou_threshold, top_k):
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes)[order][:, order]
+    # keep[i] = no higher-scored kept box overlaps i beyond threshold
+    suppressed = jnp.zeros((n,), jnp.bool_)
+
+    def body(i, suppressed):
+        over = iou[i] > iou_threshold
+        newly = over & (jnp.arange(n) > i) & ~suppressed[i]
+        return suppressed | newly
+
+    suppressed = jax.lax.fori_loop(0, n, body, suppressed)
+    keep_sorted = ~suppressed
+    kept_idx = jnp.where(
+        keep_sorted, jnp.arange(n), n
+    )
+    kept_idx = jnp.sort(kept_idx)[:top_k]
+    return order[jnp.where(kept_idx < n, kept_idx, 0)], (kept_idx < n)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS. Returns kept box indices (descending score order).
+
+    With ``category_idxs``, suppression is done per category by offsetting
+    boxes so different categories never overlap (the standard trick).
+    """
+    n = int(boxes.shape[0])
+    if scores is None:
+        scores = Tensor(jnp.arange(n, 0, -1, dtype=jnp.float32))
+    top_k = n if top_k is None else min(int(top_k), n)
+    if category_idxs is not None:
+        import numpy as _np
+
+        bv = _np.asarray(boxes.numpy())
+        # shift each category into a disjoint coordinate band; span must
+        # cover the full extent (negative coords included)
+        span = float(bv.max() - bv.min()) + 1.0
+        if not isinstance(category_idxs, Tensor):
+            category_idxs = Tensor(jnp.asarray(category_idxs))
+        offs = category_idxs.value.astype(jnp.float32)[:, None] * span
+        boxes = Tensor(boxes.value + offs)
+    idx, valid = dispatch.apply(
+        "nms", _nms, (boxes, scores),
+        {"iou_threshold": float(iou_threshold), "top_k": top_k},
+        nondiff=True,
+    )
+    # compact to the valid prefix (host-side, like the reference's
+    # dynamic-shaped output)
+    import numpy as np
+
+    iv = np.asarray(idx.numpy())
+    vv = np.asarray(valid.numpy())
+    return Tensor(jnp.asarray(iv[vv].astype(np.int64)))
+
+
+def _bilinear_gather(feat, y, x):
+    """feat [C, H, W]; y/x arbitrary same-shape index grids (float)."""
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+
+    def at(yi, xi):
+        inb = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        return feat[:, yc, xc] * inb.astype(feat.dtype)
+
+    return (
+        at(y0, x0) * (wy0 * wx0) + at(y0, x1) * (wy0 * wx1)
+        + at(y1, x0) * (wy1 * wx0) + at(y1, x1) * (wy1 * wx1)
+    )
+
+
+def _roi_align(feat, rois, roi_batch_idx, *, out_h, out_w, spatial_scale,
+               sampling_ratio, aligned):
+    off = 0.5 if aligned else 0.0
+
+    def one_roi(bi, roi):
+        fm = feat[bi]
+        x1, y1, x2, y2 = roi * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_h = rh / out_h
+        bin_w = rw / out_w
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+        iy = (jnp.arange(ratio) + 0.5) / ratio
+        gy = (
+            y1 + bin_h * (jnp.arange(out_h)[:, None] + iy[None, :])
+        ).reshape(-1)
+        gx = (
+            x1 + bin_w * (jnp.arange(out_w)[:, None] + iy[None, :])
+        ).reshape(-1)
+        yy = jnp.repeat(gy, gx.shape[0])
+        xx = jnp.tile(gx, gy.shape[0])
+        vals = _bilinear_gather(fm, yy, xx)  # [C, (out_h*r)*(out_w*r)]
+        c = vals.shape[0]
+        vals = vals.reshape(c, out_h, ratio, out_w, ratio)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one_roi)(roi_batch_idx, rois)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (bilinear bin sampling + average).
+
+    TPU deviation from the reference: with ``sampling_ratio=-1`` the
+    reference adapts the grid per ROI (ceil(roi_size/out_size) samples),
+    which is data-dependent — impossible under XLA's static shapes. Here
+    -1 means a fixed 2x2 grid per bin (detection-head scale ROIs);
+    pass an explicit ``sampling_ratio`` for exact reference parity at
+    that ratio.
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = [int(v) for v in (
+        boxes_num.tolist() if isinstance(boxes_num, Tensor) else boxes_num
+    )]
+    batch_idx = jnp.concatenate([
+        jnp.full((c,), i, jnp.int32) for i, c in enumerate(bn)
+    ]) if bn else jnp.zeros((0,), jnp.int32)
+    return dispatch.apply(
+        "roi_align", _roi_align, (x, boxes, Tensor(batch_idx)),
+        {"out_h": int(output_size[0]), "out_w": int(output_size[1]),
+         "spatial_scale": float(spatial_scale),
+         "sampling_ratio": int(sampling_ratio), "aligned": bool(aligned)},
+    )
+
+
+def _roi_pool(feat, rois, roi_batch_idx, *, out_h, out_w, spatial_scale):
+    h, w = feat.shape[-2], feat.shape[-1]
+
+    def one_roi(bi, roi):
+        fm = feat[bi]
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / out_h
+        bin_w = rw / out_w
+        ys = jnp.arange(h, dtype=fm.dtype)
+        xs = jnp.arange(w, dtype=fm.dtype)
+
+        def one_bin(py, px):
+            hs = jnp.floor(y1 + py * bin_h)
+            he = jnp.ceil(y1 + (py + 1) * bin_h)
+            ws_ = jnp.floor(x1 + px * bin_w)
+            we = jnp.ceil(x1 + (px + 1) * bin_w)
+            mask = (
+                ((ys >= hs) & (ys < he))[:, None]
+                & ((xs >= ws_) & (xs < we))[None, :]
+            )
+            neg = jnp.asarray(-jnp.inf, fm.dtype)
+            vals = jnp.where(mask[None], fm, neg)
+            mx = jnp.max(vals, axis=(-2, -1))
+            return jnp.where(jnp.isfinite(mx), mx, 0.0)
+
+        py = jnp.arange(out_h)
+        px = jnp.arange(out_w)
+        return jax.vmap(
+            lambda a: jax.vmap(lambda b: one_bin(a, b))(px)
+        )(py).transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(roi_batch_idx, rois)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    bn = [int(v) for v in (
+        boxes_num.tolist() if isinstance(boxes_num, Tensor) else boxes_num
+    )]
+    batch_idx = jnp.concatenate([
+        jnp.full((c,), i, jnp.int32) for i, c in enumerate(bn)
+    ]) if bn else jnp.zeros((0,), jnp.int32)
+    return dispatch.apply(
+        "roi_pool", _roi_pool, (x, boxes, Tensor(batch_idx)),
+        {"out_h": int(output_size[0]), "out_w": int(output_size[1]),
+         "spatial_scale": float(spatial_scale)},
+    )
+
+
+def _deform_conv2d(x, offset, weight, mask, bias, *, stride, padding,
+                   dilation, groups, deform_groups):
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    out_h = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    # base sampling grid per output position and kernel tap
+    base_y = (
+        jnp.arange(out_h)[:, None] * sh - ph
+        + jnp.arange(kh)[None, :] * dh
+    )  # [out_h, kh]
+    base_x = (
+        jnp.arange(out_w)[:, None] * sw - pw
+        + jnp.arange(kw)[None, :] * dw
+    )  # [out_w, kw]
+    # offset: [N, 2*dg*kh*kw, out_h, out_w] (y then x per tap)
+    off = offset.reshape(n, deform_groups, kh * kw, 2, out_h, out_w)
+    if mask is not None:
+        mk = mask.reshape(n, deform_groups, kh * kw, out_h, out_w)
+    cpg = cin // deform_groups  # channels per deform group
+
+    def per_sample(xs, offs, mks):
+        # xs [cin,h,w]; offs [dg,kh*kw,2,out_h,out_w]
+        def per_dg(feat, o, m):
+            # feat [cpg,h,w]; o [kh*kw,2,out_h,out_w]
+            def per_tap(t):
+                ky, kx = t // kw, t % kw
+                yy = base_y[:, ky][:, None] + o[t, 0]
+                xx = base_x[:, kx][None, :] + o[t, 1]
+                v = _bilinear_gather(feat, yy, xx)  # [cpg,out_h,out_w]
+                if m is not None:
+                    v = v * m[t]
+                return v
+
+            return jax.vmap(per_tap)(jnp.arange(kh * kw))
+
+        taps = jax.vmap(per_dg)(
+            xs.reshape(deform_groups, cpg, h, w), offs,
+            mks if mks is not None else None,
+        )  # [dg, kh*kw, cpg, out_h, out_w]
+        # -> channel-major (dg, cpg, tap) to match the weight layout
+        return taps.transpose(0, 2, 1, 3, 4).reshape(
+            deform_groups * cpg * kh * kw, out_h, out_w
+        )
+
+    if mask is not None:
+        cols = jax.vmap(per_sample)(x, off, mk)
+    else:
+        cols = jax.vmap(lambda a, b: per_sample(a, b, None))(x, off)
+    # cols [N, cin*kh*kw, out_h, out_w], channel-major (dg, cpg, tap)
+    cols = cols.reshape(n, cin, kh * kw, out_h, out_w)
+    wmat = weight.reshape(groups, cout // groups, cin_g * kh * kw)
+    cols_g = cols.reshape(n, groups, cin_g, kh * kw, out_h, out_w).reshape(
+        n, groups, cin_g * kh * kw, out_h * out_w
+    )
+    out = jnp.einsum("gok,ngkp->ngop", wmat, cols_g).reshape(
+        n, cout, out_h, out_w
+    )
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    def pair(v):
+        return (int(v), int(v)) if isinstance(v, int) else tuple(
+            int(a) for a in v
+        )
+
+    args = (x, offset, weight, mask, bias)
+    return dispatch.apply(
+        "deform_conv2d", _deform_conv2d, args,
+        {"stride": pair(stride), "padding": pair(padding),
+         "dilation": pair(dilation), "groups": int(groups),
+         "deform_groups": int(deformable_groups)},
+    )
+
+
+from ..nn.layer.layers import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper over deform_conv2d (paddle.vision.ops.DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (
+            (kernel_size, kernel_size)
+            if isinstance(kernel_size, int) else tuple(kernel_size)
+        )
+        self._cfg = dict(
+            stride=stride, padding=padding, dilation=dilation,
+            deformable_groups=deformable_groups, groups=groups,
+        )
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr,
+        )
+        self.bias = (
+            None if bias_attr is False else self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True
+            )
+        )
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, mask=mask, **self._cfg
+        )
+
+
+def _box_coder_encode(prior, prior_var, target, *, norm):
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if norm else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if norm else 1.0)
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    tw = target[:, 2] - target[:, 0] + (0.0 if norm else 1.0)
+    th = target[:, 3] - target[:, 1] + (0.0 if norm else 1.0)
+    tcx = target[:, 0] + tw * 0.5
+    tcy = target[:, 1] + th * 0.5
+    out = jnp.stack([
+        (tcx - pcx) / pw, (tcy - pcy) / ph, jnp.log(tw / pw),
+        jnp.log(th / ph),
+    ], axis=1)
+    if prior_var is not None:
+        out = out / prior_var
+    return out
+
+
+def _box_coder_decode(prior, prior_var, code, *, norm, axis):
+    if axis == 1:
+        prior = prior[None, :, :]
+        if prior_var is not None:
+            prior_var = prior_var[None, :, :]
+    else:
+        prior = prior[:, None, :]
+        if prior_var is not None:
+            prior_var = prior_var[:, None, :]
+    pw = prior[..., 2] - prior[..., 0] + (0.0 if norm else 1.0)
+    ph = prior[..., 3] - prior[..., 1] + (0.0 if norm else 1.0)
+    pcx = prior[..., 0] + pw * 0.5
+    pcy = prior[..., 1] + ph * 0.5
+    if prior_var is not None:
+        code = code * prior_var
+    cx = code[..., 0] * pw + pcx
+    cy = code[..., 1] * ph + pcy
+    w = jnp.exp(code[..., 2]) * pw
+    h = jnp.exp(code[..., 3]) * ph
+    sub = 0.0 if norm else 1.0
+    return jnp.stack([
+        cx - w * 0.5, cy - h * 0.5, cx + w * 0.5 - sub, cy + h * 0.5 - sub,
+    ], axis=-1)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    pv = prior_box_var
+    if pv is not None and not isinstance(pv, Tensor):
+        pv = Tensor(jnp.asarray(pv, jnp.float32))
+    if code_type == "encode_center_size":
+        return dispatch.apply(
+            "box_coder_encode", _box_coder_encode,
+            (prior_box, pv, target_box), {"norm": bool(box_normalized)},
+        )
+    if code_type == "decode_center_size":
+        return dispatch.apply(
+            "box_coder_decode", _box_coder_decode,
+            (prior_box, pv, target_box),
+            {"norm": bool(box_normalized), "axis": int(axis)},
+        )
+    raise ValueError(f"box_coder: unknown code_type {code_type!r}")

@@ -1,0 +1,270 @@
+"""paddle.signal (stft/istft vs torch) + paddle.vision.ops (nms/roi/
+deform_conv2d/box_coder vs numpy + torch-conv oracles)."""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision import ops as V
+
+RNG = np.random.RandomState(9)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+# ------------------------------------------------------------------ signal
+SIG = RNG.randn(2, 512).astype(np.float32)
+WIN = np.hanning(128).astype(np.float32)
+
+
+@pytest.mark.parametrize("center,normalized", [
+    (True, False), (True, True), (False, False),
+])
+def test_stft_vs_torch(center, normalized):
+    mine = paddle.signal.stft(
+        T(SIG), n_fft=128, hop_length=64, window=T(WIN), center=center,
+        normalized=normalized,
+    ).numpy()
+    gold = torch.stft(
+        torch.tensor(SIG), n_fft=128, hop_length=64,
+        window=torch.tensor(WIN), center=center, normalized=normalized,
+        return_complex=True,
+    ).numpy()
+    assert mine.shape == gold.shape
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+
+
+def test_stft_twosided_vs_torch():
+    mine = paddle.signal.stft(
+        T(SIG), n_fft=128, hop_length=64, window=T(WIN), onesided=False
+    ).numpy()
+    gold = torch.stft(
+        torch.tensor(SIG), n_fft=128, hop_length=64,
+        window=torch.tensor(WIN), onesided=False, return_complex=True,
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+
+
+def test_istft_roundtrip_and_torch_parity():
+    spec = paddle.signal.stft(
+        T(SIG), n_fft=128, hop_length=64, window=T(WIN)
+    )
+    rec = paddle.signal.istft(
+        spec, n_fft=128, hop_length=64, window=T(WIN), length=512
+    ).numpy()
+    gold = torch.istft(
+        torch.tensor(spec.numpy()), n_fft=128, hop_length=64,
+        window=torch.tensor(WIN), length=512,
+    ).numpy()
+    np.testing.assert_allclose(rec, gold, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        rec[:, 64:-64], SIG[:, 64:-64], rtol=1e-3, atol=1e-4
+    )
+
+
+def test_stft_window_length_validation():
+    with pytest.raises(ValueError):
+        paddle.signal.stft(T(SIG), n_fft=128, window=T(WIN[:64]))
+
+
+# --------------------------------------------------------------------- nms
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        iou = inter / (a[i] + a[order[1:]] - inter)
+        order = order[1:][iou <= thr]
+    return np.sort(np.array(keep))
+
+
+def test_nms_matches_greedy_numpy():
+    boxes = RNG.rand(30, 4).astype(np.float32) * 50
+    boxes[:, 2:] += boxes[:, :2] + 5
+    scores = RNG.rand(30).astype(np.float32)
+    mine = V.nms(T(boxes), 0.4, T(scores)).numpy()
+    np.testing.assert_array_equal(
+        np.sort(mine), _np_nms(boxes, scores, 0.4)
+    )
+
+
+def test_nms_categories_do_not_cross_suppress():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11]], np.float32
+    )
+    scores = np.array([0.9, 0.8], np.float32)
+    same = V.nms(T(boxes), 0.3, T(scores)).numpy()
+    assert len(same) == 1
+    crossed = V.nms(
+        T(boxes), 0.3, T(scores),
+        category_idxs=T(np.array([0, 1], np.int64)), categories=[0, 1],
+    ).numpy()
+    assert len(crossed) == 2
+
+
+# ----------------------------------------------------------- deform_conv2d
+X4 = RNG.randn(2, 4, 9, 9).astype(np.float32)
+W4 = RNG.randn(6, 4, 3, 3).astype(np.float32)
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    offset = np.zeros((2, 18, 9, 9), np.float32)
+    bias = RNG.randn(6).astype(np.float32)
+    mine = V.deform_conv2d(
+        T(X4), T(offset), T(W4), T(bias), stride=1, padding=1
+    ).numpy()
+    gold = torch.nn.functional.conv2d(
+        torch.tensor(X4), torch.tensor(W4), torch.tensor(bias), padding=1
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_integer_offset_is_shift():
+    offset = np.zeros((2, 18, 9, 9), np.float32)
+    offset[:, 1::2] = 1.0  # dx=+1 on every tap
+    mine = V.deform_conv2d(
+        T(X4), T(offset), T(W4), None, stride=1, padding=1
+    ).numpy()
+    xs = np.zeros_like(X4)
+    xs[..., :-1] = X4[..., 1:]
+    gold = torch.nn.functional.conv2d(
+        torch.tensor(xs), torch.tensor(W4), None, padding=1
+    ).numpy()
+    np.testing.assert_allclose(
+        mine[..., 1:], gold[..., 1:], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_deform_conv2d_groups_stride_and_mask():
+    wgt_g = RNG.randn(6, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((2, 18, 9, 9), np.float32)
+    mine = V.deform_conv2d(
+        T(X4), T(offset), T(wgt_g), None, stride=1, padding=1, groups=2
+    ).numpy()
+    gold = torch.nn.functional.conv2d(
+        torch.tensor(X4), torch.tensor(wgt_g), None, padding=1, groups=2
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+    off6 = np.zeros((2, 36, 6, 6), np.float32)
+    mine2 = V.deform_conv2d(
+        T(X4), T(off6), T(W4), None, stride=2, padding=2,
+        deformable_groups=2,
+    ).numpy()
+    gold2 = torch.nn.functional.conv2d(
+        torch.tensor(X4), torch.tensor(W4), None, stride=2, padding=2
+    ).numpy()
+    np.testing.assert_allclose(mine2, gold2, rtol=1e-4, atol=1e-4)
+    mask = np.ones((2, 9, 9, 9), np.float32)
+    mine3 = V.deform_conv2d(
+        T(X4), T(offset), T(W4), None, stride=1, padding=1, mask=T(mask)
+    ).numpy()
+    gold3 = torch.nn.functional.conv2d(
+        torch.tensor(X4), torch.tensor(W4), None, padding=1
+    ).numpy()
+    np.testing.assert_allclose(mine3, gold3, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_grads():
+    layer = V.DeformConv2D(4, 6, 3, padding=1)
+    offset = np.zeros((2, 18, 9, 9), np.float32)
+    out = layer(T(X4), T(offset))
+    assert tuple(out.shape) == (2, 6, 9, 9)
+    xt = T(X4)
+    xt.stop_gradient = False
+    ot = T(offset + 0.3)
+    ot.stop_gradient = False
+    V.deform_conv2d(xt, ot, T(W4), None, stride=1, padding=1).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+    assert np.abs(ot.grad.numpy()).sum() > 0
+
+
+# ------------------------------------------------------------ roi ops
+def test_roi_pool_numpy_oracle():
+    feat = RNG.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 7, 7], [2, 2, 6, 6]], np.float32)
+    mine = V.roi_pool(T(feat), T(rois), [2], 2).numpy()
+
+    def oracle(fm, roi, out):
+        x1, y1, x2, y2 = [int(round(v)) for v in roi]
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        res = np.zeros((fm.shape[0], out, out), np.float32)
+        for py in range(out):
+            for px in range(out):
+                hs = max(int(np.floor(y1 + py * rh / out)), 0)
+                he = min(int(np.ceil(y1 + (py + 1) * rh / out)), 8)
+                ws = max(int(np.floor(x1 + px * rw / out)), 0)
+                we = min(int(np.ceil(x1 + (px + 1) * rw / out)), 8)
+                if he > hs and we > ws:
+                    res[:, py, px] = fm[:, hs:he, ws:we].max(axis=(1, 2))
+        return res
+
+    gold = np.stack([oracle(feat[0], r, 2) for r in rois])
+    np.testing.assert_allclose(mine, gold, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_align_constant_and_ramp():
+    const = np.full((1, 1, 8, 8), 3.5, np.float32)
+    out = V.roi_align(
+        T(const), T(np.array([[1, 1, 6, 6]], np.float32)), [1], 2
+    ).numpy()
+    np.testing.assert_allclose(out, 3.5, rtol=1e-5)
+    ramp = np.broadcast_to(
+        np.arange(8, dtype=np.float32)[None, None, None, :], (1, 1, 8, 8)
+    ).copy()
+    out = V.roi_align(
+        T(ramp), T(np.array([[2, 2, 6, 6]], np.float32)), [1], 2,
+        sampling_ratio=2,
+    ).numpy()
+    # f(x)=x is reproduced exactly by bilinear sampling: bin averages
+    # land at x = 2.5 / 4.5 for an aligned [1.5, 5.5] window
+    np.testing.assert_allclose(
+        out[0, 0], [[2.5, 4.5], [2.5, 4.5]], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_box_coder_roundtrip():
+    prior = RNG.rand(10, 4).astype(np.float32)
+    prior[:, 2:] += prior[:, :2] + 0.2
+    target = RNG.rand(10, 4).astype(np.float32)
+    target[:, 2:] += target[:, :2] + 0.2
+    var = np.tile(np.array([0.1, 0.1, 0.2, 0.2], np.float32), (10, 1))
+    code = V.box_coder(T(prior), T(var), T(target))
+    dec = V.box_coder(
+        T(prior), T(var), T(code.numpy()[None]),
+        code_type="decode_center_size", axis=1,
+    ).numpy()
+    np.testing.assert_allclose(dec[0], target, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        V.box_coder(T(prior), T(var), T(target), code_type="bogus")
+
+
+def test_nms_categories_negative_coords():
+    boxes = np.array([[0, 0, 5, 5], [-20, -20, 5, 5]], np.float32)
+    scores = np.array([0.5, 0.9], np.float32)
+    kept = V.nms(
+        T(boxes), 0.1, T(scores),
+        category_idxs=T(np.array([0, 1], np.int64)), categories=[0, 1],
+    ).numpy()
+    assert len(kept) == 2  # different categories never cross-suppress
+
+
+def test_istft_window_length_validation():
+    spec = paddle.signal.stft(T(SIG), n_fft=128, hop_length=64, window=T(WIN))
+    with pytest.raises(ValueError):
+        paddle.signal.istft(
+            spec, n_fft=128, hop_length=64, window=T(WIN[:100])
+        )
