@@ -17,6 +17,7 @@ from .dataset import (  # noqa: F401
     random_split,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import WorkerInfo, get_worker_info  # noqa: F401
 from .sampler import (  # noqa: F401
     BatchSampler,
     DistributedBatchSampler,

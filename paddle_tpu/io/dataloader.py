@@ -206,6 +206,11 @@ class DataLoader:
             "import importlib.util, sys; "
             f"spec = importlib.util.spec_from_file_location('ptw', {worker_py!r}); "
             "m = importlib.util.module_from_spec(spec); "
+            "sys.modules['ptw'] = m; "
+            # alias under the package name so a dataset's
+            # `from paddle_tpu.io import get_worker_info` resolves to the
+            # instance whose _WORKER_INFO worker_loop installs
+            "sys.modules['paddle_tpu.io.worker'] = m; "
             "spec.loader.exec_module(m); m.spawn_main()"
         )
         # child env: forward the parent's sys.path so the pickled
@@ -244,7 +249,7 @@ class DataLoader:
                         inner = pickle.dumps(
                             (rings[i].name.decode(), self.dataset,
                              worker_collate, per_worker[i], i,
-                             self.worker_init_fn),
+                             self.worker_init_fn, w),
                             protocol=pickle.HIGHEST_PROTOCOL,
                         )
                         pickle.dump((main_script, inner), pf)

@@ -183,8 +183,32 @@ def spawn_main():
     worker_loop(*pickle.loads(blob))
 
 
+class WorkerInfo:
+    """paddle.io.get_worker_info() payload (reference:
+    python/paddle/io/dataloader/worker.py WorkerInfo — unverified)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (
+            f"WorkerInfo(id={self.id}, num_workers={self.num_workers})"
+        )
+
+
+_WORKER_INFO = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: that worker's WorkerInfo
+    (id / num_workers / dataset); in the main process: None."""
+    return _WORKER_INFO
+
+
 def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
-                worker_init_fn=None):
+                worker_init_fn=None, num_workers=None):
     """Worker-process entry: fetch assigned batches in order, write to
     the per-worker ring, close the ring when done (or on error, after
     shipping the exception). NOTHING may escape this function — it
@@ -201,6 +225,8 @@ def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
         # jax is multithreaded); the parent waits for this record with a
         # timeout and falls back to the thread pool if it never arrives
         ring.write(b"HELLO")
+        global _WORKER_INFO
+        _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset)
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         for indices in index_batches:

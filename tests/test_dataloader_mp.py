@@ -221,3 +221,28 @@ def test_unpicklable_collate_falls_back_to_threads():
     out = list(dl)
     assert len(out) == 2
     np.testing.assert_array_equal(out[0][0], ArrayDataset(n=8).x[:4])
+
+
+class WorkerInfoDataset(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        from paddle_tpu.io import get_worker_info
+
+        info = get_worker_info()
+        wid = -1 if info is None else info.id
+        nw = -1 if info is None else info.num_workers
+        return np.array([i, wid, nw], dtype=np.int64)
+
+
+def test_get_worker_info_inside_workers():
+    assert paddle.io.get_worker_info() is None  # main process
+    dl = DataLoader(
+        WorkerInfoDataset(), batch_size=4, num_workers=2, shuffle=False
+    )
+    rows = np.concatenate([np.asarray(b.numpy()) for b in dl])
+    assert rows[:, 0].tolist() == list(range(16))
+    assert set(rows[:, 1].tolist()) == {0, 1}
+    assert set(rows[:, 2].tolist()) == {2}
+    assert paddle.io.get_worker_info() is None  # still None afterwards
