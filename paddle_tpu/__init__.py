@@ -29,10 +29,12 @@ from .core.dtypes import (
     bool_,
     complex64,
     complex128,
+    finfo,
     float16,
     float32,
     float64,
     get_default_dtype,
+    iinfo,
     int8,
     int16,
     int32,
@@ -130,9 +132,13 @@ from . import jit  # noqa: E402
 from . import profiler  # noqa: E402
 from . import utils  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
+from . import audio  # noqa: E402
 from . import distribution  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
+from . import text  # noqa: E402
+from . import version  # noqa: E402
+from .hapi.summary import flops, summary  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import models  # noqa: E402

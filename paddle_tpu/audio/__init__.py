@@ -1,0 +1,4 @@
+"""paddle.audio namespace (python/paddle/audio/ parity — unverified):
+feature layers over signal.stft, mel/dct functional helpers, WAV io."""
+from . import backends, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401

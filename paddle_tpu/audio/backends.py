@@ -1,0 +1,78 @@
+"""paddle.audio load/save/info over stdlib ``wave`` (reference:
+python/paddle/audio/backends/ — unverified; the reference shells out to
+soundfile/wave backends, WAV-PCM is the common denominator here)."""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (
+            f"AudioInfo(sample_rate={self.sample_rate}, "
+            f"num_samples={self.num_samples}, "
+            f"num_channels={self.num_channels}, "
+            f"bits_per_sample={self.bits_per_sample})"
+        )
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def info(filepath):
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(
+            w.getframerate(), w.getnframes(), w.getnchannels(),
+            w.getsampwidth() * 8,
+        )
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else w.getnframes() - frame_offset
+        raw = w.readframes(n)
+    data = np.frombuffer(raw, dtype=_WIDTH_DTYPE[width]).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.float32) / 128.0 - 1.0
+    elif normalize:
+        data = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    arr = data.T if channels_first else data
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    data = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if data.ndim == 1:
+        data = data[None, :]
+    if channels_first:
+        data = data.T  # -> [T, C]
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    pcm = np.clip(np.round(data * scale), -scale - 1, scale).astype(
+        np.int16 if bits_per_sample == 16 else np.int32
+    )
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(pcm.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())

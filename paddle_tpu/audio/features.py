@@ -1,0 +1,106 @@
+"""paddle.audio.features (python/paddle/audio/features/layers.py parity —
+unverified): Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC as
+nn.Layers over signal.stft. Filterbank + DCT matrices are precomputed
+numpy constants baked into the jitted program."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from ..ops.math import matmul
+from ..ops.manipulation import transpose
+from .functional import (
+    compute_fbank_matrix,
+    create_dct,
+    get_window,
+    power_to_db,
+)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        from ..signal import stft
+
+        spec = stft(
+            x, self.n_fft, self.hop_length, self.win_length, self.window,
+            center=self.center, pad_mode=self.pad_mode,
+        )
+        from ..ops.math import abs as _abs
+
+        mag = _abs(spec)
+        if self.power == 1.0:
+            return mag
+        return mag ** self.power
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center, pad_mode,
+            dtype,
+        )
+        self.n_mels = n_mels
+        self.fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+        )
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, time]
+        return matmul(self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(
+            self._mel(x), self.ref_value, self.amin, self.top_db
+        )
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype,
+        )
+        self.dct = create_dct(n_mfcc, n_mels, dtype=dtype)  # [n_mels,n_mfcc]
+
+    def forward(self, x):
+        logmel = self._log_mel(x)  # [..., n_mels, time]
+        nd = len(logmel.shape)
+        perm = list(range(nd - 2)) + [nd - 1, nd - 2]
+        return transpose(
+            matmul(transpose(logmel, perm), self.dct), perm
+        )  # [..., n_mfcc, time]

@@ -1,0 +1,155 @@
+"""paddle.audio.functional (python/paddle/audio/functional/ parity —
+unverified): mel-scale conversions, filterbanks, window helpers, dB.
+
+Pure numpy for the static precomputations (filterbank matrices, DCT —
+built once, shipped into the jitted feature extractors as constants);
+the per-signal math runs through signal.stft/dispatch.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, (np.ndarray, Tensor))
+    f = np.asarray(
+        freq.numpy() if isinstance(freq, Tensor) else freq, np.float64
+    )
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        # Slaney: linear below 1 kHz, log above
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(
+            f >= min_log_hz,
+            min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+            mel,
+        )
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (np.ndarray, Tensor))
+    m = np.asarray(
+        mel.numpy() if isinstance(mel, Tensor) else mel, np.float64
+    )
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = np.where(
+            m >= min_log_mel,
+            min_log_hz * np.exp(logstep * (m - min_log_mel)),
+            f,
+        )
+    return float(f) if scalar else f
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    mels = np.linspace(
+        hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels
+    )
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk), dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.asarray(
+        np.linspace(0, sr / 2, 1 + n_fft // 2), dtype
+    ))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    melpts = np.linspace(
+        hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels + 2
+    )
+    hzpts = np.asarray(mel_to_hz(melpts, htk))
+    fdiff = np.diff(hzpts)
+    ramps = hzpts[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (hzpts[2:n_mels + 2] - hzpts[:n_mels])
+        fb = fb * enorm[:, None]
+    return Tensor(jnp.asarray(fb, dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    return dispatch.apply(
+        "power_to_db", _power_to_db_fn, (spect,),
+        {"ref_value": float(ref_value), "amin": float(amin),
+         "top_db": None if top_db is None else float(top_db)},
+    )
+
+
+def _power_to_db_fn(x, *, ref_value, amin, top_db):
+    log_spec = 10.0 * (
+        jnp.log10(jnp.maximum(x, amin))
+        - jnp.log10(jnp.maximum(jnp.asarray(ref_value, x.dtype), amin))
+    )
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix (torchaudio/reference layout)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)  # [n_mfcc, n_mels]
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T, dtype))
+
+
+_WINDOWS = {
+    "hamming": np.hamming,
+    "hann": np.hanning,
+    "blackman": np.blackman,
+    "bartlett": np.bartlett,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    if name == "gaussian":
+        std = params[0] if params else 7.0
+        n = np.arange(win_length) - (win_length - 1) / 2
+        w = np.exp(-0.5 * (n / std) ** 2)
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(win_length)
+    elif name in _WINDOWS:
+        # periodic (fftbins) windows drop the symmetric endpoint
+        w = (
+            _WINDOWS[name](win_length + 1)[:-1] if fftbins
+            else _WINDOWS[name](win_length)
+        )
+    else:
+        raise ValueError(f"get_window: unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, dtype))

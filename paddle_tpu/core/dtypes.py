@@ -108,3 +108,40 @@ def is_differentiable_dtype(dtype) -> bool:
 def is_integer_dtype(dtype) -> bool:
     d = np.dtype(convert_dtype(dtype))
     return jnp.issubdtype(d, jnp.integer)
+
+
+class _FInfo:
+    """paddle.finfo parity (float type limits)."""
+
+    def __init__(self, dtype):
+        # jnp.finfo handles bfloat16/float8 via ml_dtypes, numpy the rest
+        import jax.numpy as jnp
+
+        info = jnp.finfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.bits = info.bits
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+
+
+class _IInfo:
+    """paddle.iinfo parity (integer type limits)."""
+
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(convert_dtype(dtype)))
+        self.dtype = str(info.dtype)
+        self.bits = info.bits
+        self.min = int(info.min)
+        self.max = int(info.max)
+
+
+def finfo(dtype):
+    return _FInfo(dtype)
+
+
+def iinfo(dtype):
+    return _IInfo(dtype)

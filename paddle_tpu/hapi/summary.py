@@ -1,0 +1,162 @@
+"""paddle.summary / paddle.flops (reference: python/paddle/hapi/
+{model_summary,dynamic_flops}.py — unverified).
+
+One real forward pass on zeros with forward-post hooks records per-layer
+output shapes; FLOPs use the standard per-layer formulas for the common
+layer types (matmul-dominated counts — the quantities the MXU executes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _make_input(size, dtype):
+    if isinstance(size, (list, tuple)) and size and isinstance(
+        size[0], (list, tuple)
+    ):
+        return [_make_input(s, dtype) for s in size]
+    shape = [int(1 if s is None else s) for s in size]
+    return Tensor(jnp.zeros(shape, dtype or jnp.float32))
+
+
+def _shapes(out):
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (list, tuple)) and out:
+        return _shapes(out[0])
+    return []
+
+
+def _num_params(layer):
+    return sum(
+        int(np.prod(p.shape)) for p in layer.parameters(include_sublayers=False)
+    ) if hasattr(layer, "parameters") else 0
+
+
+def _layer_flops(layer, inputs, output):
+    """Per-call FLOPs for the standard layer types (multiply-adds x2)."""
+    name = type(layer).__name__
+    out_shape = _shapes(output)
+    out_elems = int(np.prod(out_shape)) if out_shape else 0
+    if name == "Linear":
+        in_f = int(layer.weight.shape[0])
+        return 2 * out_elems * in_f
+    if name.startswith("Conv") and hasattr(layer, "weight"):
+        w = layer.weight.shape  # [out_c, in_c/groups, *k]
+        per_out = 2 * int(np.prod(w[1:]))
+        return out_elems * per_out
+    if name in ("BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "LayerNorm",
+                "GroupNorm", "InstanceNorm2D"):
+        return 2 * out_elems
+    if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Hardswish",
+                "Hardsigmoid", "Softmax", "Swish", "SiLU"):
+        return out_elems
+    if name.endswith("Pool1D") or name.endswith("Pool2D") or name.endswith(
+        "Pool3D"
+    ):
+        return out_elems
+    return 0
+
+
+def _walk(net, x, want_flops):
+    rows = []
+    hooks = []
+
+    def make_hook(lname):
+        def hook(layer, inputs, output):
+            rows.append({
+                "name": lname,
+                "type": type(layer).__name__,
+                "output_shape": _shapes(output),
+                "params": _num_params(layer),
+                "flops": (
+                    _layer_flops(layer, inputs, output) if want_flops else 0
+                ),
+            })
+
+        return hook
+
+    for lname, sub in net.named_sublayers():
+        if isinstance(sub, Layer) and not list(sub.sublayers()):
+            hooks.append(sub.register_forward_post_hook(make_hook(lname)))
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        if isinstance(x, list):
+            net(*x)
+        else:
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    return rows
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Per-layer table of output shapes + param counts; returns the
+    {'total_params', 'trainable_params'} dict like the reference."""
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary: provide input_size or input")
+        input = _make_input(input_size, dtypes)
+    rows = _walk(net, input, want_flops=False)
+    width = max([len(r["name"]) + len(r["type"]) for r in rows] + [20]) + 4
+    lines = [
+        "-" * (width + 40),
+        f"{'Layer (type)':<{width}}{'Output Shape':<22}{'Param #':>12}",
+        "=" * (width + 40),
+    ]
+    for r in rows:
+        label = f"{r['name']} ({r['type']})"
+        lines.append(
+            f"{label:<{width}}{str(r['output_shape']):<22}"
+            f"{r['params']:>12,}"
+        )
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(
+        np.prod(p.shape) for p in net.parameters() if not p.stop_gradient
+    ))
+    lines += [
+        "=" * (width + 40),
+        f"Total params: {total:,}",
+        f"Trainable params: {trainable:,}",
+        f"Non-trainable params: {total - trainable:,}",
+        "-" * (width + 40),
+    ]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Total forward FLOPs (2x multiply-adds) for one input batch."""
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("flops: provide input_size or inputs")
+        inputs = _make_input(input_size, None)
+    rows = _walk(net, inputs, want_flops=True)
+    if custom_ops:
+        by_type = {}
+        for lname, sub in net.named_sublayers():
+            by_type[lname] = sub
+        for r in rows:
+            layer = by_type.get(r["name"])
+            fn = custom_ops.get(type(layer)) if layer is not None else None
+            if fn is not None:
+                r["flops"] = int(fn(layer, None, None))
+    total = int(sum(r["flops"] for r in rows))
+    if print_detail:
+        for r in rows:
+            print(
+                f"{r['name']:<40}{r['type']:<18}"
+                f"{str(r['output_shape']):<22}{r['flops']:>16,}"
+            )
+        print(f"Total FLOPs: {total:,}")
+    return total

@@ -152,6 +152,18 @@ class DataLoader:
         sentinel = object()
         q: queue.Queue = queue.Queue(self.prefetch_factor * self.num_workers)
         pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        # reference contract: get_worker_info() is non-None whenever
+        # num_workers>0. The thread pool shares one process, so expose a
+        # single logical worker (id 0) for the iteration's duration.
+        from . import worker as worker_mod
+
+        if self.num_workers > 0 and worker_mod._WORKER_INFO is None:
+            worker_mod._WORKER_INFO = worker_mod.WorkerInfo(
+                0, self.num_workers, self.dataset, 0
+            )
+            reset_info = True
+        else:
+            reset_info = False
 
         def producer():
             try:
@@ -177,6 +189,8 @@ class DataLoader:
                 yield item.result()
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
+            if reset_info:
+                worker_mod._WORKER_INFO = None
 
     def _iter_multiprocess(self):
         """Spawned workers + per-worker shm rings (see module docstring).
@@ -195,6 +209,10 @@ class DataLoader:
         ring_mb = int(os.environ.get("FLAGS_dataloader_shm_mb", 64))
         rings, procs = [], []
         per_worker = [batches[i::w] for i in range(w)]
+        # base for WorkerInfo.seed (reference: per-epoch base + worker id)
+        import random as _random
+
+        base_seed = _random.randint(0, 2 ** 31 - 1)
         # numpy-producing collate in the worker; Tensor conversion here
         worker_collate = self._user_collate
         timeout_ms = int(self.timeout * 1000) if self.timeout > 0 else -1
@@ -249,7 +267,7 @@ class DataLoader:
                         inner = pickle.dumps(
                             (rings[i].name.decode(), self.dataset,
                              worker_collate, per_worker[i], i,
-                             self.worker_init_fn, w),
+                             self.worker_init_fn, w, base_seed),
                             protocol=pickle.HIGHEST_PROTOCOL,
                         )
                         pickle.dump((main_script, inner), pf)

@@ -153,8 +153,9 @@ def _load_shmring():
 
 def spawn_main():
     """Entry point of a SPAWNED worker: argv[1] is a pickle file holding
-    (main_script, ring_name, dataset, collate_fn, index_batches,
-    worker_id, worker_init_fn).
+    (main_script, inner) where inner unpickles to worker_loop's
+    positional args: (ring_name, dataset, collate_fn, index_batches,
+    worker_id, worker_init_fn, num_workers, base_seed).
 
     Datasets/collate_fns defined in the training script itself pickle as
     ``__main__.X``; like multiprocessing's spawn, the parent's main
@@ -185,16 +186,20 @@ def spawn_main():
 
 class WorkerInfo:
     """paddle.io.get_worker_info() payload (reference:
-    python/paddle/io/dataloader/worker.py WorkerInfo — unverified)."""
+    python/paddle/io/dataloader/worker.py WorkerInfo — unverified).
+    ``seed`` follows the reference contract: base_seed + worker id, for
+    per-worker RNG seeding in datasets/worker_init_fn."""
 
-    def __init__(self, id, num_workers, dataset):
+    def __init__(self, id, num_workers, dataset, seed=None):
         self.id = id
         self.num_workers = num_workers
         self.dataset = dataset
+        self.seed = (0 if seed is None else seed) + id
 
     def __repr__(self):
         return (
-            f"WorkerInfo(id={self.id}, num_workers={self.num_workers})"
+            f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+            f"seed={self.seed})"
         )
 
 
@@ -208,7 +213,7 @@ def get_worker_info():
 
 
 def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
-                worker_init_fn=None, num_workers=None):
+                worker_init_fn=None, num_workers=None, base_seed=None):
     """Worker-process entry: fetch assigned batches in order, write to
     the per-worker ring, close the ring when done (or on error, after
     shipping the exception). NOTHING may escape this function — it
@@ -226,7 +231,7 @@ def worker_loop(ring_name, dataset, collate_fn, index_batches, worker_id,
         # timeout and falls back to the thread pool if it never arrives
         ring.write(b"HELLO")
         global _WORKER_INFO
-        _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset)
+        _WORKER_INFO = WorkerInfo(worker_id, num_workers, dataset, base_seed)
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
         for indices in index_batches:

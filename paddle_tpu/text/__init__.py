@@ -1,0 +1,12 @@
+"""paddle.text namespace (python/paddle/text/__init__.py parity —
+unverified): corpora + Viterbi decoding."""
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401

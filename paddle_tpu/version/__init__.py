@@ -1,0 +1,29 @@
+"""paddle.version parity (reference: generated python/paddle/version/
+__init__.py — unverified). The TPU rebuild reports its own version and
+the reference major.minor it tracks for API-surface parity."""
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "tpu-native-rebuild"
+istaged = False
+with_gpu = "OFF"
+with_xpu = "OFF"
+xpu_xccl = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -246,3 +246,32 @@ def test_get_worker_info_inside_workers():
     assert set(rows[:, 1].tolist()) == {0, 1}
     assert set(rows[:, 2].tolist()) == {2}
     assert paddle.io.get_worker_info() is None  # still None afterwards
+
+
+class SeedInfoDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        from paddle_tpu.io import get_worker_info
+
+        info = get_worker_info()
+        return np.array([i, info.seed if info else -1], dtype=np.int64)
+
+
+def test_worker_info_seed_and_thread_fallback():
+    # spawned workers expose a seed
+    dl = DataLoader(SeedInfoDataset(), batch_size=4, num_workers=2,
+                    shuffle=False)
+    rows = np.concatenate([np.asarray(b.numpy()) for b in dl])
+    assert (rows[:, 1] >= 0).all()
+    # thread-pool path (unpicklable collate forces fallback) still gives
+    # a non-None WorkerInfo when num_workers>0
+    unpicklable = lambda samples: np.stack([s for s in samples])  # noqa: E731
+    dl2 = DataLoader(SeedInfoDataset(), batch_size=4, num_workers=2,
+                     shuffle=False, collate_fn=unpicklable)
+    rows2 = np.concatenate([
+        np.asarray(b.numpy() if hasattr(b, "numpy") else b) for b in dl2
+    ])
+    assert (rows2[:, 1] >= 0).all()
+    assert paddle.io.get_worker_info() is None

@@ -1,0 +1,174 @@
+"""paddle.text (viterbi vs brute force, dataset contracts), paddle.audio
+(mel/dct math, feature pipeline, WAV io), paddle.summary/flops,
+iinfo/finfo/version."""
+import contextlib
+import io as pyio
+import itertools
+import math
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(4)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+# ------------------------------------------------------------------ viterbi
+def test_viterbi_matches_brute_force():
+    B, T_, N = 2, 5, 4
+    pot = RNG.randn(B, T_, N).astype(np.float32)
+    trans = RNG.randn(N, N).astype(np.float32)
+    lens = np.array([5, 3], np.int64)
+    sc, paths = paddle.text.viterbi_decode(
+        T(pot), T(trans), T(lens), include_bos_eos_tag=False
+    )
+    for b in range(B):
+        best, arg = -1e30, None
+        L = int(lens[b])
+        for seq in itertools.product(range(N), repeat=L):
+            s = pot[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            if s > best:
+                best, arg = s, seq
+        assert np.isclose(sc.numpy()[b], best, atol=1e-4)
+        assert paths.numpy()[b, :L].tolist() == list(arg)
+
+
+def test_viterbi_bos_eos_and_decoder_class():
+    B, T_, N = 2, 4, 5  # last two tags are BOS/EOS
+    pot = RNG.randn(B, T_, N).astype(np.float32)
+    trans = RNG.randn(N, N).astype(np.float32)
+    lens = np.array([4, 4], np.int64)
+    dec = paddle.text.ViterbiDecoder(T(trans))
+    sc, paths = dec(T(pot), T(lens))
+    assert tuple(sc.shape) == (B,) and tuple(paths.shape) == (B, T_)
+    # brute force incl. bos/eos transitions
+    bos, eos = N - 2, N - 1
+    for b in range(B):
+        best = -1e30
+        for seq in itertools.product(range(N), repeat=T_):
+            s = trans[bos, seq[0]] + pot[b, 0, seq[0]]
+            for t in range(1, T_):
+                s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            s += trans[seq[-1], eos]
+            best = max(best, s)
+        assert np.isclose(sc.numpy()[b], best, atol=1e-4)
+
+
+# ----------------------------------------------------------------- datasets
+def test_text_datasets_contracts():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        uci = paddle.text.UCIHousing(mode="train")
+        x, y = uci[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert 0.0 <= x.min() and x.max() <= 1.0
+        test = paddle.text.UCIHousing(mode="test")
+        assert len(uci) + len(test) == 506
+        imdb = paddle.text.Imdb(mode="train")
+        doc, lbl = imdb[0]
+        assert doc.dtype == np.int64 and int(lbl) in (0, 1)
+        assert "<unk>" in imdb.word_idx
+        imik = paddle.text.Imikolov(window_size=5)
+        assert len(imik[0]) == 5
+        ml = paddle.text.Movielens()
+        row = ml[0]
+        assert len(row) == 8 and 1.0 <= float(row[-1]) <= 5.0
+        src, trg_in, trg_next = paddle.text.WMT14()[0]
+        assert trg_in[0] == 0 and trg_next[-1] == 1  # BOS / EOS
+        assert len(trg_in) == len(trg_next)
+
+
+# -------------------------------------------------------------------- audio
+def test_mel_scale_conversions():
+    assert abs(paddle.audio.functional.hz_to_mel(1000.0) - 15.0) < 1e-6
+    assert abs(paddle.audio.functional.mel_to_hz(15.0) - 1000.0) < 1e-3
+    htk = paddle.audio.functional.hz_to_mel(1000.0, htk=True)
+    assert abs(htk - 2595 * math.log10(1 + 1000 / 700)) < 1e-3
+    freqs = paddle.audio.functional.mel_frequencies(10, 0.0, 4000.0)
+    f = freqs.numpy()
+    assert f[0] == pytest.approx(0.0, abs=1e-3) and np.all(np.diff(f) > 0)
+
+
+def test_fbank_and_dct():
+    fb = paddle.audio.functional.compute_fbank_matrix(16000, 512, 40).numpy()
+    assert fb.shape == (40, 257) and fb.min() >= 0
+    # every filter has support
+    assert (fb.sum(1) > 0).all()
+    d = paddle.audio.functional.create_dct(13, 40).numpy()
+    np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+def test_audio_feature_pipeline_shapes():
+    sig = T(np.sin(np.linspace(0, 100, 4000)).astype(np.float32)[None])
+    spec = paddle.audio.features.Spectrogram(n_fft=256)(sig)
+    assert tuple(spec.shape) == (1, 129, 63)
+    mel = paddle.audio.features.MelSpectrogram(
+        sr=8000, n_fft=256, n_mels=32
+    )(sig)
+    assert tuple(mel.shape) == (1, 32, 63)
+    mfcc = paddle.audio.features.MFCC(
+        sr=8000, n_mfcc=13, n_fft=256, n_mels=32
+    )(sig)
+    assert tuple(mfcc.shape) == (1, 13, 63)
+    assert np.isfinite(mfcc.numpy()).all()
+
+
+def test_power_to_db_clamps_to_top_db():
+    x = T(np.array([1.0, 1e-12], np.float32))
+    db = paddle.audio.functional.power_to_db(x, top_db=80.0).numpy()
+    assert db[0] == pytest.approx(0.0, abs=1e-4)
+    assert db[1] == pytest.approx(-80.0, abs=1e-4)
+
+
+def test_wav_io_roundtrip():
+    wav = (np.sin(np.linspace(0, 50, 1600)) * 0.5).astype(np.float32)[None]
+    fn = tempfile.mktemp(suffix=".wav")
+    paddle.audio.save(fn, T(wav), 16000)
+    back, sr = paddle.audio.load(fn)
+    assert sr == 16000
+    assert np.abs(back.numpy() - wav).max() < 1e-3
+    info = paddle.audio.info(fn)
+    assert info.sample_rate == 16000 and info.num_channels == 1
+    assert info.num_samples == 1600
+
+
+# ---------------------------------------------------------- summary / flops
+def test_summary_and_flops():
+    net = paddle.vision.models.LeNet()
+    buf = pyio.StringIO()
+    with contextlib.redirect_stdout(buf):
+        stats = paddle.summary(net, (1, 1, 28, 28))
+    text = buf.getvalue()
+    assert stats["total_params"] == int(
+        sum(np.prod(p.shape) for p in net.parameters())
+    )
+    assert "Total params" in text and "Conv2D" in text
+    fl = paddle.flops(net, (1, 1, 28, 28))
+    # conv1: 2*6*(1*3*3)*28*28 plus the rest — must exceed a trivial bound
+    assert fl > 100_000
+    # flops scale ~linearly with batch
+    fl2 = paddle.flops(net, (2, 1, 28, 28))
+    assert fl2 == pytest.approx(2 * fl, rel=0.01)
+
+
+def test_iinfo_finfo_version():
+    fi = paddle.finfo("float32")
+    assert fi.bits == 32 and fi.eps > 0 and fi.max > 1e38
+    fb = paddle.finfo(paddle.bfloat16)
+    assert fb.bits == 16
+    ii = paddle.iinfo("int16")
+    assert ii.min == -32768 and ii.max == 32767
+    assert paddle.version.full_version
+    assert paddle.version.cuda() is False
