@@ -61,7 +61,14 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
 
 
 def save(filepath, src, sample_rate, channels_first=True,
-         encoding="PCM_16", bits_per_sample=16):
+         encoding="PCM_S", bits_per_sample=16):
+    if bits_per_sample not in (16, 32):
+        raise ValueError(
+            "audio.save supports PCM bits_per_sample 16 or 32, got "
+            f"{bits_per_sample}"
+        )
+    if encoding not in ("PCM_S", "PCM_16", "PCM_32"):
+        raise ValueError(f"audio.save: unsupported encoding {encoding!r}")
     data = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
     if data.ndim == 1:
         data = data[None, :]

@@ -59,11 +59,102 @@ class DenseTable:
                 self.value -= self.lr * g
 
 
+class SparseTable:
+    """Embedding-style table: rows keyed by int64 feature id, created
+    lazily on first access (the reference's sparse PS table contract for
+    unbounded id spaces). Per-row SGD or Adagrad (the recommender
+    default); duplicate ids in one push accumulate sequentially.
+    Row init is deterministic in (table seed, id) so every
+    trainer/restart sees identical initial embeddings."""
+
+    def __init__(self, name, dim, optimizer="adagrad", lr=0.05,
+                 initializer="uniform", init_range=0.01, seed=0, eps=1e-10):
+        self.name = name
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = lr
+        self.initializer = initializer
+        self.init_range = init_range
+        self.seed = int(seed)
+        self.eps = eps
+        self.rows = {}
+        self._acc = {}  # adagrad accumulators
+        self._lock = threading.Lock()
+
+    def _init_row(self, rid):
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        rng = np.random.RandomState(
+            (self.seed * 1000003 + int(rid)) % (2 ** 32)
+        )
+        return (
+            (rng.rand(self.dim).astype(np.float32) * 2 - 1)
+            * self.init_range
+        )
+
+    def pull(self, ids):
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                if rid not in self.rows:
+                    self.rows[rid] = self._init_row(rid)
+                out[i] = self.rows[rid]
+            return out
+
+    def push_grad(self, ids, grads):
+        g = np.asarray(grads, np.float32)
+        with self._lock:
+            for i, rid in enumerate(ids):
+                rid = int(rid)
+                row = self.rows.setdefault(rid, self._init_row(rid))
+                if self.optimizer == "adagrad":
+                    acc = self._acc.setdefault(
+                        rid, np.zeros(self.dim, np.float32)
+                    )
+                    acc += g[i] * g[i]
+                    row -= self.lr * g[i] / (np.sqrt(acc) + self.eps)
+                else:  # async SGD
+                    row -= self.lr * g[i]
+
+    def state(self):
+        with self._lock:
+            ids = sorted(self.rows)
+            zero = np.zeros(self.dim, np.float32)
+            return {
+                "dim": self.dim, "optimizer": self.optimizer,
+                "lr": self.lr, "seed": self.seed,
+                "ids": np.array(ids, np.int64),
+                "rows": np.stack([self.rows[i] for i in ids])
+                if ids else np.zeros((0, self.dim), np.float32),
+                # adagrad accumulators are part of the training state:
+                # omitting them collapses/spikes the effective LR on resume
+                "acc": np.stack([self._acc.get(i, zero) for i in ids])
+                if ids else np.zeros((0, self.dim), np.float32),
+            }
+
+    def load_state(self, st):
+        with self._lock:
+            self.rows = {
+                int(i): np.asarray(r, np.float32)
+                for i, r in zip(st["ids"], st["rows"])
+            }
+            acc = st.get("acc")
+            if acc is not None:
+                self._acc = {
+                    int(i): np.asarray(a, np.float32)
+                    for i, a in zip(st["ids"], acc)
+                }
+            else:
+                self._acc = {}
+
+
 class ParameterServer:
     """Process-global table host (one per PSERVER process)."""
 
     def __init__(self):
         self.tables = {}
+        self.sparse_tables = {}
         self._stop = threading.Event()
         self._create_lock = threading.Lock()
         self._barriers = {}
@@ -74,6 +165,12 @@ class ParameterServer:
         with self._create_lock:
             if name not in self.tables:
                 self.tables[name] = DenseTable(name, value, **kw)
+        return name
+
+    def create_sparse(self, name, dim, **kw):
+        with self._create_lock:
+            if name not in self.sparse_tables:
+                self.sparse_tables[name] = SparseTable(name, dim, **kw)
         return name
 
 
@@ -102,6 +199,55 @@ def _ps_pull_many(names):
 def _ps_push_many(grads):
     for n, g in grads.items():
         _SERVER.tables[n].push_grad(g)
+    return True
+
+
+def _ps_create_sparse(name, dim, kw):
+    _SERVER.create_sparse(name, dim, **kw)
+    return True
+
+
+def _ps_pull_sparse(name, ids):
+    return _SERVER.sparse_tables[name].pull(ids)
+
+
+def _ps_push_sparse(name, ids, grads):
+    _SERVER.sparse_tables[name].push_grad(ids, grads)
+    return True
+
+
+def _ps_save(dirname, server_name):
+    """Server-side checkpoint: dense values + sparse row maps."""
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, f"{server_name}.npz")
+    payload = {}
+    for n, t in _SERVER.tables.items():
+        payload[f"dense:{n}"] = t.pull()
+    for n, t in _SERVER.sparse_tables.items():
+        st = t.state()
+        payload[f"sparse_ids:{n}"] = st["ids"]
+        payload[f"sparse_rows:{n}"] = st["rows"]
+        payload[f"sparse_acc:{n}"] = st["acc"]
+    np.savez(path, **payload)
+    return path
+
+
+def _ps_load(dirname, server_name):
+    path = os.path.join(dirname, f"{server_name}.npz")
+    data = np.load(path)
+    for key in data.files:
+        kind, name = key.split(":", 1)
+        if kind == "dense" and name in _SERVER.tables:
+            _SERVER.tables[name].value = data[key].copy()
+        elif kind == "sparse_ids" and name in _SERVER.sparse_tables:
+            _SERVER.sparse_tables[name].load_state({
+                "ids": data[key],
+                "rows": data[f"sparse_rows:{name}"],
+                "acc": (
+                    data[f"sparse_acc:{name}"]
+                    if f"sparse_acc:{name}" in data.files else None
+                ),
+            })
     return True
 
 
@@ -223,6 +369,37 @@ class PSContext:
         ]
         for f in futs:
             f.result()
+
+    def create_sparse_table(self, name, dim, optimizer="adagrad", lr=0.05,
+                            **kw):
+        rpc.rpc_sync(
+            _shard_of(name), _ps_create_sparse,
+            args=(name, int(dim), {"optimizer": optimizer, "lr": lr, **kw}),
+        )
+
+    def pull_sparse(self, name, ids):
+        """ids: int sequence -> [len(ids), dim] float32 rows."""
+        return rpc.rpc_sync(
+            _shard_of(name), _ps_pull_sparse,
+            args=(name, np.asarray(ids, np.int64)),
+        )
+
+    def push_sparse(self, name, ids, grads):
+        rpc.rpc_sync(
+            _shard_of(name), _ps_push_sparse,
+            args=(name, np.asarray(ids, np.int64),
+                  np.asarray(grads, np.float32)),
+        )
+
+    def save_persistables(self, dirname):
+        """fleet.save_persistables analog: every server snapshots its
+        shard (dense + sparse) under dirname."""
+        for s in _server_names():
+            rpc.rpc_sync(s, _ps_save, args=(dirname, s))
+
+    def load_persistables(self, dirname):
+        for s in _server_names():
+            rpc.rpc_sync(s, _ps_load, args=(dirname, s))
 
     def barrier(self, tag="default"):
         """Synchronize all trainers through server 0 (PS-mode analog of

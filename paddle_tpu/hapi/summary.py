@@ -19,7 +19,17 @@ def _make_input(size, dtype):
     if isinstance(size, (list, tuple)) and size and isinstance(
         size[0], (list, tuple)
     ):
+        # per-input dtype list (reference API shape) or one shared dtype
+        if isinstance(dtype, (list, tuple)):
+            if len(dtype) != len(size):
+                raise ValueError(
+                    f"summary: {len(size)} input sizes but {len(dtype)} "
+                    "dtypes"
+                )
+            return [_make_input(s, d) for s, d in zip(size, dtype)]
         return [_make_input(s, dtype) for s in size]
+    if isinstance(dtype, (list, tuple)):
+        dtype = dtype[0]
     shape = [int(1 if s is None else s) for s in size]
     return Tensor(jnp.zeros(shape, dtype or jnp.float32))
 
@@ -77,6 +87,8 @@ def _walk(net, x, want_flops):
                 "flops": (
                     _layer_flops(layer, inputs, output) if want_flops else 0
                 ),
+                "inputs": inputs,
+                "output": output,
             })
 
         return hook
@@ -143,14 +155,13 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
         inputs = _make_input(input_size, None)
     rows = _walk(net, inputs, want_flops=True)
     if custom_ops:
-        by_type = {}
-        for lname, sub in net.named_sublayers():
-            by_type[lname] = sub
+        by_name = dict(net.named_sublayers())
         for r in rows:
-            layer = by_type.get(r["name"])
+            layer = by_name.get(r["name"])
             fn = custom_ops.get(type(layer)) if layer is not None else None
             if fn is not None:
-                r["flops"] = int(fn(layer, None, None))
+                # reference count_op signature: fn(layer, inputs, output)
+                r["flops"] = int(fn(layer, r["inputs"], r["output"]))
     total = int(sum(r["flops"] for r in rows))
     if print_detail:
         for r in rows:

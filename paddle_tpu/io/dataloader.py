@@ -154,13 +154,19 @@ class DataLoader:
         pool = ThreadPoolExecutor(max_workers=self.num_workers)
         # reference contract: get_worker_info() is non-None whenever
         # num_workers>0. The thread pool shares one process, so expose a
-        # single logical worker (id 0) for the iteration's duration.
+        # single logical worker (id 0) for the iteration's duration;
+        # refcounted so nested/concurrent loader iterations don't clobber
+        # each other (last exit clears it). Approximation: the info is
+        # process-global, so the main thread also sees it mid-iteration.
         from . import worker as worker_mod
 
-        if self.num_workers > 0 and worker_mod._WORKER_INFO is None:
-            worker_mod._WORKER_INFO = worker_mod.WorkerInfo(
-                0, self.num_workers, self.dataset, 0
-            )
+        if self.num_workers > 0:
+            with worker_mod._FALLBACK_LOCK:
+                if worker_mod._FALLBACK_DEPTH[0] == 0:
+                    worker_mod._WORKER_INFO = worker_mod.WorkerInfo(
+                        0, self.num_workers, self.dataset, 0
+                    )
+                worker_mod._FALLBACK_DEPTH[0] += 1
             reset_info = True
         else:
             reset_info = False
@@ -190,7 +196,10 @@ class DataLoader:
         finally:
             pool.shutdown(wait=False, cancel_futures=True)
             if reset_info:
-                worker_mod._WORKER_INFO = None
+                with worker_mod._FALLBACK_LOCK:
+                    worker_mod._FALLBACK_DEPTH[0] -= 1
+                    if worker_mod._FALLBACK_DEPTH[0] == 0:
+                        worker_mod._WORKER_INFO = None
 
     def _iter_multiprocess(self):
         """Spawned workers + per-worker shm rings (see module docstring).

@@ -204,6 +204,11 @@ class WorkerInfo:
 
 
 _WORKER_INFO = None
+# thread-pool fallback refcount (see dataloader._iter_prefetch)
+import threading as _threading  # noqa: E402
+
+_FALLBACK_LOCK = _threading.Lock()
+_FALLBACK_DEPTH = [0]
 
 
 def get_worker_info():

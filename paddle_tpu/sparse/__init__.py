@@ -226,3 +226,169 @@ def relu(x, name=None):
             )
         )
     return Tensor(jnp.maximum(_val(x), 0))
+
+
+def subtract(x, y, name=None):
+    x, y = _coo(x), _coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        neg_y = jsparse.BCOO(
+            (-y._bcoo.data, y._bcoo.indices), shape=y._bcoo.shape
+        )
+        return SparseCooTensor((x._bcoo + neg_y).sum_duplicates())
+    return Tensor(_val(x) - _val(y))
+
+
+def divide(x, y, name=None):
+    """Elementwise; sparse / scalar keeps sparsity."""
+    x = _coo(x)
+    if isinstance(x, SparseCooTensor) and np.isscalar(y):
+        return SparseCooTensor(
+            jsparse.BCOO((x._bcoo.data / y, x._bcoo.indices),
+                         shape=x._bcoo.shape)
+        )
+    return Tensor(_val(x) / _val(y))
+
+
+def _value_op(name, fn):
+    """Zero-preserving value-wise op: applies to nonzeros only, exactly
+    the reference's sparse unary kernel contract."""
+
+    def op(x, name=None):
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(
+                x.crows, x.cols, fn(x.data), x.shape
+            )
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(
+                jsparse.BCOO((fn(x._bcoo.data), x._bcoo.indices),
+                             shape=x._bcoo.shape)
+            )
+        return Tensor(fn(_val(x)))
+
+    op.__name__ = name
+    return op
+
+
+sin = _value_op("sin", jnp.sin)
+tan = _value_op("tan", jnp.tan)
+asin = _value_op("asin", jnp.arcsin)
+atan = _value_op("atan", jnp.arctan)
+sinh = _value_op("sinh", jnp.sinh)
+tanh = _value_op("tanh", jnp.tanh)
+asinh = _value_op("asinh", jnp.arcsinh)
+atanh = _value_op("atanh", jnp.arctanh)
+sqrt = _value_op("sqrt", jnp.sqrt)
+square = _value_op("square", jnp.square)
+abs = _value_op("abs", jnp.abs)  # noqa: A001
+neg = _value_op("neg", jnp.negative)
+expm1 = _value_op("expm1", jnp.expm1)
+log1p = _value_op("log1p", jnp.log1p)
+deg2rad = _value_op("deg2rad", jnp.deg2rad)
+rad2deg = _value_op("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _value_op("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtypes import convert_dtype
+
+    x = _coo(x)
+    data, idx = x._bcoo.data, x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(convert_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+
+
+def transpose(x, perm, name=None):
+    x = _coo(x)
+    perm = [int(p) for p in perm]
+    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    shape = tuple(x._bcoo.shape[p] for p in perm)
+    return SparseCooTensor(
+        jsparse.BCOO((x._bcoo.data, idx), shape=shape).sum_duplicates()
+    )
+
+
+def reshape(x, shape, name=None):
+    x = _coo(x)
+    old = x._bcoo.shape
+    size = int(np.prod(old))
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = size // known
+    flat = jnp.ravel_multi_index(
+        tuple(x._bcoo.indices.T), old, mode="clip"
+    )
+    new_idx = jnp.stack(
+        jnp.unravel_index(flat, tuple(shape)), axis=1
+    ).astype(jnp.int32)
+    return SparseCooTensor(
+        jsparse.BCOO((x._bcoo.data, new_idx), shape=tuple(shape))
+    )
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """O(nnz): reduces stored values directly (axis=None) or
+    segment-sums over the kept axes — never densifies."""
+    x = _coo(x)
+    data, idx = x._bcoo.data, x._bcoo.indices
+    shape = x._bcoo.shape
+    if axis is None:
+        out = jnp.sum(data)
+        if keepdim:
+            out = out.reshape((1,) * len(shape))
+    else:
+        ax = int(axis) % len(shape)
+        keep = [d for d in range(len(shape)) if d != ax]
+        out_shape = tuple(shape[d] for d in keep)
+        if keep:
+            key = jnp.ravel_multi_index(
+                tuple(idx[:, d] for d in keep), out_shape, mode="clip"
+            )
+            flat = jnp.zeros(
+                (int(np.prod(out_shape)),), data.dtype
+            ).at[key].add(data)
+            out = flat.reshape(out_shape)
+        else:
+            out = jnp.sum(data)
+        if keepdim:
+            out = jnp.expand_dims(out, ax)
+    if dtype is not None:
+        from ..core.dtypes import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def mv(x, vec, name=None):
+    """sparse [M, N] @ dense vector [N] -> dense [M]."""
+    x = _coo(x)
+    return Tensor(x._bcoo @ _val(vec))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) evaluated only at mask's nonzero positions —
+    the reference SDDMM. Gathers the needed rows/cols per nnz: O(nnz*K)
+    work, never materializing the dense product."""
+    xv, yv = _val(x), _val(y)
+    m = _coo(mask)
+    rows = m._bcoo.indices[:, 0]
+    cols = m._bcoo.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, m._bcoo.indices), shape=m._bcoo.shape)
+    )
+
+
+def is_same_shape(x, y):
+    xs = x.shape if is_sparse(x) else list(_val(x).shape)
+    ys = y.shape if is_sparse(y) else list(_val(y).shape)
+    return list(xs) == list(ys)
+
+
+from . import nn  # noqa: E402,F401

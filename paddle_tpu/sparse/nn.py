@@ -1,0 +1,89 @@
+"""paddle.sparse.nn (python/paddle/sparse/nn/ parity — unverified):
+activation layers + softmax over sparse tensors. The reference's 3-D
+submanifold convolutions (SubmConv3D et al.) are point-cloud kernels
+with data-dependent gather tables — out of the TPU static-shape scope;
+documented gap in COVERAGE.md."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+class _ValueActivation:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x):
+        from . import SparseCooTensor, SparseCsrTensor, Tensor, _coo, _val
+
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(
+                x.crows, x.cols, self._fn(x.data), x.shape
+            )
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(
+                jsparse.BCOO(
+                    (self._fn(x._bcoo.data), x._bcoo.indices),
+                    shape=x._bcoo.shape,
+                )
+            )
+        return Tensor(self._fn(_val(x)))
+
+
+class ReLU(_ValueActivation):
+    def __init__(self):
+        super().__init__(lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(_ValueActivation):
+    def __init__(self):
+        super().__init__(lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(_ValueActivation):
+    def __init__(self, negative_slope=0.01):
+        s = float(negative_slope)
+        super().__init__(lambda v: jnp.where(v >= 0, v, s * v))
+
+
+class Softmax:
+    """Softmax over the last axis, restricted to stored elements —
+    the reference's sparse softmax semantics (zeros stay zero, each
+    row normalizes over its nonzeros)."""
+
+    def __init__(self, axis=-1):
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1 only")
+
+    def __call__(self, x):
+        from . import SparseCsrTensor, _coo, SparseCooTensor
+
+        csr = isinstance(x, SparseCsrTensor)
+        coo = _coo(x)
+        idx = coo._bcoo.indices
+        data = coo._bcoo.data
+        # group key = all but the last sparse dim
+        if idx.shape[1] == 1:
+            key = jnp.zeros((idx.shape[0],), jnp.int32)
+            n_rows = 1
+        else:
+            lead_shape = coo._bcoo.shape[:-1]
+            key = jnp.ravel_multi_index(
+                tuple(idx[:, :-1].T), lead_shape, mode="clip"
+            ).astype(jnp.int32)
+            n_rows = 1
+            for s in lead_shape:
+                n_rows *= int(s)
+        row_max = jnp.full((n_rows,), -jnp.inf, data.dtype).at[key].max(data)
+        ex = jnp.exp(data - row_max[key])
+        row_sum = jnp.zeros((n_rows,), data.dtype).at[key].add(ex)
+        out = ex / row_sum[key]
+        res = SparseCooTensor(
+            jsparse.BCOO((out, idx), shape=coo._bcoo.shape)
+        )
+        if csr:
+            # rebuild CSR layout from the (unchanged) structure
+            from . import SparseCsrTensor as _Csr
+
+            return _Csr(x.crows, x.cols, out, x.shape)
+        return res

@@ -77,7 +77,8 @@ class Imdb(Dataset):
         root = data_file or os.path.join(_CACHE, "imdb", "aclImdb")
         sub = "train" if mode == "train" else "test"
         texts, labels = [], []
-        if os.path.isdir(os.path.join(root, sub)):
+        real_corpus = os.path.isdir(os.path.join(root, sub))
+        if real_corpus:
             for lbl, name in ((0, "neg"), (1, "pos")):
                 d = os.path.join(root, sub, name)
                 for fn in sorted(os.listdir(d)):
@@ -100,9 +101,13 @@ class Imdb(Dataset):
         for t in texts:
             for w in t:
                 freq[w] = freq.get(w, 0) + 1
+        # real corpus honors the requested frequency cutoff; the small
+        # synthetic corpus would lose its whole vocab at cutoff=150, so
+        # it clamps to 2
+        threshold = cutoff if real_corpus else min(cutoff, 2)
         vocab = [
             w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
-            if c >= min(cutoff, 2)
+            if c >= threshold
         ]
         self.word_idx = {w: i for i, w in enumerate(vocab)}
         self.word_idx["<unk>"] = len(self.word_idx)

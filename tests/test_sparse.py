@@ -102,3 +102,111 @@ def test_sparse_matmul_and_ops():
     np.testing.assert_array_equal(
         np.asarray(r.to_dense().numpy()), np.maximum(D, 0)
     )
+
+
+sparse = paddle.sparse
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+class TestSparseBreadth:
+    """Round-3 widening: value-op family, binary ops, layout ops,
+    SDDMM, sparse softmax (torch.sparse parity)."""
+
+    def setup_method(self):
+        rng = np.random.RandomState(0)
+        self.d = (
+            rng.randn(4, 6).astype(np.float32) * (rng.rand(4, 6) > 0.6)
+        )
+        self.s = sparse.to_sparse_coo(T(self.d))
+        self.d2 = (
+            rng.randn(4, 6).astype(np.float32) * (rng.rand(4, 6) > 0.6)
+        )
+        self.rng = rng
+
+    def test_value_ops_zero_preserving(self):
+        np.testing.assert_allclose(
+            sparse.sin(self.s).to_dense().numpy(), np.sin(self.d),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sparse.sqrt(sparse.abs(self.s)).to_dense().numpy(),
+            np.sqrt(np.abs(self.d)), atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sparse.pow(self.s, 3).to_dense().numpy(), self.d ** 3,
+            atol=1e-5,
+        )
+        out = sparse.tanh(self.s)
+        assert out.nnz() == self.s.nnz()  # structure preserved
+
+    def test_binary_and_layout_ops(self):
+        s2 = sparse.to_sparse_coo(T(self.d2))
+        np.testing.assert_allclose(
+            sparse.subtract(self.s, s2).to_dense().numpy(),
+            self.d - self.d2, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sparse.divide(self.s, 2.0).to_dense().numpy(), self.d / 2,
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sparse.transpose(self.s, [1, 0]).to_dense().numpy(), self.d.T
+        )
+        np.testing.assert_allclose(
+            sparse.reshape(self.s, [2, -1]).to_dense().numpy(),
+            self.d.reshape(2, 12),
+        )
+        np.testing.assert_allclose(
+            sparse.sum(self.s, axis=1).numpy(), self.d.sum(1), atol=1e-6
+        )
+        assert sparse.is_same_shape(self.s, s2)
+        assert not sparse.is_same_shape(
+            self.s, sparse.transpose(self.s, [1, 0])
+        )
+
+    def test_mv_and_masked_matmul(self):
+        v = self.rng.randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            sparse.mv(self.s, T(v)).numpy(), self.d @ v, atol=1e-5
+        )
+        A = self.rng.randn(4, 5).astype(np.float32)
+        B = self.rng.randn(5, 6).astype(np.float32)
+        out = sparse.masked_matmul(T(A), T(B), self.s)
+        np.testing.assert_allclose(
+            out.to_dense().numpy(), (A @ B) * (self.d != 0), atol=1e-4
+        )
+
+    def test_softmax_vs_torch_sparse(self):
+        import torch
+
+        mine = sparse.nn.Softmax()(self.s).to_dense().numpy()
+        gold = torch.sparse.softmax(
+            torch.tensor(self.d).to_sparse_coo(), dim=1
+        ).to_dense().numpy()
+        np.testing.assert_allclose(mine, gold, atol=1e-5)
+
+    def test_activations_and_csr(self):
+        np.testing.assert_allclose(
+            sparse.nn.ReLU()(self.s).to_dense().numpy(),
+            np.maximum(self.d, 0),
+        )
+        np.testing.assert_allclose(
+            sparse.nn.LeakyReLU(0.1)(self.s).to_dense().numpy(),
+            np.where(self.d >= 0, self.d, 0.1 * self.d), atol=1e-6,
+        )
+        csr = sparse.sparse_csr_tensor(
+            np.array([0, 2, 3, 3, 4], np.int32),
+            np.array([1, 3, 2, 0], np.int32),
+            np.array([1.0, 2.0, 3.0, 4.0], np.float32), [4, 4],
+        )
+        np.testing.assert_allclose(
+            sparse.tanh(csr).to_dense().numpy(),
+            np.tanh(csr.to_dense().numpy()), atol=1e-6,
+        )
+        sm = sparse.nn.Softmax()(csr)
+        assert type(sm).__name__ == "SparseCsrTensor"
+        rowsums = sm.to_dense().numpy().sum(1)
+        np.testing.assert_allclose(rowsums[[0, 1, 3]], 1.0, atol=1e-5)

@@ -172,3 +172,45 @@ def test_iinfo_finfo_version():
     assert ii.min == -32768 and ii.max == 32767
     assert paddle.version.full_version
     assert paddle.version.cuda() is False
+
+
+def test_audio_save_validates_params():
+    wav = np.zeros((1, 100), np.float32)
+    with pytest.raises(ValueError):
+        paddle.audio.save(tempfile.mktemp(suffix=".wav"), T(wav), 8000,
+                          bits_per_sample=8)
+    with pytest.raises(ValueError):
+        paddle.audio.save(tempfile.mktemp(suffix=".wav"), T(wav), 8000,
+                          encoding="ULAW")
+
+
+def test_summary_multi_input_with_dtypes():
+    class TwoIn(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = paddle.nn.Linear(4, 3)
+            self.b = paddle.nn.Linear(5, 3)
+
+        def forward(self, x, y):
+            return self.a(x) + self.b(y)
+
+    buf = pyio.StringIO()
+    with contextlib.redirect_stdout(buf):
+        stats = paddle.summary(
+            TwoIn(), [(2, 4), (2, 5)], dtypes=["float32", "float32"]
+        )
+    assert stats["total_params"] == 4 * 3 + 3 + 5 * 3 + 3
+
+
+def test_flops_custom_ops_receives_io():
+    seen = {}
+
+    def count_linear(layer, inputs, output):
+        seen["out_shape"] = list(output.shape)
+        return 7
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 3))
+    fl = paddle.flops(
+        net, (2, 4), custom_ops={paddle.nn.Linear: count_linear}
+    )
+    assert fl == 7 and seen["out_shape"] == [2, 3]
