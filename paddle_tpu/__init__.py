@@ -16,12 +16,20 @@ from .core import dtypes as _dtypes
 from .core import tensor as _tensor_mod
 from .core.device import (
     CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    CustomPlace,
     Place,
     TPUPlace,
+    XPUPlace,
     device_count,
     get_device,
+    is_compiled_with_cinn,
     is_compiled_with_cuda,
+    is_compiled_with_distribute,
+    is_compiled_with_rocm,
     is_compiled_with_tpu,
+    is_compiled_with_xpu,
     set_device,
 )
 from .core.dtypes import (
@@ -79,7 +87,17 @@ _TENSOR_METHODS = (
     "slice pad index_put copysign gammaln gammainc gammaincc positive "
     "negative vecdot reduce_as view view_as as_strided select_scatter "
     "diagonal_scatter tensor_split hsplit vsplit dsplit isreal crop "
-    "matrix_exp lu_unpack"
+    "matrix_exp lu_unpack "
+    # inplace-suffix family + misc tail
+    "exp_ sqrt_ rsqrt_ ceil_ floor_ round_ reciprocal_ tanh_ sigmoid_ "
+    "clip_ scale_ tril_ triu_ cumsum_ flatten_ t_ add_ subtract_ "
+    "multiply_ remainder_ copysign_ lerp_ masked_fill_ renorm_ "
+    "index_add_ index_put_ put_along_axis_ scatter_ relu_ softmax_ "
+    "fill_ zero_ fill_diagonal_ fill_diagonal_tensor "
+    "fill_diagonal_tensor_ normal_ uniform_ exponential_ geometric_ "
+    "cauchy_ log_normal_ where_ rank increment shard_index multiplex "
+    "addbmm baddbmm histogram_bin_edges is_complex is_floating_point "
+    "is_integer"
 ).split()
 
 for _name in _TENSOR_METHODS:
@@ -104,6 +122,26 @@ def disable_static():
 
 def in_dynamic_mode():
     return True
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (maps onto numpy print options, which
+    Tensor.__repr__ uses)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    _np.set_printoptions(**kw)
 
 
 def disable_signal_handler():
@@ -134,6 +172,7 @@ from . import utils  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
 from . import audio  # noqa: E402
 from . import distribution  # noqa: E402
+from . import geometric  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import text  # noqa: E402

@@ -147,3 +147,39 @@ def device_count() -> int:
     if p.device_type == "cpu":
         return len([d for d in jax.devices() if d.platform == "cpu"]) or 1
     return len(_accelerator_devices()) or 1
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role by design (SURVEY §7); the flag answers the
+    # reference question "is a tensor compiler available" truthfully
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def CUDAPlace(device_id: int = 0):
+    """Reference scripts constructing CUDAPlace run on the accelerator
+    this build targets (TPU) — same role, same API shape."""
+    return TPUPlace(device_id)
+
+
+def XPUPlace(device_id: int = 0):
+    return TPUPlace(device_id)
+
+
+def CUDAPinnedPlace():
+    return Place("cpu", 0)
+
+
+def CustomPlace(device_type: str, device_id: int = 0):
+    return Place(str(device_type), int(device_id))

@@ -1,0 +1,30 @@
+"""paddle.geometric parity (python/paddle/geometric/ — unverified):
+segment reductions + message-passing helpers over the segment kernels
+(scatter-add lowers to XLA scatter on TPU)."""
+from .core import dispatch
+from .ops.tail import (  # noqa: F401
+    _segment_n,
+    _segment_reduce,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges and reduce at the
+    destination (the reference's basic graph message passing).
+    ``out_size`` fixes the number of output rows (nodes); without it the
+    size is inferred as max(dst_index)+1, which truncates trailing
+    isolated nodes."""
+    from .ops.manipulation import gather
+
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"send_u_recv: unknown reduce_op {reduce_op!r}")
+    n = int(out_size) if out_size is not None else _segment_n(dst_index)
+    return dispatch.apply(
+        f"segment_{reduce_op}", _segment_reduce,
+        (gather(x, src_index), dst_index), {"n": n, "how": reduce_op},
+    )

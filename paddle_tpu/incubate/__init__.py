@@ -10,3 +10,9 @@ matters (no extra HBM round trips).
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from ..ops.tail import (  # noqa: F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)

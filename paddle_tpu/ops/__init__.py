@@ -10,6 +10,7 @@ from __future__ import annotations
 from . import (
     creation,
     extras,
+    inplace,
     linalg,
     logic,
     manipulation,
@@ -20,7 +21,7 @@ from . import (
 )
 
 _MODULES = [creation, math, reduction, manipulation, search, logic, linalg,
-            extras, tail]
+            extras, tail, inplace]
 
 # helper/infra names that are callable but are NOT ops
 _EXCLUDE = {

@@ -1,0 +1,193 @@
+"""Inplace-suffix op variants (reference: the ``op_``/``Tensor.op_``
+family generated from paddle's inplace op registry — unverified).
+
+jax arrays are immutable, so "inplace" here is the framework's
+value-swap contract: ``x._inplace(op, ...)`` computes out-of-place,
+snapshots x's autograd identity as the op's input, and rebinds x to the
+result — user-visible semantics (including grad history) match the
+reference's inplace ops without aliasing mutation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from . import extras, manipulation, math, search, tail
+
+
+def _mk(name, fn):
+    def op(x, *args, **kw):
+        return x._inplace(fn, *args, **kw)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+# ----------------------------------------------------------------- unary
+exp_ = _mk("exp_", math.exp)
+sqrt_ = _mk("sqrt_", math.sqrt)
+rsqrt_ = _mk("rsqrt_", math.rsqrt)
+ceil_ = _mk("ceil_", math.ceil)
+floor_ = _mk("floor_", math.floor)
+round_ = _mk("round_", math.round)
+reciprocal_ = _mk("reciprocal_", math.reciprocal)
+tanh_ = _mk("tanh_", math.tanh)
+sigmoid_ = _mk("sigmoid_", math.sigmoid)
+clip_ = _mk("clip_", math.clip)
+scale_ = _mk("scale_", math.scale)
+tril_ = _mk("tril_", manipulation.tril)
+triu_ = _mk("triu_", manipulation.triu)
+cumsum_ = _mk("cumsum_", math.cumsum)
+flatten_ = _mk("flatten_", manipulation.flatten)
+t_ = _mk("t_", manipulation.t)
+
+# ---------------------------------------------------------------- binary
+add_ = _mk("add_", math.add)
+subtract_ = _mk("subtract_", math.subtract)
+multiply_ = _mk("multiply_", math.multiply)
+remainder_ = _mk("remainder_", math.remainder)
+copysign_ = _mk("copysign_", tail.copysign)
+lerp_ = _mk("lerp_", math.lerp)
+masked_fill_ = _mk("masked_fill_", manipulation.masked_fill)
+renorm_ = _mk("renorm_", extras.renorm)
+index_add_ = _mk("index_add_", extras.index_add)
+index_put_ = _mk("index_put_", search.index_put)
+put_along_axis_ = _mk("put_along_axis_", manipulation.put_along_axis)
+scatter_ = _mk("scatter_", manipulation.scatter)
+
+
+def relu_(x, name=None):
+    from ..nn.functional.activation import relu
+
+    return x._inplace(relu)
+
+
+def softmax_(x, axis=-1, name=None):
+    from ..nn.functional.activation import softmax
+
+    return x._inplace(softmax, axis)
+
+
+def where_(condition, x, y, name=None):
+    """Inplace into ``x`` (reference Tensor.where_ contract)."""
+    return x._inplace(
+        lambda alias: manipulation.where(condition, alias, y)
+    )
+
+
+# -------------------------------------------------------------- fillers
+def _full_like_val(x, *, v):
+    return jnp.full_like(x, v)
+
+
+def fill_(x, value, name=None):
+    return x._inplace(
+        lambda alias: dispatch.apply(
+            "fill_like", _full_like_val, (alias,), {"v": float(value)}
+        )
+    )
+
+
+def zero_(x, name=None):
+    return fill_(x, 0.0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    from .tail import diagonal_scatter
+
+    nd = len(x.shape)
+    length = (
+        min(int(x.shape[-2]), int(x.shape[-1]) - offset) if offset >= 0
+        else min(int(x.shape[-2]) + offset, int(x.shape[-1]))
+    )
+    from .creation import full
+
+    v = full([max(length, 0)], float(value), dtype=x.dtype)
+    return x._inplace(
+        lambda alias: diagonal_scatter(
+            alias, v, offset=offset, axis1=nd - 2, axis2=nd - 1
+        )
+    )
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from .tail import diagonal_scatter
+
+    return diagonal_scatter(x, y, offset=offset, axis1=dim1, axis2=dim2)
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    from .tail import diagonal_scatter
+
+    return x._inplace(
+        lambda alias: diagonal_scatter(
+            alias, y, offset=offset, axis1=dim1, axis2=dim2
+        )
+    )
+
+
+# -------------------------------------------------------- random fillers
+def _rand_fill(name, sampler):
+    def op(x, *args, **kw):
+        kw.pop("name", None)
+
+        def fill(alias):
+            return dispatch.apply(
+                name, sampler, (alias,),
+                {"key": random_mod.next_key(),
+                 "args": tuple(float(a) for a in args)},
+                cache=False, nondiff=True,
+            )
+
+        return x._inplace(fill)
+
+    op.__name__ = name
+    return op
+
+
+def _defaults(args, defaults):
+    """Positional args fill left-to-right; missing slots take defaults."""
+    return args + defaults[len(args):]
+
+
+def _normal_sampler(x, *, key, args):
+    mean, std = _defaults(args, (0.0, 1.0))
+    return mean + std * jax.random.normal(key, x.shape, x.dtype)
+
+
+def _uniform_sampler(x, *, key, args):
+    lo, hi = _defaults(args, (-1.0, 1.0))
+    return jax.random.uniform(key, x.shape, x.dtype, minval=lo, maxval=hi)
+
+
+def _exponential_sampler(x, *, key, args):
+    (lam,) = _defaults(args, (1.0,))
+    return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+def _geometric_sampler(x, *, key, args):
+    (p,) = _defaults(args, (0.5,))
+    u = jax.random.uniform(key, x.shape, x.dtype)
+    return jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1.0
+
+
+def _cauchy_sampler(x, *, key, args):
+    loc, scale = _defaults(args, (0.0, 1.0))
+    return loc + scale * jax.random.cauchy(key, x.shape, x.dtype)
+
+
+def _log_normal_sampler(x, *, key, args):
+    mean, std = _defaults(args, (1.0, 2.0))
+    return jnp.exp(mean + std * jax.random.normal(key, x.shape, x.dtype))
+
+
+normal_ = _rand_fill("normal_", _normal_sampler)
+uniform_ = _rand_fill("uniform_", _uniform_sampler)
+exponential_ = _rand_fill("exponential_", _exponential_sampler)
+geometric_ = _rand_fill("geometric_", _geometric_sampler)
+cauchy_ = _rand_fill("cauchy_", _cauchy_sampler)
+log_normal_ = _rand_fill("log_normal_", _log_normal_sampler)

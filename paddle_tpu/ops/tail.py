@@ -232,3 +232,216 @@ def dsplit(x, num_or_indices, name=None):
     if len(x.shape) < 3:
         raise ValueError("dsplit expects at least a 3-D tensor")
     return tensor_split(x, num_or_indices, axis=2)
+
+
+# ------------------------------------------------------ misc reference ops
+def rank(input, name=None):
+    """0-D int32 tensor holding ndim (reference paddle.rank)."""
+    from .creation import to_tensor
+
+    return to_tensor(len(input.shape), dtype="int32")
+
+
+def _increment(x, *, v):
+    return x + v
+
+
+def _increment_out(x, value):
+    return dispatch.apply("increment", _increment, (x,), {"v": float(value)})
+
+
+def increment(x, value=1.0, name=None):
+    """In-place like the reference (loop counters: paddle.increment(i)
+    as a bare statement must advance i)."""
+    return x._inplace(_increment_out, value)
+
+
+def _shard_index(x, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    in_shard = (x >= lo) & (x < lo + shard_size)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for {nshards} shards"
+        )
+    return dispatch.apply(
+        "shard_index", _shard_index, (input,),
+        {"index_num": int(index_num), "nshards": int(nshards),
+         "shard_id": int(shard_id), "ignore_value": int(ignore_value)},
+    )
+
+
+def _multiplex(index, *ins):
+    stacked = jnp.stack(ins, axis=0)  # [K, B, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[index[:, 0].astype(jnp.int32), rows]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors (reference multiplex)."""
+    return dispatch.apply(
+        "multiplex", _multiplex, (index, *tuple(inputs))
+    )
+
+
+def _temporal_shift(x, *, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xs = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate(
+        [xs[:, 1:, :fold], jnp.zeros_like(xs[:, :1, :fold])], axis=1
+    )
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xs[:, :1, fold:2 * fold]),
+         xs[:, :-1, fold:2 * fold]], axis=1
+    )
+    keep = xs[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    if data_format != "NCHW":
+        from .manipulation import transpose
+
+        x = transpose(x, [0, 3, 1, 2])
+    out = dispatch.apply(
+        "temporal_shift", _temporal_shift, (x,),
+        {"seg_num": int(seg_num), "shift_ratio": float(shift_ratio)},
+    )
+    if data_format != "NCHW":
+        from .manipulation import transpose
+
+        out = transpose(out, [0, 2, 3, 1])
+    return out
+
+
+def _addbmm(inp, x, y, *, beta, alpha):
+    return beta * inp + alpha * jnp.sum(jnp.matmul(x, y), axis=0)
+
+
+def addbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch.apply(
+        "addbmm", _addbmm, (input, x, y),
+        {"beta": float(beta), "alpha": float(alpha)},
+    )
+
+
+def _baddbmm(inp, x, y, *, beta, alpha):
+    return beta * inp + alpha * jnp.matmul(x, y)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch.apply(
+        "baddbmm", _baddbmm, (input, x, y),
+        {"beta": float(beta), "alpha": float(alpha)},
+    )
+
+
+def _hist_edges(x, *, bins, lo, hi):
+    minv = jnp.min(x) if lo == hi == 0 else jnp.asarray(lo, x.dtype)
+    maxv = jnp.max(x) if lo == hi == 0 else jnp.asarray(hi, x.dtype)
+    maxv = jnp.where(maxv == minv, minv + 1.0, maxv)
+    return jnp.linspace(minv, maxv, bins + 1).astype(x.dtype)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    return dispatch.apply(
+        "histogram_bin_edges", _hist_edges, (input,),
+        {"bins": int(bins), "lo": float(min), "hi": float(max)},
+    )
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.asarray(x.value).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.asarray(x.value).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.asarray(x.value).dtype, jnp.integer)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from ..core import random as random_mod
+    from ..core.dtypes import convert_dtype, get_default_dtype
+
+    dt = jnp.dtype(convert_dtype(dtype) or get_default_dtype())
+    shp = tuple(int(s) for s in (shape or []))
+
+    def _ln(*, key, mean, std, shp, dt):
+        return jnp.exp(mean + std * jax.random.normal(key, shp, dt))
+
+    return dispatch.apply(
+        "log_normal", _ln, (),
+        {"key": random_mod.next_key(), "mean": float(mean),
+         "std": float(std), "shp": shp, "dt": dt},
+        cache=False, nondiff=True,
+    )
+
+
+# ------------------------------------------------------------ segment ops
+def _segment_reduce(x, ids, *, n, how):
+    cnt = jnp.zeros((n,), jnp.int32).at[ids].add(1)
+    empty = (cnt == 0).reshape((n,) + (1,) * (x.ndim - 1))
+    zero = jnp.zeros((), x.dtype)
+    if how == "sum" or how == "mean":
+        out = jnp.zeros((n,) + x.shape[1:], x.dtype).at[ids].add(x)
+        if how == "mean":
+            denom = jnp.maximum(cnt, 1).astype(x.dtype).reshape(
+                (n,) + (1,) * (x.ndim - 1)
+            )
+            out = out / denom
+        return out
+    # max/min: dtype-preserving sentinel init, empty segments -> 0
+    # (reference contract); count-based masking keeps legitimate
+    # +-inf values intact
+    if how == "max":
+        init_v = (
+            jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min
+        )
+        out = jnp.full((n,) + x.shape[1:], init_v, x.dtype).at[ids].max(x)
+    else:
+        init_v = (
+            jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).max
+        )
+        out = jnp.full((n,) + x.shape[1:], init_v, x.dtype).at[ids].min(x)
+    return jnp.where(empty, zero, out)
+
+
+def _segment_n(segment_ids):
+    ids = segment_ids
+    return int(jnp.max(jnp.asarray(
+        ids.value if isinstance(ids, Tensor) else ids
+    ))) + 1
+
+
+def _segment(name, how):
+    def op(data, segment_ids, name=None):
+        return dispatch.apply(
+            f"segment_{how}", _segment_reduce, (data, segment_ids),
+            {"n": _segment_n(segment_ids), "how": how},
+        )
+
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_max = _segment("segment_max", "max")
+segment_min = _segment("segment_min", "min")

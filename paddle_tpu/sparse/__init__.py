@@ -292,8 +292,19 @@ def pow(x, factor, name=None):  # noqa: A001
 
 
 def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Casts values/indices, preserving the storage format (CSR in,
+    CSR out — reference contract)."""
     from ..core.dtypes import convert_dtype
 
+    if isinstance(x, SparseCsrTensor):
+        data = x.data
+        crows, cols = x.crows, x.cols
+        if value_dtype is not None:
+            data = data.astype(convert_dtype(value_dtype))
+        if index_dtype is not None:
+            crows = crows.astype(convert_dtype(index_dtype))
+            cols = cols.astype(convert_dtype(index_dtype))
+        return SparseCsrTensor(crows, cols, data, x.shape)
     x = _coo(x)
     data, idx = x._bcoo.data, x._bcoo.indices
     if value_dtype is not None:
@@ -317,10 +328,20 @@ def reshape(x, shape, name=None):
     x = _coo(x)
     old = x._bcoo.shape
     size = int(np.prod(old))
-    shape = list(shape)
+    shape = [int(s) for s in shape]
+    if shape.count(-1) > 1:
+        raise ValueError(f"reshape: more than one -1 in {shape}")
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))
+        if known == 0 or size % known != 0:
+            raise ValueError(
+                f"reshape: cannot infer -1 for {size} elements into {shape}"
+            )
         shape[shape.index(-1)] = size // known
+    if int(np.prod(shape)) != size:
+        raise ValueError(
+            f"reshape: {size} elements cannot reshape to {shape}"
+        )
     flat = jnp.ravel_multi_index(
         tuple(x._bcoo.indices.T), old, mode="clip"
     )

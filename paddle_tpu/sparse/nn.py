@@ -1,8 +1,9 @@
 """paddle.sparse.nn (python/paddle/sparse/nn/ parity — unverified):
-activation layers + softmax over sparse tensors. The reference's 3-D
-submanifold convolutions (SubmConv3D et al.) are point-cloud kernels
-with data-dependent gather tables — out of the TPU static-shape scope;
-documented gap in COVERAGE.md."""
+activation layers + softmax over sparse tensors. Activations are thin
+wrappers over the shared ``_value_op`` zero-preserving kernel helper.
+The reference's 3-D submanifold convolutions (SubmConv3D et al.) are
+point-cloud kernels with data-dependent gather tables — out of the TPU
+static-shape scope; documented gap in COVERAGE.md."""
 from __future__ import annotations
 
 import jax.numpy as jnp
@@ -11,23 +12,12 @@ from jax.experimental import sparse as jsparse
 
 class _ValueActivation:
     def __init__(self, fn):
-        self._fn = fn
+        from . import _value_op
+
+        self._op = _value_op(type(self).__name__, fn)
 
     def __call__(self, x):
-        from . import SparseCooTensor, SparseCsrTensor, Tensor, _coo, _val
-
-        if isinstance(x, SparseCsrTensor):
-            return SparseCsrTensor(
-                x.crows, x.cols, self._fn(x.data), x.shape
-            )
-        if isinstance(x, SparseCooTensor):
-            return SparseCooTensor(
-                jsparse.BCOO(
-                    (self._fn(x._bcoo.data), x._bcoo.indices),
-                    shape=x._bcoo.shape,
-                )
-            )
-        return Tensor(self._fn(_val(x)))
+        return self._op(x)
 
 
 class ReLU(_ValueActivation):
@@ -56,7 +46,7 @@ class Softmax:
             raise ValueError("sparse Softmax supports axis=-1 only")
 
     def __call__(self, x):
-        from . import SparseCsrTensor, _coo, SparseCooTensor
+        from . import SparseCooTensor, SparseCsrTensor, _coo
 
         csr = isinstance(x, SparseCsrTensor)
         coo = _coo(x)
@@ -78,12 +68,9 @@ class Softmax:
         ex = jnp.exp(data - row_max[key])
         row_sum = jnp.zeros((n_rows,), data.dtype).at[key].add(ex)
         out = ex / row_sum[key]
-        res = SparseCooTensor(
-            jsparse.BCOO((out, idx), shape=coo._bcoo.shape)
-        )
         if csr:
             # rebuild CSR layout from the (unchanged) structure
-            from . import SparseCsrTensor as _Csr
-
-            return _Csr(x.crows, x.cols, out, x.shape)
-        return res
+            return SparseCsrTensor(x.crows, x.cols, out, x.shape)
+        return SparseCooTensor(
+            jsparse.BCOO((out, idx), shape=coo._bcoo.shape)
+        )

@@ -1,0 +1,212 @@
+"""Inplace-suffix op family (value-swap semantics + autograd), random
+fillers, and the misc tail ops (rank/shard_index/multiplex/segment/...).
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(6)
+
+
+def T(a, sg=True):
+    t = Tensor(jnp.asarray(a))
+    t.stop_gradient = sg
+    return t
+
+
+def test_inplace_unary_matches_outofplace():
+    base = RNG.rand(3, 4).astype(np.float32) + 0.5
+    for name in ["exp_", "sqrt_", "rsqrt_", "ceil_", "floor_", "round_",
+                 "reciprocal_", "tanh_", "sigmoid_", "tril_", "triu_"]:
+        x = T(base.copy())
+        getattr(x, name)()
+        gold = getattr(paddle, name[:-1])(T(base)).numpy()
+        np.testing.assert_allclose(x.numpy(), gold, atol=1e-6, err_msg=name)
+
+
+def test_inplace_grad_flows_through_history():
+    x = T(RNG.randn(3, 4).astype(np.float32), sg=False)
+    y = x * 2.0
+    y.exp_()
+    y.sum().backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), np.exp(2 * x.numpy()) * 2, rtol=1e-4
+    )
+
+
+def test_inplace_binary_and_fillers():
+    a = RNG.randn(3, 4).astype(np.float32)
+    b = RNG.randn(3, 4).astype(np.float32)
+    x = T(a.copy())
+    x.add_(T(b))
+    np.testing.assert_allclose(x.numpy(), a + b, atol=1e-6)
+    x = T(a.copy())
+    x.copysign_(T(b))
+    np.testing.assert_allclose(x.numpy(), np.copysign(a, b), atol=1e-6)
+    x = T(a.copy())
+    x.fill_(5.0)
+    assert (x.numpy() == 5).all()
+    x.zero_()
+    assert (x.numpy() == 0).all()
+    x = T(np.zeros((4, 5), np.float32))
+    x.fill_diagonal_(2.0, offset=1)
+    gold = np.zeros((4, 5), np.float32)
+    np.fill_diagonal(gold[:, 1:], 2.0)
+    np.testing.assert_array_equal(x.numpy(), gold)
+    x = T(np.zeros((4, 4), np.float32))
+    paddle.fill_diagonal_tensor_(x, T(np.arange(4, dtype=np.float32)))
+    np.testing.assert_array_equal(np.diag(x.numpy()), np.arange(4))
+
+
+def test_random_fillers_statistics():
+    paddle.seed(123)
+    x = T(np.zeros(4000, np.float32))
+    x.normal_(3.0, 0.5)
+    assert abs(x.numpy().mean() - 3.0) < 0.05
+    assert abs(x.numpy().std() - 0.5) < 0.05
+    x.uniform_(0.0, 2.0)
+    assert 0.9 < x.numpy().mean() < 1.1
+    assert x.numpy().min() >= 0 and x.numpy().max() <= 2
+    x.exponential_(2.0)
+    assert abs(x.numpy().mean() - 0.5) < 0.05
+    x.log_normal_(0.0, 0.25)
+    assert abs(np.log(x.numpy()).mean()) < 0.05
+    x.geometric_(0.5)
+    assert x.numpy().min() >= 1
+
+
+def test_addbmm_baddbmm_vs_torch():
+    inp = RNG.randn(4, 5).astype(np.float32)
+    bx = RNG.randn(3, 4, 2).astype(np.float32)
+    by = RNG.randn(3, 2, 5).astype(np.float32)
+    mine = paddle.addbmm(
+        T(inp), T(bx), T(by), beta=0.5, alpha=2.0
+    ).numpy()
+    gold = torch.addbmm(
+        torch.tensor(inp), torch.tensor(bx), torch.tensor(by),
+        beta=0.5, alpha=2.0,
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-5)
+    binp = RNG.randn(3, 4, 5).astype(np.float32)
+    mine2 = paddle.baddbmm(T(binp), T(bx), T(by)).numpy()
+    gold2 = torch.baddbmm(
+        torch.tensor(binp), torch.tensor(bx), torch.tensor(by)
+    ).numpy()
+    np.testing.assert_allclose(mine2, gold2, rtol=1e-4, atol=1e-5)
+
+
+def test_misc_reference_ops():
+    x = T(RNG.randn(3, 4).astype(np.float32))
+    assert int(paddle.rank(x).numpy()) == 2
+    idx = T(np.array([0, 7, 15, 16, 31], np.int64))
+    assert paddle.shard_index(idx, 32, 2, 0).numpy().tolist() == \
+        [0, 7, 15, -1, -1]
+    assert paddle.shard_index(idx, 32, 2, 1).numpy().tolist() == \
+        [-1, -1, -1, 0, 15]
+    with pytest.raises(ValueError):
+        paddle.shard_index(idx, 32, 2, 5)
+    assert float(paddle.increment(T(np.float32(3.0))).numpy()) == 4.0
+    ins = [T(np.full((3, 2), i, np.float32)) for i in range(3)]
+    midx = T(np.array([[2], [0], [1]], np.int32))
+    assert paddle.multiplex(ins, midx).numpy()[:, 0].tolist() == \
+        [2.0, 0.0, 1.0]
+    assert paddle.is_floating_point(x) and not paddle.is_complex(x)
+    hbe = paddle.histogram_bin_edges(
+        T(np.array([0.0, 1.0, 2.0, 3.0], np.float32)), bins=4
+    )
+    np.testing.assert_allclose(
+        hbe.numpy(), np.histogram_bin_edges(np.arange(4.0), 4), atol=1e-6
+    )
+
+
+def test_temporal_shift_semantics():
+    x = RNG.randn(4, 8, 2, 2).astype(np.float32)  # nt=4, seg=2 -> n=2,t=2
+    out = paddle.temporal_shift(T(x), seg_num=2, shift_ratio=0.25).numpy()
+    xs = x.reshape(2, 2, 8, 2, 2)
+    fold = 2
+    # first fold channels shift backward in time
+    np.testing.assert_allclose(
+        out.reshape(2, 2, 8, 2, 2)[:, 0, :fold], xs[:, 1, :fold]
+    )
+    assert (out.reshape(2, 2, 8, 2, 2)[:, 1, :fold] == 0).all()
+    # untouched channels pass through
+    np.testing.assert_allclose(
+        out.reshape(2, 2, 8, 2, 2)[:, :, 2 * fold:], xs[:, :, 2 * fold:]
+    )
+
+
+def test_segment_ops_and_geometric():
+    data = RNG.randn(6, 3).astype(np.float32)
+    seg = np.array([0, 0, 1, 1, 1, 2], np.int64)
+    golds = {
+        "segment_sum": np.stack(
+            [data[:2].sum(0), data[2:5].sum(0), data[5:].sum(0)]
+        ),
+        "segment_mean": np.stack(
+            [data[:2].mean(0), data[2:5].mean(0), data[5:].mean(0)]
+        ),
+        "segment_max": np.stack(
+            [data[:2].max(0), data[2:5].max(0), data[5:].max(0)]
+        ),
+        "segment_min": np.stack(
+            [data[:2].min(0), data[2:5].min(0), data[5:].min(0)]
+        ),
+    }
+    for name, gold in golds.items():
+        out = getattr(paddle.geometric, name)(T(data), T(seg)).numpy()
+        np.testing.assert_allclose(out, gold, atol=1e-5, err_msg=name)
+        assert hasattr(paddle.incubate, name)
+    eye = T(np.eye(3, dtype=np.float32))
+    src = T(np.array([0, 1, 2, 0], np.int64))
+    dst = T(np.array([1, 2, 0, 2], np.int64))
+    agg = paddle.geometric.send_u_recv(eye, src, dst).numpy()
+    gold = np.zeros((3, 3), np.float32)
+    for s, d in [(0, 1), (1, 2), (2, 0), (0, 2)]:
+        gold[d] += np.eye(3, dtype=np.float32)[s]
+    np.testing.assert_array_equal(agg, gold)
+
+
+def test_places_and_flags():
+    assert str(paddle.CUDAPlace(0)) == str(paddle.TPUPlace(0))
+    assert paddle.CustomPlace("npu", 1).device_type == "npu"
+    assert not paddle.is_compiled_with_xpu()
+    assert not paddle.is_compiled_with_rocm()
+    assert paddle.is_compiled_with_cinn()
+    assert paddle.is_compiled_with_distribute()
+    assert paddle.tolist(T(np.array([1, 2]))) == [1, 2]
+
+
+def test_increment_is_inplace():
+    x = T(np.float32(5.0))
+    paddle.increment(x)
+    assert float(x.numpy()) == 6.0
+
+
+def test_segment_max_int_dtype_and_empty_segments():
+    data = T(np.array([[1], [2]], np.int32))
+    ids = T(np.array([0, 2], np.int64))
+    out = paddle.segment_max(data, ids)
+    assert out.numpy().dtype == np.int32
+    np.testing.assert_array_equal(out.numpy(), [[1], [0], [2]])
+    out_min = paddle.segment_min(data, ids)
+    np.testing.assert_array_equal(out_min.numpy(), [[1], [0], [2]])
+    # float +inf survives the empty-segment masking
+    fdata = T(np.array([np.inf, 1.0], np.float32))
+    fout = paddle.segment_max(fdata, T(np.array([0, 1], np.int64)))
+    assert np.isposinf(fout.numpy()[0])
+
+
+def test_send_u_recv_out_size():
+    x = T(np.eye(3, dtype=np.float32))
+    src = T(np.array([0, 1, 2], np.int64))
+    dst = T(np.array([0, 1, 0], np.int64))
+    out = paddle.geometric.send_u_recv(x, src, dst, "sum", out_size=5)
+    assert tuple(out.shape) == (5, 3)
+    assert (out.numpy()[2:] == 0).all()
+    with pytest.raises(ValueError):
+        paddle.geometric.send_u_recv(x, src, dst, "prod")

@@ -210,3 +210,23 @@ class TestSparseBreadth:
         assert type(sm).__name__ == "SparseCsrTensor"
         rowsums = sm.to_dense().numpy().sum(1)
         np.testing.assert_allclose(rowsums[[0, 1, 3]], 1.0, atol=1e-5)
+
+
+def test_sparse_reshape_validates_and_cast_preserves_format():
+    d = np.eye(4, dtype=np.float32)
+    s = sparse.to_sparse_coo(T(d))
+    import pytest
+
+    with pytest.raises(ValueError):
+        sparse.reshape(s, [5, 5])
+    with pytest.raises(ValueError):
+        sparse.reshape(s, [7, -1])
+    with pytest.raises(ValueError):
+        sparse.reshape(s, [-1, -1])
+    csr = sparse.sparse_csr_tensor(
+        np.array([0, 1, 2], np.int32), np.array([0, 1], np.int32),
+        np.array([1.0, 2.0], np.float32), [2, 2],
+    )
+    out = sparse.cast(csr, value_dtype="float16")
+    assert type(out).__name__ == "SparseCsrTensor"
+    assert str(out.dtype) == "float16"
