@@ -173,6 +173,7 @@ from .utils.flags import get_flags, set_flags  # noqa: E402
 from . import audio  # noqa: E402
 from . import distribution  # noqa: E402
 from . import geometric  # noqa: E402
+from . import quantization  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
 from . import text  # noqa: E402

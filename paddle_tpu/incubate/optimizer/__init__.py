@@ -6,3 +6,95 @@ and is wired into paddle_tpu.optimizer.Adam/AdamW via use_multi_tensor=True:
 one jitted whole-tree update per step instead of one dispatch per parameter.
 """
 from ...kernels.fused_adam import fused_adam_update  # noqa: F401
+
+
+class LookAhead:
+    """paddle.incubate.LookAhead (reference: python/paddle/incubate/
+    optimizer/lookahead.py — unverified): k fast steps with the inner
+    optimizer, then interpolate slow weights toward fast weights by
+    alpha and reset the fast weights to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for _, p in self.inner_optimizer._all_params()]
+
+    def step(self):
+        import jax.numpy as jnp
+
+        if self._slow is None:
+            # deep copies: optimizer steps donate the old param buffers
+            self._slow = [
+                jnp.array(p.value, copy=True) for p in self._params()
+            ]
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for i, p in enumerate(self._params()):
+                slow = self._slow[i] + self.alpha * (
+                    p.value - self._slow[i]
+                )
+                # keep an independent copy: the next optimizer step
+                # donates (deletes) the buffer handed to the param
+                self._slow[i] = jnp.array(slow, copy=True)
+                p.set_value(slow)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_slow"] = [
+            None if s is None else s for s in (self._slow or [])
+        ]
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+
+class ModelAverage:
+    """paddle.incubate.ModelAverage (reference: python/paddle/incubate/
+    optimizer/modelaverage.py — unverified): maintain a running average
+    of parameters; apply()/restore() swap it in and out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires parameters")
+        self._params = list(parameters)
+        self._sums = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import jax.numpy as jnp
+
+        if self._sums is None:
+            self._sums = [jnp.zeros_like(p.value) for p in self._params]
+        for i, p in enumerate(self._params):
+            self._sums[i] = self._sums[i] + p.value
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+
+        if not self._count:
+            return
+        self._backup = [jnp.array(p.value, copy=True) for p in self._params]
+        for p, s in zip(self._params, self._sums):
+            p.set_value(s / float(self._count))
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.set_value(b)
+        self._backup = None

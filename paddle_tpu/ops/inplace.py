@@ -96,20 +96,32 @@ def zero_(x, name=None):
     return fill_(x, 0.0)
 
 
+def _fill_diagonal(xv, *, v, offset, wrap):
+    nd = xv.ndim
+    if nd == 2:
+        m, n = xv.shape
+        if wrap and m > n:
+            # numpy wrap semantics: flat stride n+1 through the whole
+            # array (one skipped row between wrapped diagonal blocks)
+            idx = jnp.arange(0, m * n, n + 1)
+            return xv.reshape(-1).at[idx].set(v).reshape(m, n)
+        length = min(m, n - offset) if offset >= 0 else min(m + offset, n)
+        length = max(length, 0)
+        r = jnp.arange(length)
+        rows = r if offset >= 0 else r - offset
+        cols = r + offset if offset >= 0 else r
+        return xv.at[rows, cols].set(v)
+    # ndim > 2: reference fills the main hyper-diagonal x[i, i, ..., i]
+    k = min(xv.shape)
+    r = jnp.arange(k)
+    return xv.at[tuple([r] * nd)].set(v)
+
+
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
-    from .tail import diagonal_scatter
-
-    nd = len(x.shape)
-    length = (
-        min(int(x.shape[-2]), int(x.shape[-1]) - offset) if offset >= 0
-        else min(int(x.shape[-2]) + offset, int(x.shape[-1]))
-    )
-    from .creation import full
-
-    v = full([max(length, 0)], float(value), dtype=x.dtype)
     return x._inplace(
-        lambda alias: diagonal_scatter(
-            alias, v, offset=offset, axis1=nd - 2, axis2=nd - 1
+        lambda alias: dispatch.apply(
+            "fill_diagonal", _fill_diagonal, (alias,),
+            {"v": float(value), "offset": int(offset), "wrap": bool(wrap)},
         )
     )
 
@@ -131,9 +143,24 @@ def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
 
 
 # -------------------------------------------------------- random fillers
-def _rand_fill(name, sampler):
+def _rand_fill(name, sampler, kworder):
+    """kworder maps the reference keyword names onto positional slots so
+    keyword calls (x.uniform_(min=0, max=2)) behave identically."""
+
     def op(x, *args, **kw):
         kw.pop("name", None)
+        args = list(args)
+        for i, key in enumerate(kworder):
+            if key in kw:
+                if i < len(args):
+                    raise TypeError(
+                        f"{name}: got multiple values for argument {key!r}"
+                    )
+                while len(args) < i:
+                    args.append(_RAND_DEFAULTS[name][len(args)])
+                args.append(kw.pop(key))
+        if kw:
+            raise TypeError(f"{name}: unexpected arguments {sorted(kw)}")
 
         def fill(alias):
             return dispatch.apply(
@@ -185,9 +212,18 @@ def _log_normal_sampler(x, *, key, args):
     return jnp.exp(mean + std * jax.random.normal(key, x.shape, x.dtype))
 
 
-normal_ = _rand_fill("normal_", _normal_sampler)
-uniform_ = _rand_fill("uniform_", _uniform_sampler)
-exponential_ = _rand_fill("exponential_", _exponential_sampler)
-geometric_ = _rand_fill("geometric_", _geometric_sampler)
-cauchy_ = _rand_fill("cauchy_", _cauchy_sampler)
-log_normal_ = _rand_fill("log_normal_", _log_normal_sampler)
+_RAND_DEFAULTS = {
+    "normal_": (0.0, 1.0),
+    "uniform_": (-1.0, 1.0),
+    "exponential_": (1.0,),
+    "geometric_": (0.5,),
+    "cauchy_": (0.0, 1.0),
+    "log_normal_": (1.0, 2.0),
+}
+
+normal_ = _rand_fill("normal_", _normal_sampler, ("mean", "std"))
+uniform_ = _rand_fill("uniform_", _uniform_sampler, ("min", "max"))
+exponential_ = _rand_fill("exponential_", _exponential_sampler, ("lam",))
+geometric_ = _rand_fill("geometric_", _geometric_sampler, ("probs",))
+cauchy_ = _rand_fill("cauchy_", _cauchy_sampler, ("loc", "scale"))
+log_normal_ = _rand_fill("log_normal_", _log_normal_sampler, ("mean", "std"))

@@ -347,7 +347,10 @@ def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 def _hist_edges(x, *, bins, lo, hi):
     minv = jnp.min(x) if lo == hi == 0 else jnp.asarray(lo, x.dtype)
     maxv = jnp.max(x) if lo == hi == 0 else jnp.asarray(hi, x.dtype)
-    maxv = jnp.where(maxv == minv, minv + 1.0, maxv)
+    # numpy degenerate-range convention: [v, v] -> [v-0.5, v+0.5]
+    degen = maxv == minv
+    minv = jnp.where(degen, minv - 0.5, minv)
+    maxv = jnp.where(degen, maxv + 0.5, maxv)
     return jnp.linspace(minv, maxv, bins + 1).astype(x.dtype)
 
 

@@ -1,0 +1,20 @@
+"""paddle.quantization parity (python/paddle/quantization/ — unverified):
+QuantConfig + QAT/PTQ over fake-quant simulation.
+
+TPU design: quantization here is *simulated* (fake-quant) — scales are
+learned/observed and quant/dequant round-trips run in the graph with a
+straight-through estimator, exactly the reference's QAT/PTQ training
+semantics. True int8 matmul execution is a deployment-backend concern
+(the reference hands that to TensorRT/Paddle-Lite; this build's analog
+would be XLA int8 dots) and is out of scope — ``convert`` bakes the
+final scales into ObservedLayers so the exported StableHLO carries the
+quant arithmetic explicitly.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import (  # noqa: F401
+    AbsmaxObserver,
+    PerChannelAbsmaxObserver,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401

@@ -1,0 +1,79 @@
+"""Observers: track activation/weight ranges to derive quant scales
+(reference: python/paddle/quantization/observers/abs_max.py et al. —
+unverified)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _ObserverFactory:
+    """Reference API shape: config holds a factory; one instance is
+    materialized per observed tensor via ``_instance()``."""
+
+    def __init__(self, cls, **kw):
+        self._cls = cls
+        self._kw = kw
+
+    def _instance(self):
+        return self._cls(**self._kw)
+
+
+class BaseObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = int(quant_bits)
+        self._qmax = float(2 ** (self.quant_bits - 1) - 1)
+
+    def observe(self, value):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class _AbsmaxObserver(BaseObserver):
+    """Running max of |x| over observed batches -> per-tensor scale."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def observe(self, value):
+        v = np.asarray(value.numpy() if hasattr(value, "numpy") else value)
+        self._absmax = max(self._absmax, float(np.abs(v).max(initial=0.0)))
+
+    def scales(self):
+        return max(self._absmax, 1e-8) / self._qmax
+
+
+class _PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel |w| max (weights; channel axis configurable)."""
+
+    def __init__(self, quant_bits=8, channel_axis=-1):
+        super().__init__(quant_bits)
+        self.channel_axis = channel_axis
+        self._absmax = None
+
+    def observe(self, value):
+        v = np.asarray(value.numpy() if hasattr(value, "numpy") else value)
+        axes = tuple(
+            i for i in range(v.ndim)
+            if i != (self.channel_axis % v.ndim)
+        )
+        cur = np.abs(v).max(axis=axes) if axes else np.abs(v)
+        self._absmax = (
+            cur if self._absmax is None else np.maximum(self._absmax, cur)
+        )
+
+    def scales(self):
+        return np.maximum(self._absmax, 1e-8) / self._qmax
+
+
+def AbsmaxObserver(quant_bits=8):
+    return _ObserverFactory(_AbsmaxObserver, quant_bits=quant_bits)
+
+
+def PerChannelAbsmaxObserver(quant_bits=8, channel_axis=-1):
+    return _ObserverFactory(
+        _PerChannelAbsmaxObserver, quant_bits=quant_bits,
+        channel_axis=channel_axis,
+    )

@@ -1,0 +1,78 @@
+"""PTQ (reference: python/paddle/quantization/ptq.py — unverified):
+insert observers, run calibration batches, freeze scales on convert."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .qat import ObservedLayer, _swap_layers
+
+
+class _ObservingWrapper(Layer):
+    def __init__(self, inner, act_observer=None, weight_observer=None):
+        super().__init__()
+        self._inner = inner
+        self._act_observer = (
+            act_observer._instance() if act_observer is not None else None
+        )
+        self._weight_observer = (
+            weight_observer._instance() if weight_observer is not None
+            else None
+        )
+
+    def forward(self, x, *args, **kw):
+        if self._act_observer is not None:
+            self._act_observer.observe(x)
+        if self._weight_observer is not None and hasattr(
+            self._inner, "weight"
+        ):
+            self._weight_observer.observe(self._inner.weight)
+        return self._inner(x, *args, **kw)
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Insert observers; run calibration data through the returned
+        model, then ``convert``."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            cfg = self._config._config_for(layer)
+            if cfg is None or isinstance(layer, _ObservingWrapper):
+                return None
+            return _ObservingWrapper(
+                layer, cfg.get("activation"), cfg.get("weight")
+            )
+
+        return _swap_layers(model, make)
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            if not isinstance(layer, _ObservingWrapper):
+                return None
+            act_scale = (
+                layer._act_observer.scales()
+                if layer._act_observer is not None else None
+            )
+            w_scale = None
+            bits = 8
+            if layer._weight_observer is not None and hasattr(
+                layer._inner, "weight"
+            ):
+                layer._weight_observer.observe(layer._inner.weight)
+                w_scale = layer._weight_observer.scales()
+                bits = layer._weight_observer.quant_bits
+            if layer._act_observer is not None:
+                bits = layer._act_observer.quant_bits
+            return ObservedLayer(layer._inner, act_scale, w_scale, bits)
+
+        return _swap_layers(model, make)

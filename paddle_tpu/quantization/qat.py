@@ -1,0 +1,135 @@
+"""QAT (reference: python/paddle/quantization/qat.py — unverified):
+wrap target layers so forward applies fake-quant to weights and
+activations; training gradients flow via the STE."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .quanters import fake_quant
+
+
+class QuantedWrapper(Layer):
+    """Wraps one layer: fake-quant input activation + weight, then run
+    the wrapped layer with the quantized weight."""
+
+    def __init__(self, inner, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self._inner = inner
+        self._act_quanter = (
+            act_quanter._instance() if act_quanter is not None else None
+        )
+        self._weight_quanter = (
+            weight_quanter._instance() if weight_quanter is not None
+            else None
+        )
+
+    def forward(self, x, *args, **kw):
+        if self._act_quanter is not None:
+            x = self._act_quanter(x)
+        wq = self._weight_quanter
+        if wq is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            if hasattr(wq, "observe"):  # observer-style (per-channel etc.)
+                wq.observe(w)
+                wfq = fake_quant(w, wq.scales(), wq.quant_bits)
+            else:  # quanter-style (moving-average fake quanter)
+                wfq = wq(w)
+            orig = w
+            try:
+                object.__setattr__(self._inner, "weight", wfq)
+                return self._inner(x, *args, **kw)
+            finally:
+                object.__setattr__(self._inner, "weight", orig)
+        return self._inner(x, *args, **kw)
+
+
+class ObservedLayer(Layer):
+    """Post-convert layer: quant arithmetic with FROZEN scales baked in
+    (what jit.save exports)."""
+
+    def __init__(self, inner, act_scale, weight_scale, quant_bits=8):
+        super().__init__()
+        self._inner = inner
+        self.act_scale = act_scale
+        self.weight_scale = weight_scale
+        self.quant_bits = quant_bits
+
+    def forward(self, x, *args, **kw):
+        if self.act_scale is not None:
+            x = fake_quant(x, self.act_scale, self.quant_bits)
+        if self.weight_scale is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            orig = w
+            try:
+                object.__setattr__(
+                    self._inner, "weight",
+                    fake_quant(w, self.weight_scale, self.quant_bits),
+                )
+                return self._inner(x, *args, **kw)
+            finally:
+                object.__setattr__(self._inner, "weight", orig)
+        return self._inner(x, *args, **kw)
+
+
+def _swap_layers(model, make):
+    """Replace matching sublayers in place (reference quantize walks
+    and replaces named children)."""
+    for name, child in list(model._sub_layers.items()):
+        replacement = make(child)
+        if replacement is not None:
+            model._sub_layers[name] = replacement
+        else:
+            _swap_layers(child, make)
+    return model
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            cfg = self._config._config_for(layer)
+            if cfg is None or isinstance(layer, QuantedWrapper):
+                return None
+            return QuantedWrapper(
+                layer, cfg.get("activation"), cfg.get("weight")
+            )
+
+        return _swap_layers(model, make)
+
+    def convert(self, model, inplace=False):
+        """Freeze the learned scales into ObservedLayers."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+
+        def make(layer):
+            if not isinstance(layer, QuantedWrapper):
+                return None
+            aq = layer._act_quanter
+            act_scale = (
+                (aq.scales() if hasattr(aq, "observe") else aq.scale())
+                if aq is not None else None
+            )
+            w_scale = None
+            bits = 8
+            wq = layer._weight_quanter
+            if wq is not None and hasattr(layer._inner, "weight"):
+                if hasattr(wq, "observe"):
+                    wq.observe(layer._inner.weight)
+                    w_scale = wq.scales()
+                else:
+                    wq(layer._inner.weight)
+                    w_scale = wq.scale()
+                bits = wq.quant_bits
+            if aq is not None:
+                bits = aq.quant_bits
+            return ObservedLayer(layer._inner, act_scale, w_scale, bits)
+
+        return _swap_layers(model, make)

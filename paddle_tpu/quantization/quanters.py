@@ -1,0 +1,65 @@
+"""Fake quanters: quant->round->dequant in the graph with a
+straight-through estimator (reference: python/paddle/quantization/
+quanters/abs_max.py FakeQuanterWithAbsMaxObserver — unverified)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from .observers import _ObserverFactory
+
+
+def _fake_quant(x, scale, *, qmax):
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    dq = q * scale
+    # straight-through estimator: forward = dq, gradient = identity
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+def fake_quant(x, scale, quant_bits=8):
+    """Public helper: simulate b-bit symmetric quantization of x."""
+    from ..core.tensor import Tensor
+
+    if not isinstance(scale, Tensor):
+        scale = Tensor(jnp.asarray(scale, jnp.float32))
+    return dispatch.apply(
+        "fake_quant", _fake_quant, (x, scale),
+        {"qmax": float(2 ** (int(quant_bits) - 1) - 1)},
+    )
+
+
+class _FakeQuanter:
+    """Moving-average absmax scale + STE fake quant (QAT activation
+    quanter). Stateful like the reference (the scale is part of the
+    layer's quant state)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = int(quant_bits)
+        self._qmax = float(2 ** (self.quant_bits - 1) - 1)
+        self.moving_rate = float(moving_rate)
+        self._state = 0.0
+        self._initialized = False
+
+    def scale(self):
+        return max(self._state, 1e-8) / self._qmax
+
+    def __call__(self, x):
+        import numpy as np
+
+        cur = float(np.abs(np.asarray(x.numpy())).max(initial=0.0))
+        if not self._initialized:
+            self._state = cur
+            self._initialized = True
+        else:
+            self._state = (
+                self.moving_rate * self._state
+                + (1.0 - self.moving_rate) * cur
+            )
+        return fake_quant(x, self.scale(), self.quant_bits)
+
+
+def FakeQuanterWithAbsMaxObserver(quant_bits=8, moving_rate=0.9):
+    return _ObserverFactory(
+        _FakeQuanter, quant_bits=quant_bits, moving_rate=moving_rate
+    )

@@ -210,3 +210,39 @@ def test_send_u_recv_out_size():
     assert (out.numpy()[2:] == 0).all()
     with pytest.raises(ValueError):
         paddle.geometric.send_u_recv(x, src, dst, "prod")
+
+
+def test_random_fillers_keyword_calls():
+    paddle.seed(9)
+    x = T(np.zeros(3000, np.float32))
+    x.uniform_(min=0.0, max=2.0)
+    assert x.numpy().min() >= 0 and 0.9 < x.numpy().mean() < 1.1
+    x.normal_(mean=4.0, std=0.25)
+    assert abs(x.numpy().mean() - 4.0) < 0.05
+    x.normal_(2.0, std=0.5)  # mixed positional+keyword
+    assert abs(x.numpy().mean() - 2.0) < 0.1
+    with pytest.raises(TypeError):
+        x.normal_(1.0, mean=2.0)
+    with pytest.raises(TypeError):
+        x.uniform_(bogus=1.0)
+
+
+def test_fill_diagonal_wrap_and_hyperdiag():
+    x = T(np.zeros((6, 2), np.float32))
+    x.fill_diagonal_(1.0, wrap=True)
+    gold = np.zeros((6, 2), np.float32)
+    np.fill_diagonal(gold, 1.0, wrap=True)
+    np.testing.assert_array_equal(x.numpy(), gold)
+    x3 = T(np.zeros((3, 3, 3), np.float32))
+    x3.fill_diagonal_(1.0)
+    gold3 = np.zeros((3, 3, 3), np.float32)
+    np.fill_diagonal(gold3, 1.0)  # numpy: main hyper-diagonal
+    np.testing.assert_array_equal(x3.numpy(), gold3)
+
+
+def test_histogram_bin_edges_degenerate_range():
+    out = paddle.histogram_bin_edges(
+        T(np.array([5.0, 5.0], np.float32)), bins=4
+    ).numpy()
+    gold = np.histogram_bin_edges(np.array([5.0, 5.0]), 4)
+    np.testing.assert_allclose(out, gold, atol=1e-6)
