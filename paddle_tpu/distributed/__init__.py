@@ -27,18 +27,24 @@ from .communication import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    broadcast_object_list,
     destroy_process_group,
     gather,
+    get_backend,
     get_group,
+    irecv,
     is_initialized,
+    isend,
     new_group,
     recv,
     reduce,
     reduce_scatter,
     scatter,
+    scatter_object_list,
     send,
     wait,
 )
+from . import stream  # noqa: F401
 from .env import (  # noqa: F401
     get_current_endpoint,
     get_trainer_endpoints,

@@ -136,3 +136,46 @@ def is_initialized():
     from .parallel import _PARALLEL_ENV
 
     return _PARALLEL_ENV["initialized"]
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """In-place: rank src's objects replace everyone's list contents
+    (reference contract). Single-process groups are a no-op."""
+    g = _g(group)
+    if g.nranks == 1:
+        return
+    gathered = []
+    all_gather_object(gathered, list(object_list), group)
+    src_objs = gathered[g.get_group_rank(src)]
+    object_list[:] = src_objs
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives its slot of rank src's list."""
+    g = _g(group)
+    if g.nranks == 1:
+        out_object_list[:] = [
+            (in_object_list or [None])[0]
+        ]
+        return
+    gathered = []
+    all_gather_object(gathered, in_object_list or [], group)
+    src_objs = gathered[g.get_group_rank(src)]
+    out_object_list[:] = [src_objs[g.rank]]
+
+
+def get_backend(group=None):
+    """The collective backend name; ICI/XLA collectives here (the
+    reference returns NCCL/GLOO)."""
+    return "XCCL_TPU"
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send: returns the task handle (reference returns a task
+    whose wait() blocks)."""
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)

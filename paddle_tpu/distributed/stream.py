@@ -1,0 +1,17 @@
+"""paddle.distributed.stream parity (python/paddle/distributed/
+communication/stream/ — unverified): the reference's stream-scoped
+collective variants. XLA owns stream scheduling on TPU, so these are
+the same collectives with ``sync_op``/``use_calc_stream`` accepted for
+signature parity (async tasks are returned when sync_op=False)."""
+from .communication import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    alltoall,
+    broadcast,
+    gather,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)

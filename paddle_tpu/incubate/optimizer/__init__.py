@@ -84,13 +84,34 @@ class ModelAverage:
         self._count += 1
 
     def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged weights. Usable as a context manager
+        (``with avg.apply(): evaluate()``) which restores on exit when
+        need_restore is True; double-apply without restore is rejected
+        (it would back up the averaged weights and lose the trained
+        ones)."""
+        import contextlib
+
         import jax.numpy as jnp
 
-        if not self._count:
-            return
-        self._backup = [jnp.array(p.value, copy=True) for p in self._params]
-        for p, s in zip(self._params, self._sums):
-            p.set_value(s / float(self._count))
+        @contextlib.contextmanager
+        def _ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        if self._count:
+            if self._backup is not None:
+                raise RuntimeError(
+                    "ModelAverage.apply called twice without restore()"
+                )
+            self._backup = [
+                jnp.array(p.value, copy=True) for p in self._params
+            ]
+            for p, s in zip(self._params, self._sums):
+                p.set_value(s / float(self._count))
+        return _ctx()
 
     def restore(self, executor=None):
         if self._backup is None:

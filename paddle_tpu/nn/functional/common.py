@@ -337,3 +337,70 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
         "pairwise_distance", _pairwise_distance, (x, y),
         {"p": float(p), "eps": float(epsilon), "keepdim": bool(keepdim)},
     )
+
+
+def _sequence_mask(lens, *, maxlen, dt):
+    return (
+        jnp.arange(maxlen)[None, :] < lens.reshape(-1, 1)
+    ).astype(dt).reshape(tuple(lens.shape) + (maxlen,))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtypes import convert_dtype
+
+    if maxlen is None:
+        import numpy as _np
+
+        maxlen = int(_np.asarray(x.numpy()).max())
+    return dispatch.apply(
+        "sequence_mask", _sequence_mask, (x,),
+        {"maxlen": int(maxlen), "dt": jnp.dtype(convert_dtype(dtype))},
+    )
+
+
+def _zeropad2d(x, *, padding, nchw):
+    l, r, t, b = padding
+    cfg = (
+        [(0, 0), (0, 0), (t, b), (l, r)] if nchw
+        else [(0, 0), (t, b), (l, r), (0, 0)]
+    )
+    return jnp.pad(x, cfg)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops._helpers import static_int_list
+
+    pad4 = static_int_list(padding)
+    if isinstance(pad4, int):
+        pad4 = (pad4,) * 4
+    return dispatch.apply(
+        "zeropad2d", _zeropad2d, (x,),
+        {"padding": tuple(pad4), "nchw": data_format == "NCHW"},
+    )
+
+
+def _gather_tree(ids, parents):
+    # ids/parents: [max_time, batch, beam]; walk parents backward from
+    # the last step reconstructing each beam's token path
+    T_, B, W = ids.shape
+
+    def step(beams, inputs):
+        ids_t, parents_t = inputs  # [B, W]
+        tokens = jnp.take_along_axis(ids_t, beams, axis=1)
+        next_beams = jnp.take_along_axis(parents_t, beams, axis=1)
+        return next_beams, tokens
+
+    init = jnp.broadcast_to(jnp.arange(W, dtype=parents.dtype), (B, W))
+    _, toks = jax.lax.scan(
+        step, init,
+        (jnp.flip(ids, 0), jnp.flip(parents, 0)),
+    )
+    return jnp.flip(toks, 0)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference gather_tree): follow parent
+    pointers from the final step to emit each beam's full token path."""
+    return dispatch.apply(
+        "gather_tree", _gather_tree, (ids, parents), nondiff=True
+    )

@@ -495,3 +495,41 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         {"blank": int(blank), "reduction": reduction,
          "norm_by_times": bool(norm_by_times)},
     )
+
+
+def _dice_loss(x, lbl, *, eps):
+    # x [N, ..., C] probabilities, lbl [N, ..., 1] int class ids
+    lbl_onehot = jax.nn.one_hot(lbl[..., 0], x.shape[-1], dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * lbl_onehot, axis=reduce_dims)
+    union = (
+        jnp.sum(x, axis=reduce_dims) + jnp.sum(lbl_onehot, axis=reduce_dims)
+    )
+    return 1.0 - (inter + eps) / (union + eps)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return dispatch.apply(
+        "dice_loss", _dice_loss, (input, label), {"eps": float(epsilon)}
+    )
+
+
+def _npair_loss(anchor, positive, labels, *, l2_reg):
+    # cross-entropy over anchor @ positive^T with same-label targets
+    sim = jnp.matmul(anchor, positive.T)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    xent = jnp.mean(-jnp.sum(targets * logp, axis=1))
+    reg = l2_reg * 0.25 * (
+        jnp.mean(jnp.sum(jnp.square(anchor), 1))
+        + jnp.mean(jnp.sum(jnp.square(positive), 1))
+    )
+    return xent + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    return dispatch.apply(
+        "npair_loss", _npair_loss, (anchor, positive, labels),
+        {"l2_reg": float(l2_reg)},
+    )

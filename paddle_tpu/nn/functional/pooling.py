@@ -76,6 +76,12 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("return_mask=True supports NCHW only")
+        return max_pool2d_with_mask(
+            x, kernel_size, stride, padding, ceil_mode
+        )
     return _pool_entry(_max_pool, x, 2, kernel_size, stride, padding, data_format,
                        dict(ceil_mode=bool(ceil_mode)))
 
@@ -187,4 +193,93 @@ def _adaptive_entry(x, nd, output_size, data_format, op):
         _adaptive_pool,
         (x,),
         {"nd": nd, "out_sizes": out, "channel_last": channel_last, "op": op},
+    )
+
+
+def _max_pool2d_with_mask(x, *, k, s, pad, ceil_mode):
+    """Max pool that also returns the argmax flat index (per-channel
+    H*W offset) — the reference's return_mask contract, consumed by
+    max_unpool2d. Patches come from dtype-preserving strided slices and
+    the flat index is reconstructed with exact integer arithmetic (no
+    float32 index round-trip)."""
+    n, c, h, w = x.shape
+    padding = _full_pad(2, pad, False, x, k, s, ceil_mode)
+    neg = (
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    (ph0, ph1), (pw0, pw1) = padding[2], padding[3]
+    xp = jnp.pad(
+        x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)),
+        constant_values=neg,
+    )
+    kh, kw = k
+    hp, wp = xp.shape[2], xp.shape[3]
+    oh = (hp - kh) // s[0] + 1
+    ow = (wp - kw) // s[1] + 1
+    taps = [
+        xp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]]
+        for i in range(kh) for j in range(kw)
+    ]
+    xpat = jnp.stack(taps, axis=2)  # [N, C, kh*kw, oh, ow], input dtype
+    am = jnp.argmax(xpat, axis=2)  # first-max tie-break, torch parity
+    out = jnp.max(xpat, axis=2)
+    # tap t at output (oy, ox) reads input (oy*s0 - ph0 + t//kw,
+    # ox*s1 - pw0 + t%kw); flat per-channel index = iy*w + ix
+    oy = jnp.arange(oh)[:, None]
+    ox = jnp.arange(ow)[None, :]
+    iy = oy * s[0] - ph0 + am // kw
+    ix = ox * s[1] - pw0 + am % kw
+    mask = (iy * w + ix).astype(jnp.int32)
+    return out, mask
+
+
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0,
+                         ceil_mode=False, name=None):
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    pad = _conv_padding(padding, 2)
+    if isinstance(pad, str):
+        raise ValueError(
+            "max_pool2d(return_mask=True) needs explicit int padding"
+        )
+    return dispatch.apply(
+        "max_pool2d_mask", _max_pool2d_with_mask, (x,),
+        {"k": k, "s": s, "pad": pad, "ceil_mode": bool(ceil_mode)},
+    )
+
+
+def _max_unpool2d(x, mask, *, out_hw):
+    n, c, oh, ow = x.shape
+    h, w = out_hw
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    midx = mask.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(lambda f, m, v: f.at[m].set(v)))(
+        flat, midx, vals
+    )
+    return flat.reshape(n, c, h, w)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to their argmax positions (zeros
+    elsewhere); inverse of max_pool2d(return_mask=True)."""
+    k = _tuplize(kernel_size, 2)
+    s = _tuplize(stride if stride is not None else kernel_size, 2)
+    if output_size is not None:
+        from ...ops._helpers import static_int_list
+
+        osz = tuple(static_int_list(output_size))[-2:]
+    else:
+        oh, ow = int(x.shape[-2]), int(x.shape[-1])
+        p = _conv_padding(padding, 2)
+        ph = p[0][0] if not isinstance(p, str) else 0
+        pw = p[1][0] if not isinstance(p, str) else 0
+        osz = (
+            (oh - 1) * s[0] - 2 * ph + k[0],
+            (ow - 1) * s[1] - 2 * pw + k[1],
+        )
+    return dispatch.apply(
+        "max_unpool2d", _max_unpool2d, (x, indices), {"out_hw": osz}
     )

@@ -1,0 +1,118 @@
+"""Vision functionals: affine_grid / grid_sample / temporal_shift
+(reference: python/paddle/nn/functional/vision.py — unverified).
+
+grid_sample is a bilinear/nearest gather — XLA lowers it to gathers +
+fused arithmetic; no dynamic shapes."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...ops.tail import temporal_shift  # noqa: F401  (re-export)
+
+
+def _affine_grid(theta, *, size, align_corners):
+    n, _, h, w = size
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2.0 + 1.0) / h - 1.0
+        xs = (jnp.arange(w) * 2.0 + 1.0) / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [H*W, 3]
+    out = jnp.einsum("nij,pj->npi", theta.astype(base.dtype), base)
+    return out.reshape(n, h, w, 2)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (x, y order)."""
+    from ...ops._helpers import static_int_list
+
+    size = tuple(static_int_list(out_shape))
+    if len(size) != 4:
+        raise ValueError(f"affine_grid expects NCHW out_shape, got {size}")
+    return dispatch.apply(
+        "affine_grid", _affine_grid, (theta,),
+        {"size": size, "align_corners": bool(align_corners)},
+    )
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect_coord(v, size, align_corners):
+    """Reflect a continuous coordinate into the valid range: around
+    pixel centers [0, size-1] (align_corners) or the pixel-edge box
+    [-0.5, size-0.5] (torch/paddle convention)."""
+    if size == 1:
+        return jnp.zeros_like(v)
+    lo = 0.0 if align_corners else -0.5
+    hi = (size - 1.0) if align_corners else (size - 0.5)
+    period = 2.0 * (hi - lo)
+    vf = (v - lo) % period
+    vf = jnp.minimum(vf, period - vf) + lo
+    return jnp.clip(vf, 0.0, size - 1.0)
+
+
+def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx = _unnormalize(grid[..., 0], w, align_corners)  # [N, Hg, Wg]
+    gy = _unnormalize(grid[..., 1], h, align_corners)
+    if padding_mode == "reflection":
+        # reflect the CONTINUOUS coordinate, then sample border-style
+        gx = _reflect_coord(gx, w, align_corners)
+        gy = _reflect_coord(gy, h, align_corners)
+
+    def pixel(img, iy, ix):
+        # img [C, H, W]; iy/ix int grids
+        if padding_mode in ("border", "reflection"):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            return img[:, iyc, ixc]
+        # zeros
+        inb = (iy >= 0) & (iy <= h - 1) & (ix >= 0) & (ix <= w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        ixc = jnp.clip(ix, 0, w - 1)
+        return img[:, iyc, ixc] * inb.astype(img.dtype)
+
+    def sample_one(img, sy, sx):
+        if mode == "nearest":
+            return pixel(
+                img, jnp.round(sy).astype(jnp.int32),
+                jnp.round(sx).astype(jnp.int32),
+            )
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy1 = (sy - y0).astype(img.dtype)
+        wx1 = (sx - x0).astype(img.dtype)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        return (
+            pixel(img, y0i, x0i) * (1 - wy1) * (1 - wx1)
+            + pixel(img, y0i, x0i + 1) * (1 - wy1) * wx1
+            + pixel(img, y0i + 1, x0i) * wy1 * (1 - wx1)
+            + pixel(img, y0i + 1, x0i + 1) * wy1 * wx1
+        )
+
+    return jax.vmap(sample_one)(x, gy, gx)  # [N, C, Hg, Wg]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample: unsupported padding_mode {padding_mode!r}"
+        )
+    return dispatch.apply(
+        "grid_sample", _grid_sample, (x, grid),
+        {"mode": mode, "padding_mode": padding_mode,
+         "align_corners": bool(align_corners)},
+    )

@@ -3,7 +3,12 @@ insert observers, run calibration batches, freeze scales on convert."""
 from __future__ import annotations
 
 from ..nn.layer.layers import Layer
-from .qat import ObservedLayer, _swap_layers
+from .qat import (
+    ObservedLayer,
+    _is_quant_layer,
+    _resolve_then_copy,
+    _swap_layers,
+)
 
 
 class _ObservingWrapper(Layer):
@@ -35,14 +40,11 @@ class PTQ:
     def quantize(self, model, inplace=False):
         """Insert observers; run calibration data through the returned
         model, then ``convert``."""
-        if not inplace:
-            import copy
-
-            model = copy.deepcopy(model)
+        model, by_id = _resolve_then_copy(model, self._config, inplace)
 
         def make(layer):
-            cfg = self._config._config_for(layer)
-            if cfg is None or isinstance(layer, _ObservingWrapper):
+            cfg = by_id.get(id(layer))
+            if cfg is None or _is_quant_layer(layer):
                 return None
             return _ObservingWrapper(
                 layer, cfg.get("activation"), cfg.get("weight")
@@ -59,20 +61,21 @@ class PTQ:
         def make(layer):
             if not isinstance(layer, _ObservingWrapper):
                 return None
-            act_scale = (
-                layer._act_observer.scales()
-                if layer._act_observer is not None else None
-            )
+            act_scale = None
+            act_bits = 8
+            if layer._act_observer is not None:
+                act_scale = layer._act_observer.scales()
+                act_bits = layer._act_observer.quant_bits
             w_scale = None
-            bits = 8
+            w_bits = 8
             if layer._weight_observer is not None and hasattr(
                 layer._inner, "weight"
             ):
                 layer._weight_observer.observe(layer._inner.weight)
                 w_scale = layer._weight_observer.scales()
-                bits = layer._weight_observer.quant_bits
-            if layer._act_observer is not None:
-                bits = layer._act_observer.quant_bits
-            return ObservedLayer(layer._inner, act_scale, w_scale, bits)
+                w_bits = layer._weight_observer.quant_bits
+            return ObservedLayer(
+                layer._inner, act_scale, w_scale, act_bits, w_bits
+            )
 
         return _swap_layers(model, make)

@@ -44,25 +44,28 @@ class QuantedWrapper(Layer):
 
 class ObservedLayer(Layer):
     """Post-convert layer: quant arithmetic with FROZEN scales baked in
-    (what jit.save exports)."""
+    (what jit.save exports). Activation and weight bit widths are
+    tracked separately (they may differ per config)."""
 
-    def __init__(self, inner, act_scale, weight_scale, quant_bits=8):
+    def __init__(self, inner, act_scale, weight_scale, act_bits=8,
+                 weight_bits=8):
         super().__init__()
         self._inner = inner
         self.act_scale = act_scale
         self.weight_scale = weight_scale
-        self.quant_bits = quant_bits
+        self.act_bits = act_bits
+        self.weight_bits = weight_bits
 
     def forward(self, x, *args, **kw):
         if self.act_scale is not None:
-            x = fake_quant(x, self.act_scale, self.quant_bits)
+            x = fake_quant(x, self.act_scale, self.act_bits)
         if self.weight_scale is not None and hasattr(self._inner, "weight"):
             w = self._inner.weight
             orig = w
             try:
                 object.__setattr__(
                     self._inner, "weight",
-                    fake_quant(w, self.weight_scale, self.quant_bits),
+                    fake_quant(w, self.weight_scale, self.weight_bits),
                 )
                 return self._inner(x, *args, **kw)
             finally:
@@ -70,16 +73,58 @@ class ObservedLayer(Layer):
         return self._inner(x, *args, **kw)
 
 
+# layers the walker must never descend into (their _inner would be
+# matched and double-wrapped)
+def _is_quant_layer(layer):
+    return isinstance(layer, (QuantedWrapper, ObservedLayer)) or (
+        type(layer).__name__ == "_ObservingWrapper"
+    )
+
+
 def _swap_layers(model, make):
     """Replace matching sublayers in place (reference quantize walks
-    and replaces named children)."""
+    and replaces named children). Does not recurse into already-
+    quantized wrappers."""
     for name, child in list(model._sub_layers.items()):
         replacement = make(child)
         if replacement is not None:
             model._sub_layers[name] = replacement
-        else:
+        elif not _is_quant_layer(child):
             _swap_layers(child, make)
     return model
+
+
+def _named_paths(model, prefix=""):
+    for name, child in model._sub_layers.items():
+        path = f"{prefix}.{name}" if prefix else name
+        yield path, child
+        if not _is_quant_layer(child):
+            yield from _named_paths(child, path)
+
+
+def _layer_by_path(model, path):
+    cur = model
+    for part in path.split("."):
+        cur = cur._sub_layers[part]
+    return cur
+
+
+def _resolve_then_copy(model, config, inplace):
+    """Resolve per-layer configs on the ORIGINAL model (so id()-based
+    add_layer_config overrides survive deepcopy), then copy."""
+    resolved = {
+        path: config._config_for(layer)
+        for path, layer in _named_paths(model)
+    }
+    if not inplace:
+        import copy
+
+        model = copy.deepcopy(model)
+    by_id = {
+        id(_layer_by_path(model, path)): cfg
+        for path, cfg in resolved.items()
+    }
+    return model, by_id
 
 
 class QAT:
@@ -87,14 +132,11 @@ class QAT:
         self._config = config
 
     def quantize(self, model, inplace=False):
-        if not inplace:
-            import copy
-
-            model = copy.deepcopy(model)
+        model, by_id = _resolve_then_copy(model, self._config, inplace)
 
         def make(layer):
-            cfg = self._config._config_for(layer)
-            if cfg is None or isinstance(layer, QuantedWrapper):
+            cfg = by_id.get(id(layer))
+            if cfg is None or _is_quant_layer(layer):
                 return None
             return QuantedWrapper(
                 layer, cfg.get("activation"), cfg.get("weight")
@@ -113,23 +155,35 @@ class QAT:
             if not isinstance(layer, QuantedWrapper):
                 return None
             aq = layer._act_quanter
-            act_scale = (
-                (aq.scales() if hasattr(aq, "observe") else aq.scale())
-                if aq is not None else None
-            )
+            act_scale = None
+            act_bits = 8
+            if aq is not None:
+                act_scale = (
+                    aq.scales() if hasattr(aq, "observe") else aq.scale()
+                )
+                act_bits = aq.quant_bits
             w_scale = None
-            bits = 8
+            w_bits = 8
             wq = layer._weight_quanter
             if wq is not None and hasattr(layer._inner, "weight"):
                 if hasattr(wq, "observe"):
                     wq.observe(layer._inner.weight)
                     w_scale = wq.scales()
-                else:
-                    wq(layer._inner.weight)
+                elif wq._initialized:
+                    # freeze the TRAINED moving-average scale; do not
+                    # run another EMA update here
                     w_scale = wq.scale()
-                bits = wq.quant_bits
-            if aq is not None:
-                bits = aq.quant_bits
-            return ObservedLayer(layer._inner, act_scale, w_scale, bits)
+                else:
+                    import numpy as _np
+
+                    wq._state = float(_np.abs(
+                        _np.asarray(layer._inner.weight.numpy())
+                    ).max(initial=0.0))
+                    wq._initialized = True
+                    w_scale = wq.scale()
+                w_bits = wq.quant_bits
+            return ObservedLayer(
+                layer._inner, act_scale, w_scale, act_bits, w_bits
+            )
 
         return _swap_layers(model, make)

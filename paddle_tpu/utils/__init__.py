@@ -1,4 +1,5 @@
 """paddle.utils parity surface + framework utilities."""
+from . import dlpack  # noqa: F401
 from . import flags  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 
@@ -22,5 +23,74 @@ def run_check():
     y = paddle.matmul(x, x)
     assert np.allclose(y.numpy(), 2 * np.ones((2, 2)))
     dev = paddle.get_device()
-    print(f"paddle_tpu is installed successfully! device={dev}")
+    print(f"paddle_tpu is installed successfully and works fine on {dev}.")
     return True
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator: warn (level<=1) or raise (level==2) on call
+    (reference: python/paddle/utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            msg = (
+                f"API {fn.__module__}.{fn.__name__} is deprecated"
+                + (f" since {since}" if since else "")
+                + (f", use {update_to} instead" if update_to else "")
+                + (f". Reason: {reason}" if reason else "")
+            )
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kw)
+
+        return wrapper
+
+    return decorator
+
+
+class _UniqueNameGenerator:
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._counters = {}
+
+    def __call__(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{self._prefix}{key}_{n}"
+
+
+class _UniqueNameModule:
+    """paddle.utils.unique_name parity: generate/guard/switch."""
+
+    def __init__(self):
+        self._gen = _UniqueNameGenerator()
+
+    def generate(self, key="tmp"):
+        return self._gen(key)
+
+    def switch(self, new_generator=None):
+        old = self._gen
+        if isinstance(new_generator, str):  # reference: str prefix
+            new_generator = _UniqueNameGenerator(new_generator)
+        self._gen = new_generator or _UniqueNameGenerator()
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            old = self.switch(new_generator)
+            try:
+                yield
+            finally:
+                self._gen = old
+
+        return _guard()
+
+
+unique_name = _UniqueNameModule()

@@ -1,0 +1,25 @@
+"""paddle.utils.dlpack parity (python/paddle/utils/dlpack.py —
+unverified): zero-copy tensor exchange via the DLPack protocol, backed
+by jax's dlpack bridge.
+
+Modern DLPack is capsule-less: ``to_dlpack`` returns a protocol object
+(implements ``__dlpack__``/``__dlpack_device__``) that torch/numpy/cupy
+``from_dlpack`` consume directly; ``from_dlpack`` accepts any such
+provider (e.g. a torch tensor)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack provider object."""
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def from_dlpack(dlpack):
+    """DLPack provider (anything with __dlpack__, e.g. a torch tensor
+    or the result of to_dlpack) -> Tensor."""
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

@@ -1,0 +1,195 @@
+"""Tail nn functionals: grid_sample/affine_grid (torch-parity, all
+mode/padding/align combos), max-pool masks + unpool, sequence_mask,
+zeropad2d, gather_tree, dice/npair losses."""
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(8)
+X = RNG.randn(2, 3, 5, 6).astype(np.float32)
+GRID = (RNG.rand(2, 4, 7, 2).astype(np.float32) * 2 - 1) * 1.6
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_affine_grid_vs_torch(align_corners):
+    theta = (
+        RNG.randn(2, 2, 3).astype(np.float32) * 0.3
+        + np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    )
+    mine = F.affine_grid(
+        T(theta), [2, 3, 5, 6], align_corners=align_corners
+    ).numpy()
+    gold = torch.nn.functional.affine_grid(
+        torch.tensor(theta), [2, 3, 5, 6], align_corners=align_corners
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("padding_mode", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align_corners", [True, False])
+def test_grid_sample_vs_torch(mode, padding_mode, align_corners):
+    mine = F.grid_sample(
+        T(X), T(GRID), mode, padding_mode, align_corners
+    ).numpy()
+    gold = torch.nn.functional.grid_sample(
+        torch.tensor(X), torch.tensor(GRID), mode, padding_mode,
+        align_corners,
+    ).numpy()
+    np.testing.assert_allclose(mine, gold, rtol=1e-4, atol=1e-4)
+
+
+def test_grid_sample_grad_flows():
+    x = T(X)
+    x.stop_gradient = False
+    F.grid_sample(x, T(GRID)).sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    with pytest.raises(ValueError):
+        F.grid_sample(T(X), T(GRID), mode="bicubic")
+
+
+def test_max_pool_mask_and_unpool_vs_torch():
+    xin = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(T(xin), 2, 2, return_mask=True)
+    tout, tmask = torch.nn.functional.max_pool2d(
+        torch.tensor(xin), 2, 2, return_indices=True
+    )
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tmask.numpy())
+    unp = F.max_unpool2d(out, mask, 2, 2)
+    tunp = torch.nn.functional.max_unpool2d(tout, tmask, 2, 2)
+    np.testing.assert_allclose(unp.numpy(), tunp.numpy(), atol=1e-6)
+    # non-square kernel + stride
+    out2, mask2 = F.max_pool2d(T(xin), (2, 4), (2, 4), return_mask=True)
+    tout2, tmask2 = torch.nn.functional.max_pool2d(
+        torch.tensor(xin), (2, 4), (2, 4), return_indices=True
+    )
+    np.testing.assert_array_equal(mask2.numpy(), tmask2.numpy())
+
+
+def test_sequence_mask():
+    lens = T(np.array([2, 0, 4], np.int64))
+    gold = np.array(
+        [[1, 1, 0, 0, 0], [0, 0, 0, 0, 0], [1, 1, 1, 1, 0]], np.int64
+    )
+    np.testing.assert_array_equal(
+        F.sequence_mask(lens, maxlen=5).numpy(), gold
+    )
+    assert tuple(F.sequence_mask(lens).shape) == (3, 4)  # inferred
+    f32 = F.sequence_mask(lens, maxlen=5, dtype="float32")
+    assert f32.numpy().dtype == np.float32
+
+
+def test_zeropad2d():
+    zp = F.zeropad2d(T(X), [1, 2, 3, 4])
+    assert tuple(zp.shape) == (2, 3, 5 + 3 + 4, 6 + 1 + 2)
+    np.testing.assert_array_equal(zp.numpy()[:, :, 3:8, 1:7], X)
+    assert zp.numpy()[:, :, :3].sum() == 0
+
+
+def test_gather_tree():
+    ids = RNG.randint(0, 9, (4, 2, 3)).astype(np.int64)
+    parents = RNG.randint(0, 3, (4, 2, 3)).astype(np.int64)
+
+    def ref(ids, parents):
+        T_, B, W = ids.shape
+        out = np.zeros_like(ids)
+        for b in range(B):
+            for w in range(W):
+                beam = w
+                for t in range(T_ - 1, -1, -1):
+                    out[t, b, w] = ids[t, b, beam]
+                    beam = parents[t, b, beam]
+        return out
+
+    np.testing.assert_array_equal(
+        F.gather_tree(T(ids), T(parents)).numpy(), ref(ids, parents)
+    )
+
+
+def test_dice_and_npair_losses():
+    probs = np.asarray(
+        jax.nn.softmax(jnp.asarray(RNG.randn(4, 10, 3)), -1),
+        np.float32,
+    )
+    lbl = RNG.randint(0, 3, (4, 10, 1)).astype(np.int64)
+    dl = F.dice_loss(T(probs), T(lbl))
+    assert tuple(dl.shape) == (4,)
+    assert ((dl.numpy() >= 0) & (dl.numpy() <= 1)).all()
+    # perfect prediction -> ~0 loss
+    onehot = np.eye(3, dtype=np.float32)[lbl[..., 0]]
+    np.testing.assert_allclose(
+        F.dice_loss(T(onehot), T(lbl)).numpy(), 0.0, atol=1e-4
+    )
+    anchor = RNG.randn(6, 8).astype(np.float32)
+    pos = RNG.randn(6, 8).astype(np.float32)
+    labels = RNG.randint(0, 3, 6).astype(np.int64)
+    a = T(anchor)
+    a.stop_gradient = False
+    loss = F.npair_loss(a, T(pos), T(labels))
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+    assert np.isfinite(a.grad.numpy()).all()
+
+
+def test_temporal_shift_reexport():
+    assert F.temporal_shift is paddle.temporal_shift
+
+
+def test_utils_dlpack_and_helpers():
+    import contextlib
+    import io as pyio
+    import warnings
+
+    x = T(np.arange(6, dtype=np.float32).reshape(2, 3))
+    back = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(x))
+    np.testing.assert_array_equal(back.numpy(), x.numpy())
+    tt = torch.arange(4, dtype=torch.float32)
+    np.testing.assert_array_equal(
+        paddle.utils.dlpack.from_dlpack(tt).numpy(), tt.numpy()
+    )
+    np.testing.assert_array_equal(
+        torch.from_dlpack(paddle.utils.dlpack.to_dlpack(x)).numpy(),
+        x.numpy(),
+    )
+
+    @paddle.utils.deprecated(update_to="paddle.new", since="2.0")
+    def old():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 7
+        assert len(w) == 1 and "deprecated" in str(w[0].message)
+
+    with paddle.utils.unique_name.guard():
+        assert paddle.utils.unique_name.generate("zz") == "zz_0"
+        assert paddle.utils.unique_name.generate("zz") == "zz_1"
+    buf = pyio.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert paddle.utils.run_check()
+
+
+def test_dist_object_collectives_single_process():
+    import paddle_tpu.distributed as dist
+
+    objs = ["hello", 123]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs == ["hello", 123]
+    out = []
+    dist.scatter_object_list(out, ["mine"], src=0)
+    assert out == ["mine"]
+    assert isinstance(dist.get_backend(), str)
+    assert hasattr(dist.stream, "all_reduce")
+    assert callable(dist.isend) and callable(dist.irecv)

@@ -165,3 +165,70 @@ def test_lookahead_and_model_average():
     assert not np.allclose(before, after_apply)  # averaged weights differ
     avg.restore()
     np.testing.assert_allclose(np.asarray(lin.weight.numpy()), before)
+
+
+def test_qat_layer_override_survives_deepcopy():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 4), paddle.nn.Linear(4, 4)
+    )
+    cfg = QuantConfig()
+    cfg.add_type_config(
+        paddle.nn.Linear, weight=FakeQuanterWithAbsMaxObserver()
+    )
+    cfg.add_layer_config([net[0]], activation=None, weight=None)
+    q = QAT(cfg).quantize(net, inplace=False)  # default deepcopy path
+    w0 = q._sub_layers["0"]
+    assert type(w0).__name__ == "QuantedWrapper"
+    assert w0._weight_quanter is None
+
+
+def test_qat_double_quantize_is_idempotent():
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+    cfg = QuantConfig()
+    cfg.add_type_config(
+        paddle.nn.Linear, weight=FakeQuanterWithAbsMaxObserver()
+    )
+    qat = QAT(cfg)
+    q = qat.quantize(net, inplace=True)
+    q2 = qat.quantize(q, inplace=True)
+    w = q2._sub_layers["0"]
+    assert type(w).__name__ == "QuantedWrapper"
+    assert type(w._inner).__name__ == "Linear"  # not double-wrapped
+
+
+def test_convert_separates_act_and_weight_bits():
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+    cfg = QuantConfig()
+    cfg.add_type_config(
+        paddle.nn.Linear,
+        activation=FakeQuanterWithAbsMaxObserver(quant_bits=8),
+        weight=FakeQuanterWithAbsMaxObserver(quant_bits=4),
+    )
+    qat = QAT(cfg)
+    q = qat.quantize(net, inplace=True)
+    q(T(RNG.randn(2, 4).astype(np.float32)))
+    trained_scale = q._sub_layers["0"]._weight_quanter.scale()
+    conv = qat.convert(q, inplace=True)
+    ol = conv._sub_layers["0"]
+    assert ol.weight_bits == 4 and ol.act_bits == 8
+    # the frozen scale is the trained one, not an extra-EMA-updated one
+    assert ol.weight_scale == pytest.approx(trained_scale)
+
+
+def test_model_average_context_manager_and_double_apply():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    lin = paddle.nn.Linear(4, 1)
+    avg = ModelAverage(parameters=lin.parameters())
+    avg.step()
+    lin.weight.set_value(lin.weight + 1.0)
+    avg.step()
+    before = np.asarray(lin.weight.numpy()).copy()
+    with avg.apply():
+        inside = np.asarray(lin.weight.numpy())
+        assert not np.allclose(inside, before)
+    np.testing.assert_allclose(np.asarray(lin.weight.numpy()), before)
+    avg.apply()
+    with pytest.raises(RuntimeError):
+        avg.apply()
+    avg.restore()
