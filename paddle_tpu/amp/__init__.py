@@ -1,3 +1,4 @@
 """paddle.amp parity (python/paddle/amp/ — unverified)."""
 from .auto_cast import amp_guard, auto_cast, decorate, white_list  # noqa: F401
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from . import debugging  # noqa: F401

@@ -1,0 +1,115 @@
+"""paddle.amp.debugging parity (python/paddle/amp/debugging.py —
+unverified): numeric-health tooling for mixed-precision training.
+
+Builds on the framework's check_nan_inf sweep (core/dispatch.py): the
+eager path scans per-op outputs; inside compiled steps a debug callback
+fires. This module adds the user-facing knobs + per-op stats."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..utils.flags import get_flags, set_flags
+
+
+class DebugMode:
+    """Reference enum surface (CHECK_NAN_INF_AND_ABORT is the acted-on
+    mode; the others are accepted for API parity)."""
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+def enable_tensor_checker(checker_config=None):
+    """Turn on the per-op NaN/Inf sweep (FLAGS_check_nan_inf)."""
+    set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise on NaN/Inf in ``tensor`` (reference check_numerics)."""
+    v = np.asarray(
+        tensor.numpy() if isinstance(tensor, Tensor) else tensor
+    )
+    bad = ~np.isfinite(v)
+    if bad.any():
+        raise FloatingPointError(
+            f"check_numerics: {int(bad.sum())}/{v.size} non-finite values "
+            f"in {op_type or 'tensor'} {var_name or ''} "
+            f"(nan={int(np.isnan(v).sum())}, inf={int(np.isinf(v).sum())})"
+        )
+    return True
+
+
+class _OpStats:
+    def __init__(self):
+        self.calls = {}
+
+    def hook(self, name, seconds):
+        cnt, total = self.calls.get(name, (0, 0.0))
+        self.calls[name] = (cnt + 1, total + seconds)
+
+
+_COLLECTOR = [None]
+_PREV_HOOK = [None]
+
+
+def enable_operator_stats_collection():
+    """Start counting per-op dispatches (reference: low-precision op
+    stats during amp training). Chains with an active Profiler hook
+    instead of clobbering it."""
+    _COLLECTOR[0] = _OpStats()
+    _PREV_HOOK[0] = dispatch._PROFILER_HOOK[0]
+    prev = _PREV_HOOK[0]
+    stats = _COLLECTOR[0]
+
+    def chained(name, seconds):
+        stats.hook(name, seconds)
+        if prev is not None:
+            prev(name, seconds)
+
+    dispatch._PROFILER_HOOK[0] = chained
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the per-op call table; restores any
+    previously-installed (profiler) hook."""
+    col = _COLLECTOR[0]
+    dispatch._PROFILER_HOOK[0] = _PREV_HOOK[0]
+    _PREV_HOOK[0] = None
+    _COLLECTOR[0] = None
+    if col is None:
+        return {}
+    print(f"{'op':<32}{'calls':>8}{'total_ms':>12}")
+    for name, (cnt, total) in sorted(
+        col.calls.items(), key=lambda kv: -kv[1][1]
+    ):
+        print(f"{name:<32}{cnt:>8}{total * 1e3:>12.2f}")
+    return {k: c for k, (c, _) in col.calls.items()}
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "compare_accuracy consumes the reference's GPU tensor-dump "
+        "format; on this build use check_numerics / operator stats"
+    )

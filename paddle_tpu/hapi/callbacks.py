@@ -264,3 +264,90 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "save_dir": save_dir,
     })
     return lst
+
+
+class ReduceLROnPlateau(Callback):
+    """Scale the LR down when a monitored metric stops improving
+    (reference: python/paddle/hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.verbose = verbose
+        self.min_delta = abs(float(min_delta))
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self._better = lambda cur, best: cur > best + self.min_delta
+            self._best = -float("inf")
+        else:
+            self._better = lambda cur, best: cur < best - self.min_delta
+            self._best = float("inf")
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _current(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return None if v is None else float(v)
+
+    def _step(self, logs):
+        cur = self._current(logs)
+        if cur is None:
+            return
+        if self._cooldown_left > 0:
+            # cooldown suppresses patience counting entirely
+            self._cooldown_left -= 1
+            self._wait = 0
+            if self._better(cur, self._best):
+                self._best = cur
+            return
+        if self._better(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            lr_obj = opt._lr
+            if hasattr(lr_obj, "last_lr"):  # LRScheduler
+                new = max(float(lr_obj.last_lr) * self.factor, self.min_lr)
+                lr_obj.last_lr = new
+                if hasattr(lr_obj, "base_lr"):
+                    lr_obj.base_lr = new
+            else:
+                new = max(float(lr_obj) * self.factor, self.min_lr)
+                opt._lr = new
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr -> {new:.3e}")
+            self._wait = 0
+            self._cooldown_left = self.cooldown
+
+    # At most ONE patience step per epoch. fit() fires on_epoch_end
+    # (train logs) and then, with eval_data, on_eval_end (eval logs);
+    # eval is the authoritative signal, so epoch-end stashes its logs
+    # and eval-end either overrides or the stash flushes at the next
+    # epoch boundary / train end.
+    def on_epoch_end(self, epoch, logs=None):
+        self._flush()  # previous epoch's stash, if eval never consumed it
+        self._pending = dict(logs or {})
+
+    def on_eval_end(self, logs=None):
+        self._pending = dict(logs or {})
+        self._flush()
+
+    def on_train_end(self, logs=None):
+        self._flush()
+
+    def _flush(self):
+        pending = getattr(self, "_pending", None)
+        if pending is not None:
+            self._pending = None
+            self._step(pending)

@@ -19,3 +19,4 @@ from .optimizer import (  # noqa: F401
     Optimizer,
     RMSProp,
 )
+from .extra import ASGD, LBFGS, NAdam, RAdam, Rprop  # noqa: F401

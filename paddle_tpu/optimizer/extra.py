@@ -1,0 +1,357 @@
+"""Tail optimizers: NAdam / RAdam / ASGD / Rprop / LBFGS
+(reference: python/paddle/optimizer/{nadam,radam,asgd,rprop,lbfgs}.py —
+unverified). Same jitted-donated update-kernel pattern as optimizer.py:
+fp32 master math, params stay in their own dtype.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _nadam_update(p, m, v, g, lr, beta1, beta2, eps, mu_t, mu_next,
+                  mu_prod_t, mu_prod_next, bc2):
+    g32 = g.astype(jnp.float32)
+    m2 = beta1 * m + (1 - beta1) * g32
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g32)
+    m_hat = (
+        mu_next * m2 / (1 - mu_prod_next)
+        + (1 - mu_t) * g32 / (1 - mu_prod_t)
+    )
+    v_hat = v2 / bc2
+    out = (
+        p.astype(jnp.float32) - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+    ).astype(p.dtype)
+    return out, m2, v2
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (Dozat 2016 schedule)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        # scalar state lives in ordinary (state_dict-safe) accumulators
+        t = self._scalar(p, "nadam_t", 0.0) + 1
+        self._set_acc(p, "nadam_t", jnp.float32(t))
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = self._scalar(p, "nadam_mu_prod", 1.0) * mu_t
+        self._set_acc(p, "nadam_mu_prod", jnp.float32(mu_prod))
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        p.value, m2, v2 = _nadam_update(
+            p.value, m, v, g.value, jnp.float32(lr),
+            jnp.float32(self._b1), jnp.float32(self._b2),
+            jnp.float32(self._eps), jnp.float32(mu_t),
+            jnp.float32(mu_next), jnp.float32(mu_prod),
+            jnp.float32(mu_prod * mu_next),
+            jnp.float32(1 - self._b2 ** t),
+        )
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+
+    def _scalar(self, p, name, default):
+        v = self._accumulators.get((id(p), name))
+        return default if v is None else float(v)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(9,))
+def _radam_update(p, m, v, g, lr, beta1, beta2, eps, rho_t, rectified,
+                  r_t, bc1, bc2):
+    g32 = g.astype(jnp.float32)
+    m2 = beta1 * m + (1 - beta1) * g32
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g32)
+    m_hat = m2 / bc1
+    if rectified:
+        step = lr * r_t * m_hat / (jnp.sqrt(v2 / bc2) + eps)
+    else:  # variance not tractable yet: un-adapted SGD-with-momentum
+        step = lr * m_hat
+    return (p.astype(jnp.float32) - step).astype(p.dtype), m2, v2
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (Liu et al. 2020): warmup-free variance rectification."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._rho_inf = 2.0 / (1.0 - beta2) - 1.0
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        tv = self._accumulators.get((id(p), "radam_t"))
+        t = (0.0 if tv is None else float(tv)) + 1
+        self._set_acc(p, "radam_t", jnp.float32(t))
+        rho_t = (
+            self._rho_inf
+            - 2.0 * t * self._b2 ** t / (1.0 - self._b2 ** t)
+        )
+        rectified = rho_t > 5.0
+        if rectified:
+            r_t = math.sqrt(
+                ((rho_t - 4) * (rho_t - 2) * self._rho_inf)
+                / ((self._rho_inf - 4) * (self._rho_inf - 2) * rho_t)
+            )
+        else:
+            r_t = 1.0
+        m = self._acc(p, "moment1")
+        v = self._acc(p, "moment2")
+        p.value, m2, v2 = _radam_update(
+            p.value, m, v, g.value, jnp.float32(lr),
+            jnp.float32(self._b1), jnp.float32(self._b2),
+            jnp.float32(self._eps), jnp.float32(rho_t), bool(rectified),
+            jnp.float32(r_t), jnp.float32(1 - self._b1 ** t),
+            jnp.float32(1 - self._b2 ** t),
+        )
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _asgd_update(p, ax, g, lr, mu):
+    g32 = g.astype(jnp.float32)
+    p2 = p.astype(jnp.float32) - lr * g32
+    ax2 = ax + mu * (p2 - ax)
+    return p2.astype(p.dtype), ax2
+
+
+class ASGD(Optimizer):
+    """Averaged SGD: plain SGD steps plus a running polyak average of
+    the parameters (exposed via ``averaged_params``/``apply_averaged``)."""
+
+    def __init__(self, learning_rate=0.001, t0=1e6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._t0 = t0
+        self._t = 0
+
+    def step(self):
+        self._t += 1
+        super().step()
+
+    def _update_param(self, p, g, lr, group):
+        wd, l1 = self._decay_value(group, p)
+        if l1 == "l1":
+            g = self._apply_l1(p, g, wd)
+        elif wd:
+            g = Tensor(g.value + wd * p.value)
+        # lazy init (allocating every step would leak a throwaway copy);
+        # independent copy because the jitted update donates both buffers
+        if (id(p), "averaged") not in self._accumulators:
+            self._set_acc(
+                p, "averaged", jnp.array(p.value, jnp.float32, copy=True)
+            )
+        ax = self._acc(p, "averaged")
+        mu = 1.0 / max(1.0, self._t - self._t0)
+        p.value, ax2 = _asgd_update(
+            p.value, ax, g.value, jnp.float32(lr), jnp.float32(mu)
+        )
+        self._set_acc(p, "averaged", ax2)
+
+    def averaged_params(self):
+        return {
+            id(p): self._accumulators[(id(p), "averaged")]
+            for _, p in self._all_params()
+        }
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _rprop_update(p, step, prev_g, g, eta_neg, eta_pos, lo, hi):
+    g32 = g.astype(jnp.float32)
+    sign = jnp.sign(g32 * prev_g)
+    factor = jnp.where(sign > 0, eta_pos, jnp.where(sign < 0, eta_neg, 1.0))
+    step2 = jnp.clip(step * factor, lo, hi)
+    g_eff = jnp.where(sign < 0, 0.0, g32)  # backtrack: skip this update
+    p2 = p.astype(jnp.float32) - jnp.sign(g_eff) * step2
+    return p2.astype(p.dtype), step2, g_eff
+
+
+class Rprop(Optimizer):
+    """Resilient backprop: per-weight adaptive step sizes from gradient
+    sign agreement (full-batch training)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lo, self._hi = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_step = learning_rate
+
+    def _update_param(self, p, g, lr, group):
+        if (id(p), "step_size") not in self._accumulators:  # lazy init
+            self._set_acc(
+                p, "step_size",
+                jnp.full_like(p.value, self._init_step, jnp.float32),
+            )
+        step = self._acc(p, "step_size")
+        prev = self._acc(p, "prev_grad")
+        p.value, step2, g_eff = _rprop_update(
+            p.value, step, prev, g.value, jnp.float32(self._eta_neg),
+            jnp.float32(self._eta_pos), jnp.float32(self._lo),
+            jnp.float32(self._hi),
+        )
+        self._set_acc(p, "step_size", step2)
+        self._set_acc(p, "prev_grad", g_eff)
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-based re-evaluation
+    (reference: python/paddle/optimizer/lbfgs.py). Two-loop recursion
+    over the last ``history_size`` (s, y) pairs; optional backtracking
+    line search when ``line_search_fn='strong_wolfe'`` (Armijo
+    backtracking here — same API, documented simplification)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._max_iter = int(max_iter)
+        self._max_eval = None if max_eval is None else int(max_eval)
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = int(history_size)
+        self._line_search = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_g = None
+
+    # -- flat views over the whole parameter list ------------------------
+    def _params(self):
+        return [p for _, p in self._all_params()]
+
+    def _flat(self, arrs):
+        return jnp.concatenate([jnp.ravel(a).astype(jnp.float32)
+                                for a in arrs])
+
+    def _assign_flat(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(p.value.size)
+            p.set_value(
+                flat[off:off + n].reshape(p.value.shape).astype(
+                    p.value.dtype
+                )
+            )
+            off += n
+
+    def _grads(self):
+        # apply grad clip + L2 decay here (the base step() loop that
+        # normally does it is bypassed); missing grads act as zeros
+        pairs = []
+        for group, p in self._all_params():
+            g = (
+                Tensor(jnp.zeros_like(p.value)) if p.grad is None
+                else p.grad
+            )
+            pairs.append((p, g, group))
+        if self._grad_clip is not None:
+            clipped = self._grad_clip([(p, g) for p, g, _ in pairs])
+            pairs = [
+                (p, g, grp)
+                for (p, g), (_, _, grp) in zip(clipped, pairs)
+            ]
+        flats = []
+        for p, g, group in pairs:
+            gv = g.value.astype(jnp.float32)
+            wd, l1 = self._decay_value(group, p)
+            if wd and l1 != "l1":
+                gv = gv + wd * p.value.astype(jnp.float32)
+            flats.append(jnp.ravel(gv))
+        return jnp.concatenate(flats)
+
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-10)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((rho, a, s, y))
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.dot(s_last, y_last) / jnp.maximum(
+                jnp.dot(y_last, y_last), 1e-10
+            )
+            q = q * gamma
+        for rho, a, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        self.clear_grad()  # stale grads from a previous step accumulate
+        self._evals = 0
+        loss = closure()
+        self._evals += 1
+        for _ in range(self._max_iter):
+            if self._max_eval is not None and self._evals >= self._max_eval:
+                break
+            g = self._grads()
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            d = self._direction(g)
+            x0 = self._flat([p.value for p in self._params()])
+            lr = float(self.get_lr())
+            if self._line_search == "strong_wolfe":
+                f0 = float(loss.numpy())
+                gtd = float(jnp.dot(g, d))
+                t = lr
+                for _ls in range(20):  # Armijo backtracking
+                    self._assign_flat(x0 + t * d)
+                    self.clear_grad()
+                    loss = closure()
+                    self._evals += 1
+                    if float(loss.numpy()) <= f0 + 1e-4 * t * gtd:
+                        break
+                    if (self._max_eval is not None
+                            and self._evals >= self._max_eval):
+                        break
+                    t *= 0.5
+            else:
+                self._assign_flat(x0 + lr * d)
+                self.clear_grad()
+                loss = closure()
+                self._evals += 1
+            g_new = self._grads()
+            s = self._flat([p.value for p in self._params()]) - x0
+            y = g_new - g
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s))) <= self._tol_change:
+                break
+        return loss

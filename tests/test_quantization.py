@@ -232,3 +232,186 @@ def test_model_average_context_manager_and_double_apply():
     with pytest.raises(RuntimeError):
         avg.apply()
     avg.restore()
+
+
+def _quad_data():
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 4).astype(np.float32)
+    W0 = rng.randn(4, 3).astype(np.float32)
+    Y = rng.randn(16, 3).astype(np.float32)
+    return X, W0, Y
+
+
+@pytest.mark.parametrize("mine_cls,torch_cls,kw,tkw", [
+    ("NAdam", "NAdam", {"learning_rate": 0.01}, {"lr": 0.01}),
+    ("RAdam", "RAdam", {"learning_rate": 0.01}, {"lr": 0.01}),
+    ("Rprop", "Rprop", {"learning_rate": 0.01}, {"lr": 0.01}),
+    ("ASGD", "ASGD", {"learning_rate": 0.05},
+     {"lr": 0.05, "lambd": 0.0, "alpha": 0.0}),
+])
+def test_tail_optimizers_step_parity_vs_torch(mine_cls, torch_cls, kw, tkw):
+    import torch
+
+    X, W0, Y = _quad_data()
+    p = paddle.Parameter(T(W0.copy()).value)
+    p.stop_gradient = False
+    opt = getattr(paddle.optimizer, mine_cls)(parameters=[p], **kw)
+    for _ in range(10):
+        loss = ((T(X) @ p - T(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    tp = torch.tensor(W0.copy(), requires_grad=True)
+    topt = getattr(torch.optim, torch_cls)([tp], **tkw)
+    for _ in range(10):
+        topt.zero_grad()
+        tl = ((torch.tensor(X) @ tp - torch.tensor(Y)) ** 2).mean()
+        tl.backward()
+        topt.step()
+    np.testing.assert_allclose(
+        np.asarray(p.numpy()), tp.detach().numpy(), atol=1e-4
+    )
+
+
+def test_lbfgs_reaches_least_squares_optimum():
+    X, W0, Y = _quad_data()
+    p = paddle.Parameter(T(W0.copy()).value)
+    p.stop_gradient = False
+    lb = paddle.optimizer.LBFGS(
+        learning_rate=1.0, max_iter=50, parameters=[p],
+        line_search_fn="strong_wolfe",
+    )
+
+    def closure():
+        loss = ((T(X) @ p - T(Y)) ** 2).mean()
+        loss.backward()
+        return loss
+
+    loss = lb.step(closure)
+    gold = np.linalg.lstsq(X, Y, rcond=None)[0]
+    resid = ((X @ gold - Y) ** 2).mean()
+    assert abs(float(loss.numpy()) - resid) < 1e-4
+    with pytest.raises(ValueError):
+        lb.step()
+
+
+def test_amp_debugging_tools():
+    import contextlib
+    import io as pyio
+
+    x = T(np.array([1.0, 2.0], np.float32))
+    assert paddle.amp.debugging.check_numerics(x)
+    with pytest.raises(FloatingPointError):
+        paddle.amp.debugging.check_numerics(
+            T(np.array([1.0, np.inf], np.float32))
+        )
+    buf = pyio.StringIO()
+    with contextlib.redirect_stdout(buf):
+        with paddle.amp.debugging.collect_operator_stats():
+            _ = (x + x) * x
+    out = buf.getvalue()
+    assert "multiply" in out and "add" in out
+
+
+def test_reduce_lr_on_plateau_callback():
+    cb = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=2, verbose=0
+    )
+
+    class FakeModel:
+        pass
+
+    fm = FakeModel()
+    fm._optimizer = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[paddle.Parameter(T(np.zeros(2, np.float32)).value)],
+    )
+    cb.model = fm
+    # eval-end path (authoritative, steps immediately)
+    cb.on_eval_end({"loss": 1.0})       # sets best
+    cb.on_eval_end({"loss": 1.0})       # wait=1
+    cb.on_eval_end({"loss": 1.0})       # wait=2 -> reduce
+    assert fm._optimizer._lr == pytest.approx(0.05)
+    cb.on_eval_end({"loss": 0.5})       # improvement resets
+    cb.on_eval_end({"loss": 0.5})
+    cb.on_eval_end({"loss": 0.5})
+    assert fm._optimizer._lr == pytest.approx(0.025)
+    # epoch-end + eval-end in one epoch counts patience ONCE
+    cb2 = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=4, verbose=0
+    )
+    fm2 = FakeModel()
+    fm2._optimizer = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[paddle.Parameter(T(np.zeros(2, np.float32)).value)],
+    )
+    cb2.model = fm2
+    for epoch in range(4):  # 4 flat epochs, patience 4: no reduction yet
+        cb2.on_epoch_end(epoch, {"loss": 2.0})
+        cb2.on_eval_end({"loss": 2.0})
+    assert fm2._optimizer._lr == pytest.approx(0.1)
+    cb2.on_epoch_end(4, {"loss": 2.0})
+    cb2.on_eval_end({"loss": 2.0})      # 5th flat signal -> reduce once
+    assert fm2._optimizer._lr == pytest.approx(0.05)
+    # cooldown suppresses counting
+    cb3 = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=1, cooldown=3, verbose=0
+    )
+    fm3 = FakeModel()
+    fm3._optimizer = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[paddle.Parameter(T(np.zeros(2, np.float32)).value)],
+    )
+    cb3.model = fm3
+    for _ in range(5):
+        cb3.on_eval_end({"loss": 3.0})
+    # first flat eval sets best, second reduces, then 3 cooldown evals
+    assert fm3._optimizer._lr == pytest.approx(0.05)
+
+
+def test_nadam_state_dict_roundtrip():
+    X, W0, Y = _quad_data()
+
+    def run(resume_at=None):
+        p = paddle.Parameter(T(W0.copy()).value)
+        p.stop_gradient = False
+        opt = paddle.optimizer.NAdam(learning_rate=0.01, parameters=[p])
+        for i in range(10):
+            if resume_at is not None and i == resume_at:
+                sd = opt.state_dict()
+                p2 = paddle.Parameter(T(np.asarray(p.numpy())).value)
+                p2.stop_gradient = False
+                opt = paddle.optimizer.NAdam(
+                    learning_rate=0.01, parameters=[p2]
+                )
+                opt.set_state_dict(sd)
+                p = p2
+            loss = ((T(X) @ p - T(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(p.numpy())
+
+    np.testing.assert_allclose(run(), run(resume_at=5), atol=1e-5)
+
+
+def test_lbfgs_repeated_steps_and_none_grads():
+    X, W0, Y = _quad_data()
+    p = paddle.Parameter(T(W0.copy()).value)
+    p.stop_gradient = False
+    unused = paddle.Parameter(T(np.zeros(3, np.float32)).value)
+    unused.stop_gradient = False
+    lb = paddle.optimizer.LBFGS(
+        learning_rate=1.0, max_iter=5, parameters=[p, unused],
+        line_search_fn="strong_wolfe",
+    )
+
+    def closure():
+        loss = ((T(X) @ p - T(Y)) ** 2).mean()
+        loss.backward()
+        return loss
+
+    l1 = float(lb.step(closure).numpy())
+    l2 = float(lb.step(closure).numpy())  # second call: no stale grads
+    assert l2 <= l1 + 1e-6
+    np.testing.assert_array_equal(unused.numpy(), np.zeros(3))
