@@ -1,5 +1,6 @@
 """paddle.nn namespace parity (python/paddle/nn/__init__.py — unverified)."""
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer import Layer, ParamAttr  # noqa: F401

@@ -273,3 +273,44 @@ class PairwiseDistance(Layer):
 
     def forward(self, x, y):
         return F.pairwise_distance(x, y, **self._kw)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis = int(axis)
+        self._shape = list(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape
+
+        nd = len(x.shape)
+        ax = self._axis % nd
+        new_shape = (
+            list(x.shape[:ax]) + list(self._shape)
+            + list(x.shape[ax + 1:])
+        )
+        return reshape(x, new_shape)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._kw = dict(kernel_size=kernel_size, stride=stride,
+                        padding=padding, data_format=data_format,
+                        output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self._kw)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW inputs."""
+
+    def forward(self, x):
+        if len(x.shape) not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3-D or 4-D input, got {len(x.shape)}-D"
+            )
+        return F.softmax(x, axis=-3)

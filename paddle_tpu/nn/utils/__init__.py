@@ -1,0 +1,196 @@
+"""paddle.nn.utils parity (python/paddle/nn/utils/ — unverified):
+weight/spectral norm reparameterizations + parameter vector helpers +
+gradient clipping utilities.
+
+Reparameterizations use forward-pre-hooks: the effective ``weight`` is
+recomputed from the stored factors right before each forward, so the
+recomputation traces into compiled steps and XLA fuses it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor
+
+
+def _norm_except_dim(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v / ||v||  (per-slice along ``dim``; dim=None -> global)."""
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"weight_norm: layer has no parameter {name!r}")
+    wv = jnp.asarray(w.value)
+    d = dim if dim is not None else -1
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(wv)))
+        g0 = norm.reshape(1)
+    else:
+        norm = _norm_except_dim(wv, dim)
+        g0 = norm
+    delattr(layer, name)
+    g_param = Parameter(jnp.asarray(g0))
+    v_param = Parameter(wv)
+    layer.add_parameter(f"{name}_g", g_param)
+    layer.add_parameter(f"{name}_v", v_param)
+
+    def hook(lyr, inputs):
+        v = getattr(lyr, f"{name}_v")
+        g = getattr(lyr, f"{name}_g")
+        if dim is None:
+            from ...ops.math import multiply
+            from ...ops.linalg import norm as _pnorm
+
+            w_eff = v * (g / _pnorm(v))
+        else:
+            from ...core import dispatch
+
+            w_eff = dispatch.apply(
+                "weight_norm", _weight_norm_fn, (v, g), {"dim": d}
+            )
+        object.__setattr__(lyr, name, w_eff)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handles = getattr(layer, "_weight_norm_handles", {})
+    layer._weight_norm_handles[name] = (handle, dim)
+    hook(layer, ())  # materialize immediately (reference parity)
+    return layer
+
+
+def _weight_norm_fn(v, g, *, dim):
+    return v * (g / jnp.maximum(_norm_except_dim(v, dim), 1e-12))
+
+
+def remove_weight_norm(layer, name="weight"):
+    handles = getattr(layer, "_weight_norm_handles", {})
+    if name not in handles:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    handle, dim = handles.pop(name)
+    handle.remove()
+    v = getattr(layer, f"{name}_v")
+    g = getattr(layer, f"{name}_g")
+    vv, gv = jnp.asarray(v.value), jnp.asarray(g.value)
+    if dim is None:
+        w = vv * (gv / jnp.sqrt(jnp.sum(jnp.square(vv))))
+    else:
+        w = vv * (gv / jnp.maximum(_norm_except_dim(vv, dim), 1e-12))
+    delattr(layer, f"{name}_g")
+    delattr(layer, f"{name}_v")
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """w / sigma_max(w) via power iteration (persistent u buffer)."""
+    w = getattr(layer, name)
+    wv = jnp.asarray(w.value)
+    mat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(mat.shape[0]).astype(np.float32)
+    u0 /= np.linalg.norm(u0) + eps
+    delattr(layer, name)
+    layer.add_parameter(f"{name}_orig", Parameter(wv))
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0)))
+
+    def hook(lyr, inputs):
+        from ...core import dispatch
+
+        w_orig = getattr(lyr, f"{name}_orig")
+        u = getattr(lyr, f"{name}_u")
+        w_eff, u_new = dispatch.apply(
+            "spectral_norm", _spectral_norm_fn, (w_orig, u),
+            {"dim": dim, "iters": int(n_power_iterations),
+             "eps": float(eps)},
+        )
+        lyr._buffers[f"{name}_u"] = Tensor(
+            jnp.asarray(u_new.value if isinstance(u_new, Tensor)
+                        else u_new)
+        )
+        object.__setattr__(lyr, name, w_eff)
+        return inputs
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_handles = getattr(
+        layer, "_spectral_norm_handles", {}
+    )
+    layer._spectral_norm_handles[name] = handle
+    hook(layer, ())
+    return layer
+
+
+def _spectral_norm_fn(w, u, *, dim, iters, eps):
+    import jax
+
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    mat_ng = jax.lax.stop_gradient(mat)
+    for _ in range(iters):
+        v = mat_ng.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat_ng @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (mat @ v)
+    return w / sigma, jax.lax.stop_gradient(u)
+
+
+def parameters_to_vector(parameters, name=None):
+    params = list(parameters)
+    return Tensor(jnp.concatenate([
+        jnp.ravel(jnp.asarray(p.value)) for p in params
+    ]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = jnp.asarray(vec.value if isinstance(vec, Tensor) else vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(v[off:off + n].reshape(tuple(p.shape)).astype(
+            p.value.dtype
+        ))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip; returns the total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([
+            jnp.max(jnp.abs(g.value)) for g in grads
+        ]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.value), norm_type))
+                for g in grads),
+            1.0 / norm_type,
+        )
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"clip_grad_norm_: non-finite total norm {total}"
+        )
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(p.grad.value * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(
+                p.grad.value, -clip_value, clip_value
+            ))

@@ -1,0 +1,111 @@
+"""nn.utils: weight_norm/spectral_norm reparameterizations, parameter
+vector helpers, gradient clip utilities + Unflatten/MaxUnPool2D/
+Softmax2D layers."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.utils import (
+    clip_grad_norm_,
+    clip_grad_value_,
+    parameters_to_vector,
+    remove_weight_norm,
+    spectral_norm,
+    vector_to_parameters,
+    weight_norm,
+)
+
+RNG = np.random.RandomState(12)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+def test_weight_norm_function_preserving_and_trainable():
+    lin = paddle.nn.Linear(4, 3)
+    W = np.asarray(lin.weight.numpy()).copy()
+    weight_norm(lin, dim=1)
+    assert sorted(lin._parameters.keys()) == ["bias", "weight_g", "weight_v"]
+    x = RNG.randn(2, 4).astype(np.float32)
+    out1 = lin(T(x)).numpy()
+    np.testing.assert_allclose(
+        out1, x @ W + np.asarray(lin.bias.numpy()), atol=1e-5
+    )
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=lin.parameters()
+    )
+    (lin(T(x)) ** 2).mean().backward()
+    opt.step()
+    opt.clear_grad()
+    out2 = lin(T(x)).numpy()
+    assert not np.allclose(out1, out2)
+    remove_weight_norm(lin)
+    assert sorted(lin._parameters.keys()) == ["bias", "weight"]
+    np.testing.assert_allclose(lin(T(x)).numpy(), out2, atol=1e-5)
+    with pytest.raises(ValueError):
+        remove_weight_norm(lin)
+
+
+def test_spectral_norm_unit_sigma():
+    lin = paddle.nn.Linear(6, 5)
+    spectral_norm(lin, n_power_iterations=5)
+    for _ in range(3):
+        lin(T(RNG.randn(2, 6).astype(np.float32)))
+    sigma = np.linalg.svd(
+        np.asarray(lin.weight.numpy()), compute_uv=False
+    )[0]
+    assert sigma == pytest.approx(1.0, abs=1e-3)
+    assert "weight_orig" in lin._parameters
+    assert "weight_u" in lin._buffers
+
+
+def test_parameter_vector_roundtrip():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(3, 2), paddle.nn.Linear(2, 1)
+    )
+    vec = parameters_to_vector(net.parameters())
+    assert tuple(vec.shape)[0] == 3 * 2 + 2 + 2 * 1 + 1
+    orig = np.asarray(vec.numpy()).copy()
+    vector_to_parameters(T(np.zeros_like(orig)), net.parameters())
+    assert all(
+        (np.asarray(p.numpy()) == 0).all() for p in net.parameters()
+    )
+    vector_to_parameters(T(orig), net.parameters())
+    np.testing.assert_allclose(
+        np.asarray(parameters_to_vector(net.parameters()).numpy()), orig
+    )
+
+
+def test_clip_grad_helpers():
+    p = paddle.Parameter(T(np.zeros(4, np.float32)).value)
+    p.stop_gradient = False
+    (p * T(np.array([3.0, 4.0, 0.0, 0.0], np.float32))).sum().backward()
+    total = clip_grad_norm_([p], max_norm=1.0)
+    assert float(total.numpy()) == pytest.approx(5.0, abs=1e-4)
+    assert np.linalg.norm(p.grad.numpy()) == pytest.approx(1.0, abs=1e-4)
+    p.grad = T(np.array([3.0, -4.0, 0.5, 0.0], np.float32))
+    clip_grad_value_([p], 1.0)
+    assert p.grad.numpy().tolist() == [1.0, -1.0, 0.5, 0.0]
+    with pytest.raises(RuntimeError):
+        p.grad = T(np.array([np.inf] * 4, np.float32))
+        clip_grad_norm_([p], 1.0, error_if_nonfinite=True)
+
+
+def test_unflatten_maxunpool_softmax2d_layers():
+    x = RNG.randn(2, 6).astype(np.float32)
+    out = paddle.nn.Unflatten(1, [2, 3])(T(x))
+    np.testing.assert_array_equal(out.numpy(), x.reshape(2, 2, 3))
+    xm = RNG.randn(2, 4, 8, 8).astype(np.float32)
+    pooled, mask = paddle.nn.functional.max_pool2d(
+        T(xm), 2, 2, return_mask=True
+    )
+    unp = paddle.nn.MaxUnPool2D(2, 2)(pooled, mask)
+    assert tuple(unp.shape) == (2, 4, 8, 8)
+    sm = paddle.nn.Softmax2D()(T(xm))
+    np.testing.assert_allclose(sm.numpy().sum(1), 1.0, atol=1e-5)
+    with pytest.raises(ValueError):
+        paddle.nn.Softmax2D()(T(x))
