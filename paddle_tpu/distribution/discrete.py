@@ -31,7 +31,16 @@ def _bernoulli_entropy(p, *, _):
     return -(_xlogy(p, p) + _xlogy(1.0 - p, 1.0 - p))
 
 
-class Bernoulli(Distribution):
+class _ProbsAttr:
+    """Expose the success probability as a ``probs`` attribute (reference
+    surface); Categorical is excluded — there ``probs`` is a method."""
+
+    @property
+    def probs(self):
+        return self.probs_param
+
+
+class Bernoulli(_ProbsAttr, Distribution):
     def __init__(self, probs, name=None):
         self.probs_param = _as_tensor(probs)
         super().__init__(tuple(self.probs_param.shape))
@@ -140,22 +149,24 @@ class Categorical(Distribution):
 # --------------------------------------------------------------- Geometric
 def _geometric_sample(p, *, key, shape):
     u = jax.random.uniform(key, shape, dtype=p.dtype)
-    # trials-until-first-success parameterization, support {0, 1, ...}
-    return jnp.floor(jnp.log1p(-u) / jnp.log1p(-p))
+    # number-of-trials-until-first-success, support {1, 2, ...} — the
+    # paddle convention (mean 1/p); torch's {0,1,...} variant is this - 1.
+    # Matches Tensor.geometric_ (ops/inplace.py).
+    return jnp.floor(jnp.log1p(-u) / jnp.log1p(-p)) + 1.0
 
 
 def _geometric_logp(p, v, *, _):
-    return v * jnp.log1p(-p) + jnp.log(p)
+    return (v - 1.0) * jnp.log1p(-p) + jnp.log(p)
 
 
-class Geometric(Distribution):
+class Geometric(_ProbsAttr, Distribution):
     def __init__(self, probs, name=None):
         self.probs_param = _as_tensor(probs)
         super().__init__(tuple(self.probs_param.shape))
 
     @property
     def mean(self):
-        return (1.0 - self.probs_param) / self.probs_param
+        return 1.0 / self.probs_param
 
     @property
     def variance(self):
@@ -235,7 +246,7 @@ def _binomial_logp(p, v, *, n):
     return logc + _xlogy(v, p) + _xlogy(n - v, 1.0 - p)
 
 
-class Binomial(Distribution):
+class Binomial(_ProbsAttr, Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
         self.probs_param = _as_tensor(probs)
@@ -279,10 +290,12 @@ def _multinomial_logp(p, v, *, n):
     return logc + jnp.sum(_xlogy(v, p), -1)
 
 
-class Multinomial(Distribution):
+class Multinomial(_ProbsAttr, Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
-        self.probs_param = _as_tensor(probs)
+        p = _as_tensor(probs)
+        # reference normalizes along the event axis at construction
+        self.probs_param = p / p.sum(axis=-1, keepdim=True)
         shape = tuple(self.probs_param.shape)
         super().__init__(shape[:-1], shape[-1:])
 

@@ -130,6 +130,9 @@ def _spectral_norm_fn(w, u, *, dim, iters, eps):
 
     mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
     mat_ng = jax.lax.stop_gradient(mat)
+    # derive v from the stored u so iters=0 still yields a valid sigma
+    v = mat_ng.T @ u
+    v = v / (jnp.linalg.norm(v) + eps)
     for _ in range(iters):
         v = mat_ng.T @ u
         v = v / (jnp.linalg.norm(v) + eps)

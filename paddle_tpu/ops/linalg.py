@@ -224,7 +224,8 @@ def _lu(x):
     import jax.scipy.linalg as jsl
 
     lu_mat, piv = jsl.lu_factor(x)
-    return lu_mat, piv.astype(jnp.int32)
+    # paddle's lu contract is 1-based LAPACK pivots; jax returns 0-based
+    return lu_mat, piv.astype(jnp.int32) + 1
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
@@ -324,7 +325,7 @@ def _lu_unpack(lu_mat, piv, *, unpack_ludata, unpack_pivots):
         perm_fn = _lu_perm
         for _ in range(piv.ndim - 1):  # batched pivots
             perm_fn = jax.vmap(perm_fn, in_axes=(0, None))
-        perm = perm_fn(piv, m)
+        perm = perm_fn(piv - 1, m)  # pivots are 1-based (LAPACK contract)
         # rows perm of A equal L@U, so A = P @ L @ U with P[perm[i], i]=1
         p = jnp.swapaxes(
             jnp.take(jnp.eye(m, dtype=lu_mat.dtype), perm, axis=0), -2, -1

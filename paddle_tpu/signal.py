@@ -98,10 +98,21 @@ def _istft(spec, window, *, n_fft, hop, center, normalized, onesided,
     if center:
         out = out[..., n_fft // 2:]
         if length is not None:
+            if out.shape[-1] < length:  # torch zero-pads to `length`
+                pad = [(0, 0)] * (out.ndim - 1) + [
+                    (0, length - out.shape[-1])
+                ]
+                out = jnp.pad(out, pad)
             out = out[..., :length]
         else:
-            out = out[..., : out_len - n_fft]
+            # trim exactly n_fft//2 from each end (front trim already
+            # removed n_fft//2; for odd n_fft this keeps one extra sample
+            # vs out_len - n_fft, matching torch/paddle).
+            out = out[..., : out_len - 2 * (n_fft // 2)]
     elif length is not None:
+        if out.shape[-1] < length:
+            pad = [(0, 0)] * (out.ndim - 1) + [(0, length - out.shape[-1])]
+            out = jnp.pad(out, pad)
         out = out[..., :length]
     return out
 

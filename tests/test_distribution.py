@@ -56,8 +56,6 @@ PAIRS = [
     ("bernoulli", lambda: D.Bernoulli(P),
      lambda: td.Bernoulli(torch.tensor(P)),
      (RNG.rand(4) > 0.5).astype(np.float32)),
-    ("geometric", lambda: D.Geometric(P),
-     lambda: td.Geometric(torch.tensor(P)), VK),
     ("poisson", lambda: D.Poisson(A * 2),
      lambda: td.Poisson(torch.tensor(A * 2)), VK),
 ]
@@ -84,6 +82,20 @@ def test_log_prob_parity(name, mk, mk_gold, value):
 ], ids=["normal", "beta", "gamma", "bernoulli", "cauchy"])
 def test_entropy_parity(name, mk, mk_gold):
     close(mk().entropy(), mk_gold().entropy())
+
+
+def test_geometric_paddle_convention():
+    # paddle counts trials from 1 (mean 1/p); torch's support is {0,1,...}
+    # so paddle.log_prob(k+1) == torch.log_prob(k).
+    g = D.Geometric(P)
+    tg = td.Geometric(torch.tensor(P))
+    close(g.log_prob(T(VK + 1.0)), tg.log_prob(torch.tensor(VK)))
+    close(g.mean, 1.0 / torch.tensor(P))
+    close(g.variance, tg.variance)
+    close(g.probs, torch.tensor(P))
+    close(D.Bernoulli(P).probs, torch.tensor(P))
+    s = np.asarray(g.sample([512]).numpy())
+    assert s.min() >= 1.0
 
 
 def test_uniform():

@@ -63,6 +63,14 @@ def test_spectral_norm_unit_sigma():
     assert "weight_u" in lin._buffers
 
 
+def test_spectral_norm_zero_iterations():
+    # iters=0 must reuse the stored u (no NameError) and still normalize
+    lin = paddle.nn.Linear(6, 5)
+    spectral_norm(lin, n_power_iterations=0)
+    out = lin(T(RNG.randn(2, 6).astype(np.float32)))
+    assert np.all(np.isfinite(np.asarray(out.numpy())))
+
+
 def test_parameter_vector_roundtrip():
     net = paddle.nn.Sequential(
         paddle.nn.Linear(3, 2), paddle.nn.Linear(2, 1)

@@ -72,6 +72,23 @@ def test_stft_window_length_validation():
         paddle.signal.stft(T(SIG), n_fft=128, window=T(WIN[:64]))
 
 
+def test_istft_odd_nfft_length_none():
+    # odd n_fft: both ends must drop exactly n_fft//2 samples (torch parity)
+    n_fft, hop = 127, 32
+    win = np.hanning(n_fft).astype(np.float32) + 0.1
+    spec = paddle.signal.stft(T(SIG), n_fft=n_fft, hop_length=hop,
+                              window=T(win))
+    rec = paddle.signal.istft(
+        spec, n_fft=n_fft, hop_length=hop, window=T(win)
+    ).numpy()
+    gold = torch.istft(
+        torch.tensor(spec.numpy()), n_fft=n_fft, hop_length=hop,
+        window=torch.tensor(win),
+    ).numpy()
+    assert rec.shape == gold.shape
+    np.testing.assert_allclose(rec, gold, rtol=1e-4, atol=1e-4)
+
+
 # --------------------------------------------------------------------- nms
 def _np_nms(boxes, scores, thr):
     order = np.argsort(-scores)
