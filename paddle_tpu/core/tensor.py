@@ -110,6 +110,7 @@ class Tensor:
         return np.asarray(self.value)
 
     def item(self, *args):
+        self._guard_concrete(".item()")
         arr = np.asarray(self.value)
         return arr.item(*args)
 
@@ -265,16 +266,35 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self.value.shape[0]
 
+    def _guard_concrete(self, what):
+        import jax as _jax
+
+        if isinstance(self.value, _jax.core.Tracer):
+            raise TypeError(
+                f"{what} of a traced Tensor: inside to_static/jit the "
+                "value is not available, so data-dependent Python control "
+                "flow cannot be compiled. to_static auto-converts "
+                "`if`/`while` on Tensor conditions when the branch/body "
+                "has no early return/break/continue; otherwise use "
+                "paddle.static.nn.cond / while_loop / switch_case, or "
+                "express the branch as a select with paddle.where. "
+                "(reference: dy2static unsupported-syntax errors)"
+            )
+
     def __bool__(self):
+        self._guard_concrete("bool()")
         return bool(np.asarray(self.value))
 
     def __int__(self):
+        self._guard_concrete("int()")
         return int(np.asarray(self.value))
 
     def __float__(self):
+        self._guard_concrete("float()")
         return float(np.asarray(self.value))
 
     def __index__(self):
+        self._guard_concrete("index()")
         return int(np.asarray(self.value))
 
     def __format__(self, spec):

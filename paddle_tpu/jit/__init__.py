@@ -10,3 +10,4 @@ via jax.export (the deployment format replacing ProgramDesc+params).
 """
 from .api import TranslatedLayer, ignore_module, load, not_to_static, save, to_static  # noqa: F401
 from .trainer import CompiledTrainStep  # noqa: F401
+from . import dy2static  # noqa: F401

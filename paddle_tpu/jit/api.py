@@ -51,7 +51,11 @@ class StaticFunction:
     """Callable wrapping a Layer or function with whole-program jax.jit."""
 
     def __init__(self, fn, layer=None, input_spec=None, full_graph=True):
-        self._fn = fn
+        from .dy2static import convert_to_static
+
+        # AST pass first: Python if/while on traced predicates become
+        # lax.cond/lax.while_loop so data-dependent control flow compiles
+        self._fn = convert_to_static(fn)
         self._layer = layer
         self._input_spec = input_spec
         # one compiled program per train/eval mode: dropout/batch-norm

@@ -1,0 +1,486 @@
+"""dy2static: dynamic-to-static control-flow conversion.
+
+Reference parity: python/paddle/jit/dy2static/* + python/paddle/jit/sot/*
+(~80k LoC upstream — unverified, mount empty). TPU-first redesign: the
+reference translates Python control flow into static-graph cond/while ops
+executed by its interpreter; here the targets are XLA's native structured
+control flow (``lax.cond`` / ``lax.while_loop`` / ``lax.switch``), which
+compile into HLO conditionals the TPU executes without host round trips.
+
+Two cooperating layers:
+
+1. **Runtime converters** (this module): ``convert_ifelse`` /
+   ``convert_while`` / ``convert_and`` etc. Each inspects its predicate at
+   call time — a concrete value keeps plain Python semantics (the eager
+   path and non-tensor conditions are untouched); a traced value routes to
+   the corresponding ``lax`` primitive with Tensor un/re-wrapping.
+2. **AST pass** (``transformer.py``): rewrites Python ``if``/``while`` on
+   potentially-traced predicates into calls to the runtime converters.
+   ``to_static`` applies it automatically; statements it cannot convert
+   (early ``return``, ``break``/``continue``) are left as-is and produce
+   an actionable error from ``Tensor.__bool__`` if their predicate turns
+   out to be traced.
+
+The public ``paddle.static.nn.cond/while_loop/switch_case`` ops are thin
+wrappers over the same converters (static/nn/__init__.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Dy2StaticError", "UndefinedVar", "convert_to_static",
+    "convert_ifelse", "convert_while", "convert_and", "convert_or",
+    "convert_not", "cond_impl", "while_impl", "switch_impl",
+]
+
+
+class Dy2StaticError(Exception):
+    """Raised when dynamic Python control flow cannot be staticized."""
+
+
+class UndefinedVar:
+    """Placeholder for a name not yet bound when captured (reference:
+    jit/dy2static/utils.py UndefinedVar). Any use raises a NameError with
+    the original variable name."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        object.__setattr__(self, "name", name)
+
+    def _raise(self):
+        raise NameError(
+            f"local variable '{self.name}' referenced before assignment "
+            "(inside to_static-converted control flow)"
+        )
+
+    def __getattr__(self, item):
+        object.__getattribute__(self, "name")  # keep pickling sane
+        self._raise()
+
+    def __bool__(self):
+        self._raise()
+
+    def __call__(self, *a, **k):
+        self._raise()
+
+    def __iter__(self):
+        self._raise()
+
+    def __repr__(self):
+        return f"UndefinedVar({object.__getattribute__(self, 'name')!r})"
+
+
+def ld(thunk, name):
+    """Capture the current value of a possibly-unbound local."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UndefinedVar(name)
+
+
+def _raw(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_raw(x), jax.core.Tracer)
+
+
+def _is_arraylike(x):
+    if isinstance(x, Tensor):
+        return True
+    return isinstance(x, (jax.Array, np.ndarray)) or isinstance(
+        x, jax.core.Tracer
+    )
+
+
+def _pred_bool(pred):
+    v = _raw(pred)
+    return bool(np.asarray(v))
+
+
+def _is_structure_error(e):
+    """Does this TypeError come from jax's cond/while structure checks
+    (as opposed to a genuine user bug raised inside a branch)?"""
+    msg = str(e)
+    return any(
+        key in msg
+        for key in (
+            "pytree", "type structure", "carry input and carry output",
+            "must have equal types", "output and input",
+        )
+    )
+
+
+# --------------------------------------------------------------- if / cond
+def _split_outputs(out, where):
+    """Flatten a branch output into (array_leaves, rebuild_template).
+
+    Tensors/arrays become lax-carried leaves; everything else (ints, None,
+    UndefinedVar, strings, ...) is recorded as a static in the template.
+    The template is a nested structure mirroring ``out`` where array
+    positions hold the marker ``_ARR`` and statics hold themselves.
+    """
+    leaves = []
+
+    def walk(o):
+        if isinstance(o, Tensor):
+            leaves.append(o.value)
+            return _ARR_T
+        if _is_arraylike(o):
+            leaves.append(jnp.asarray(o))
+            return _ARR
+        if isinstance(o, (list, tuple)):
+            return type(o)(walk(v) for v in o)
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in sorted(o.items())}
+        return o
+
+    template = walk(out)
+    return leaves, template
+
+
+_ARR = object()    # raw-array position
+_ARR_T = object()  # Tensor position
+
+
+def _rebuild_outputs(template, leaves):
+    it = iter(leaves)
+
+    def walk(t):
+        if t is _ARR_T:
+            return Tensor(next(it))
+        if t is _ARR:
+            return next(it)
+        if isinstance(t, (list, tuple)):
+            return type(t)(walk(v) for v in t)
+        if isinstance(t, dict):
+            return {k: walk(v) for k, v in t.items()}
+        return t
+
+    return walk(template)
+
+
+def _templates_equal(a, b):
+    if a is _ARR_T or a is _ARR:
+        return b is _ARR_T or b is _ARR
+    if isinstance(a, (list, tuple)):
+        return (
+            type(a) is type(b) and len(a) == len(b)
+            and all(_templates_equal(x, y) for x, y in zip(a, b))
+        )
+    if isinstance(a, dict):
+        return (
+            isinstance(b, dict) and a.keys() == b.keys()
+            and all(_templates_equal(a[k], b[k]) for k in a)
+        )
+    if isinstance(a, UndefinedVar) or isinstance(b, UndefinedVar):
+        return isinstance(a, UndefinedVar) and isinstance(b, UndefinedVar)
+    try:
+        return bool(a == b)
+    except Exception:
+        return a is b
+
+
+def _describe_template(t):
+    if t is _ARR_T or t is _ARR:
+        return "Tensor"
+    if isinstance(t, UndefinedVar):
+        return f"<undefined '{object.__getattribute__(t, 'name')}'>"
+    if isinstance(t, (list, tuple)):
+        return type(t)(_describe_template(v) for v in t)
+    if isinstance(t, dict):
+        return {k: _describe_template(v) for k, v in t.items()}
+    return repr(t)
+
+
+def cond_impl(pred, true_thunk, false_thunk, names=None, where="cond"):
+    """Core of paddle.static.nn.cond and the AST if-conversion.
+
+    ``true_thunk``/``false_thunk``: nullary callables returning an
+    arbitrary Tensor pytree. Concrete predicate -> run the taken branch
+    only (plain Python semantics, tape-autograd intact). Traced predicate
+    -> ``lax.cond``: XLA compiles both branches, executes one; jax
+    reverse-mode differentiates it natively inside whole-step jit.
+    """
+    if not _is_traced(pred):
+        return (true_thunk if _pred_bool(pred) else false_thunk)()
+
+    recorded = {}
+
+    def make(fn, tag):
+        def inner(_):
+            leaves, template = _split_outputs(fn(), where)
+            recorded[tag] = template
+            return tuple(jnp.asarray(v) for v in leaves)
+
+        return inner
+
+    try:
+        leaves = jax.lax.cond(
+            jnp.asarray(_raw(pred)).astype(bool).reshape(()),
+            make(true_thunk, "t"), make(false_thunk, "f"), (),
+        )
+    except TypeError as e:
+        if not _is_structure_error(e):
+            raise  # a genuine user bug inside a branch: keep its traceback
+        raise Dy2StaticError(
+            f"{where}: the two branches of a Tensor-condition must "
+            "return matching shapes/dtypes; jax reported: " + str(e)
+        ) from e
+    if not _templates_equal(recorded["t"], recorded["f"]):
+        hint = ""
+        if names:
+            hint = f" (captured variables, in order: {names})"
+        raise Dy2StaticError(
+            f"{where}: branches of a Tensor-dependent `if` must produce "
+            "matching outputs — every assigned variable must be a Tensor "
+            f"(or an equal static) in BOTH branches{hint}. "
+            f"true branch: {_describe_template(recorded['t'])}; "
+            f"false branch: {_describe_template(recorded['f'])}. "
+            "Assign the variable in both branches, or compute it with "
+            "paddle.where instead."
+        )
+    return _rebuild_outputs(recorded["t"], leaves)
+
+
+def convert_ifelse(pred, true_fn, false_fn, args, names):
+    """AST-generated `if` conversion: branch fns take the captured args
+    (current values of every name either branch assigns) and return the
+    tuple of their final values."""
+    out = cond_impl(
+        pred, lambda: true_fn(*args), lambda: false_fn(*args),
+        names=names, where="to_static if",
+    )
+    return tuple(out)
+
+
+# ------------------------------------------------------------------- while
+def while_impl(cond_fn, body_fn, loop_vars, names=None, where="while_loop",
+               maximum_trip_count=None):
+    """Core of paddle.static.nn.while_loop and the AST while-conversion.
+
+    ``loop_vars`` is a flat tuple; ``cond_fn(*vars) -> scalar`` and
+    ``body_fn(*vars) -> tuple(vars)``. Tensor loop state rides the
+    ``lax.while_loop`` carry; non-tensor loop vars must stay invariant
+    (XLA loops have a fixed carry signature).
+
+    ``maximum_trip_count``: when given, the traced loop lowers to a
+    masked ``lax.scan`` of that fixed length (iterations after the
+    condition goes false are no-ops) — reverse-mode differentiable, which
+    ``lax.while_loop`` is not. This is how a value-dependent loop trains
+    on TPU; the unbounded form is inference-only under reverse AD.
+    """
+    loop_vars = tuple(loop_vars)
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first):
+        # concrete condition: plain Python loop — eager semantics (tape
+        # autograd intact), and under an outer trace the body simply
+        # unrolls (traced loop STATE is fine; only a traced CONDITION
+        # needs lax.while_loop)
+        out = loop_vars
+        step = 0
+        while True:
+            if maximum_trip_count is not None and step >= int(
+                maximum_trip_count
+            ):
+                break  # same bound as the traced masked-scan lowering
+            pred = cond_fn(*out) if step else first
+            if _is_traced(pred):
+                raise Dy2StaticError(
+                    f"{where}: the loop condition became value-dependent "
+                    f"after {step} iteration(s) (it started concrete). "
+                    "Initialize the state the condition reads as a "
+                    "Tensor so the whole loop compiles via "
+                    "lax.while_loop, or keep the condition on concrete "
+                    "Python values."
+                )
+            if not _pred_bool(pred):
+                break
+            out = tuple(body_fn(*out))
+            if len(out) != len(loop_vars):
+                raise Dy2StaticError(
+                    f"{where}: body must return as many values as "
+                    f"loop_vars ({len(loop_vars)}), got {len(out)}"
+                )
+            step += 1
+        return out
+
+    init_leaves, template = _split_outputs(loop_vars, where)
+
+    def rebuild(leaves):
+        return _rebuild_outputs(template, leaves)
+
+    def cond_wrapped(leaves):
+        res = cond_fn(*rebuild(leaves))
+        return jnp.asarray(_raw(res)).astype(bool).reshape(())
+
+    def body_wrapped(leaves):
+        out = tuple(body_fn(*rebuild(leaves)))
+        new_leaves, new_template = _split_outputs(out, where)
+        if not _templates_equal(new_template, template):
+            hint = f" (loop variables, in order: {names})" if names else ""
+            raise Dy2StaticError(
+                f"{where}: a Tensor-dependent `while` must keep its loop "
+                f"variables' structure fixed{hint}: every loop variable "
+                "must stay a Tensor (same shape/dtype) across iterations. "
+                f"before: {_describe_template(template)}; after one step: "
+                f"{_describe_template(new_template)}."
+            )
+        return tuple(jnp.asarray(v) for v in new_leaves)
+
+    init = tuple(jnp.asarray(v) for v in init_leaves)
+    try:
+        if maximum_trip_count is not None:
+            # masked scan: fixed length, iterations past the condition
+            # are identity — reverse-differentiable on TPU
+            def scan_body(carry, _):
+                leaves, done = carry
+                cont = jnp.logical_and(cond_wrapped(leaves), ~done)
+                new_leaves = body_wrapped(leaves)
+                kept = tuple(
+                    jnp.where(cont, n, o)
+                    for o, n in zip(leaves, new_leaves)
+                )
+                return (kept, ~cont), None
+
+            (final, _), _ = jax.lax.scan(
+                scan_body, (init, jnp.asarray(False)), None,
+                length=int(maximum_trip_count),
+            )
+        else:
+            final = jax.lax.while_loop(cond_wrapped, body_wrapped, init)
+    except TypeError as e:
+        if not _is_structure_error(e):
+            raise  # a genuine user bug inside cond/body: keep its traceback
+        raise Dy2StaticError(
+            f"{where}: loop body changed the shape/dtype of a loop "
+            "variable (XLA loop carries are fixed); jax reported: "
+            + str(e)
+        ) from e
+    return tuple(_rebuild_outputs(template, final))
+
+
+def convert_while(cond_fn, body_fn, loop_vars, names):
+    return while_impl(
+        cond_fn, body_fn, loop_vars, names=names, where="to_static while"
+    )
+
+
+# ------------------------------------------------------------------ switch
+def switch_impl(branch_index, branch_fns, default=None, where="switch_case"):
+    """paddle.static.nn.switch_case semantics over ``lax.switch``.
+
+    ``branch_fns``: list of callables, or list of (int_index, callable)
+    pairs. Out-of-range / unmatched index runs ``default`` (required when
+    indices are sparse and the predicate is traced).
+    """
+    pairs = []
+    if isinstance(branch_fns, dict):
+        branch_fns = list(branch_fns.items())
+    for i, item in enumerate(branch_fns):
+        if isinstance(item, (tuple, list)) and len(item) == 2 and callable(
+            item[1]
+        ):
+            pairs.append((int(item[0]), item[1]))
+        elif callable(item):
+            pairs.append((i, item))
+        else:
+            raise TypeError(
+                f"{where}: branch_fns entries must be callables or "
+                f"(index, callable) pairs, got {type(item).__name__}"
+            )
+    indices = [p[0] for p in pairs]
+    if len(set(indices)) != len(indices):
+        raise ValueError(f"{where}: duplicate branch indices {indices}")
+
+    if not _is_traced(branch_index):
+        idx = int(np.asarray(_raw(branch_index)))
+        for k, fn in pairs:
+            if k == idx:
+                return fn()
+        if default is None:
+            # paddle: the largest-index branch doubles as the default
+            return max(pairs, key=lambda p: p[0])[1]()
+        return default()
+
+    if default is None:
+        # paddle: the largest-index branch doubles as the default
+        default = max(pairs, key=lambda p: p[0])[1]
+
+    idx_val = jnp.asarray(_raw(branch_index)).astype(jnp.int32).reshape(())
+    # map the user index to a dense position; unmatched -> default slot
+    positions = jnp.full((), len(pairs), jnp.int32)
+    for pos, (k, _) in enumerate(pairs):
+        positions = jnp.where(idx_val == k, jnp.int32(pos), positions)
+
+    recorded = {}
+
+    def make(fn, tag):
+        def inner(_):
+            leaves, template = _split_outputs(fn(), where)
+            recorded[tag] = template
+            return tuple(jnp.asarray(v) for v in leaves)
+
+        return inner
+
+    fns = [make(fn, i) for i, (_, fn) in enumerate(pairs)]
+    fns.append(make(default, "default"))
+    leaves = jax.lax.switch(positions, fns, ())
+    templates = list(recorded.values())
+    for t in templates[1:]:
+        if not _templates_equal(templates[0], t):
+            raise Dy2StaticError(
+                f"{where}: all branches (and the default) must return "
+                "matching Tensor structures under a traced index; got "
+                + "; ".join(
+                    str(_describe_template(t)) for t in templates
+                )
+            )
+    return _rebuild_outputs(templates[0], leaves)
+
+
+# --------------------------------------------------- short-circuit bool ops
+def convert_and(lhs, rhs_thunk):
+    if not _is_traced(lhs):
+        if isinstance(lhs, Tensor):
+            lhs = _pred_bool(lhs)
+        return rhs_thunk() if lhs else lhs
+    from ...ops.logic import logical_and
+
+    lhs_t = lhs if isinstance(lhs, Tensor) else Tensor(jnp.asarray(lhs))
+    rhs = rhs_thunk()
+    rhs_t = rhs if isinstance(rhs, Tensor) else Tensor(jnp.asarray(rhs))
+    return logical_and(lhs_t.astype("bool"), rhs_t.astype("bool"))
+
+
+def convert_or(lhs, rhs_thunk):
+    if not _is_traced(lhs):
+        if isinstance(lhs, Tensor):
+            lhs = _pred_bool(lhs)
+        return lhs if lhs else rhs_thunk()
+    from ...ops.logic import logical_or
+
+    lhs_t = lhs if isinstance(lhs, Tensor) else Tensor(jnp.asarray(lhs))
+    rhs = rhs_thunk()
+    rhs_t = rhs if isinstance(rhs, Tensor) else Tensor(jnp.asarray(rhs))
+    return logical_or(lhs_t.astype("bool"), rhs_t.astype("bool"))
+
+
+def convert_not(x):
+    if not _is_traced(x):
+        return not (_pred_bool(x) if isinstance(x, Tensor) else x)
+    from ...ops.logic import logical_not
+
+    x_t = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return logical_not(x_t.astype("bool"))
+
+
+from .transformer import convert_to_static  # noqa: E402  (cycle-free)

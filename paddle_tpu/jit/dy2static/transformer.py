@@ -1,0 +1,458 @@
+"""AST pass: rewrite Python `if`/`while` into runtime-converter calls.
+
+Reference parity: python/paddle/jit/dy2static/transformers/* (IfElse,
+Loop, LogicalOp transformers — unverified, mount empty). Scope here is
+deliberately the common subset that maps onto XLA structured control flow:
+
+* ``if``/``elif``/``else`` whose branches contain no ``return`` /
+  ``break`` / ``continue`` / ``yield`` -> ``_jst.convert_ifelse``.
+* ``while`` (no ``else`` clause, body free of the same statements)
+  -> ``_jst.convert_while``.
+* ``and`` / ``or`` / ``not`` inside converted predicates
+  -> ``_jst.convert_and/or/not`` (Python short-circuit semantics are
+  preserved for concrete operands; traced operands become logical ops).
+
+Anything outside this subset is left untouched: with a concrete predicate
+it runs as plain Python; with a traced predicate, ``Tensor.__bool__``
+raises an actionable error naming the rewrite options (this module's
+skip-list is intentionally mirrored in that message).
+
+The conversion is value-semantics-preserving for names: every name a
+branch/body assigns is captured before the statement (``_jst.ld``: value
+or ``UndefinedVar``), threaded through the generated branch functions as
+parameters, and rebound afterwards from the returned tuple — names the
+taken path does not assign keep their prior value. Assignments to
+attributes/subscripts inside branches execute as ordinary side effects
+(valid on the concrete path; on the traced path they are outside the
+convertible subset, like the reference's dy2static).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import textwrap
+import types
+import warnings
+
+
+# ------------------------------------------------------------ name analysis
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by statements in a block (not descending into nested
+    function/class scopes, where bindings are local to that scope)."""
+
+    def __init__(self):
+        self.names = set()
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        # Attribute/Subscript targets are side effects, not name bindings
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):  # walrus
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # the def itself binds its name
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.names.add((a.asname or a.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+
+def _assigned_names(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _CtrlFlow(ast.NodeVisitor):
+    """Detect return/break/continue/yield that would escape the block."""
+
+    def __init__(self):
+        self.found = False
+        self._loop_depth = 0
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    visit_YieldFrom = visit_Yield
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.found = True
+
+    visit_Continue = visit_Break
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _loop
+    visit_While = _loop
+
+    def visit_FunctionDef(self, node):
+        pass  # its own scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+
+def _has_escaping_ctrl(stmts):
+    v = _CtrlFlow()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+# ------------------------------------------------------------- AST building
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(fn_name):
+    return ast.Attribute(value=_name("_jst"), attr=fn_name, ctx=ast.Load())
+
+
+def _capture_call(var):
+    """_jst.ld(lambda: var, 'var')"""
+    lam = ast.Lambda(
+        args=ast.arguments(
+            posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+            kw_defaults=[], kwarg=None, defaults=[],
+        ),
+        body=_name(var),
+    )
+    return ast.Call(
+        func=_jst_attr("ld"), args=[lam, ast.Constant(var)], keywords=[]
+    )
+
+
+def _make_branch_fn(fname, params, body, result_names):
+    """def fname(p1, p2, ...): <body>; return (r1, r2, ...)"""
+    ret = ast.Return(
+        value=ast.Tuple(
+            elts=[_name(n) for n in result_names], ctx=ast.Load()
+        )
+    )
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p, annotation=None) for p in params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[],
+        ),
+        body=list(body) + [ret],
+        decorator_list=[],
+        returns=None,
+    )
+
+
+class _PredicateBoolOps(ast.NodeTransformer):
+    """Inside converted predicates: and/or/not -> runtime converters."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "convert_and" if isinstance(node.op, ast.And) else "convert_or"
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            thunk = ast.Lambda(
+                args=ast.arguments(
+                    posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                    kw_defaults=[], kwarg=None, defaults=[],
+                ),
+                body=rhs,
+            )
+            expr = ast.Call(
+                func=_jst_attr(op), args=[expr, thunk], keywords=[]
+            )
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=_jst_attr("convert_not"), args=[node.operand],
+                keywords=[],
+            )
+        return node
+
+    def visit_Lambda(self, node):
+        return node  # don't rewrite inside nested lambdas
+
+
+def _convert_predicate(test):
+    return _PredicateBoolOps().visit(test)
+
+
+class _SuperRewriter(ast.NodeTransformer):
+    """Zero-arg ``super()`` relies on the ``__class__`` compiler cell,
+    which only exists for defs inside a class body; the regenerated def is
+    module-level, so rewrite to the explicit two-arg form. ``__class__``
+    itself is provided via the snapshotted closure (the original method's
+    implicit cell)."""
+
+    def __init__(self, self_name):
+        self.self_name = self_name
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (
+            isinstance(node.func, ast.Name) and node.func.id == "super"
+            and not node.args and not node.keywords and self.self_name
+        ):
+            node.args = [_name("__class__"), _name(self.self_name)]
+        return node
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # Nested def/lambda/class keep their own (untransformed) scope: the
+    # conversion targets the decorated function's body only, like the
+    # reference's per-function transform entry.
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escaping_ctrl(node.body) or _has_escaping_ctrl(node.orelse):
+            return node
+        assigned = sorted(
+            n
+            for n in _assigned_names(node.body) | _assigned_names(node.orelse)
+            if not n.startswith("__dy2st_")  # inner conversions' machinery
+        )
+        if not assigned:
+            return node  # side-effect-only if: leave as Python
+        uid = self._uid()
+        self.changed = True
+        true_name, false_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        out_name = f"__dy2st_out_{uid}"
+        true_fn = _make_branch_fn(true_name, assigned, node.body, assigned)
+        false_fn = _make_branch_fn(
+            false_name, assigned, node.orelse or [ast.Pass()], assigned
+        )
+        call = ast.Assign(
+            targets=[_name(out_name, ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_ifelse"),
+                args=[
+                    _convert_predicate(node.test),
+                    _name(true_name), _name(false_name),
+                    ast.Tuple(
+                        elts=[_capture_call(n) for n in assigned],
+                        ctx=ast.Load(),
+                    ),
+                    ast.Constant(tuple(assigned)),
+                ],
+                keywords=[],
+            ),
+        )
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in assigned],
+                ctx=ast.Store(),
+            )],
+            value=_name(out_name),
+        )
+        return [true_fn, false_fn, call, unpack]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escaping_ctrl(node.body):
+            return node
+        assigned = sorted(
+            n for n in _assigned_names(node.body)
+            if not n.startswith("__dy2st_")
+        )
+        if not assigned:
+            return node
+        uid = self._uid()
+        self.changed = True
+        cond_name, body_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        out_name = f"__dy2st_out_{uid}"
+        cond_fn = _make_branch_fn(
+            cond_name, assigned, [], []
+        )
+        # cond returns the predicate, not a tuple
+        cond_fn.body = [ast.Return(value=_convert_predicate(node.test))]
+        body_fn = _make_branch_fn(body_name, assigned, node.body, assigned)
+        call = ast.Assign(
+            targets=[_name(out_name, ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_while"),
+                args=[
+                    _name(cond_name), _name(body_name),
+                    ast.Tuple(
+                        elts=[_capture_call(n) for n in assigned],
+                        ctx=ast.Load(),
+                    ),
+                    ast.Constant(tuple(assigned)),
+                ],
+                keywords=[],
+            ),
+        )
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store()) for n in assigned],
+                ctx=ast.Store(),
+            )],
+            value=_name(out_name),
+        )
+        return [cond_fn, body_fn, call, unpack]
+
+
+# ------------------------------------------------------------ entry point
+def convert_to_static(fn):
+    """Apply the control-flow AST pass to ``fn``; returns the transformed
+    function, or ``fn`` unchanged when there is nothing to convert or the
+    source is unavailable (built-ins, lambdas, exec'd code)."""
+    import inspect
+
+    bound_self = None
+    if isinstance(fn, types.MethodType):
+        bound_self = fn.__self__
+        fn = fn.__func__
+    if not isinstance(fn, types.FunctionType):
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+    if hasattr(fn, "__wrapped__"):
+        # a decorator wrapper: getsource would unwrap to the inner def and
+        # recompiling would silently drop the decorator — leave untouched
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, IndentationError, SyntaxError):
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+    fdef.decorator_list = []  # avoid re-running to_static/wrappers
+
+    tr = _ControlFlowTransformer()
+    # visit the body statements (visit(fdef) itself would skip: nested
+    # FunctionDefs are deliberately opaque to the transformer)
+    new_body = []
+    for stmt in fdef.body:
+        res = tr.visit(stmt)
+        if isinstance(res, list):
+            new_body.extend(res)
+        elif res is not None:
+            new_body.append(res)
+    fdef.body = new_body
+    if not tr.changed:
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+
+    # zero-arg super() would need the class-body __class__ cell; rewrite
+    # it to super(__class__, self) — __class__ arrives via the closure
+    # snapshot below (the original method's implicit cell)
+    self_name = None
+    if fdef.args.args:
+        self_name = fdef.args.args[0].arg
+    elif fdef.args.posonlyargs:
+        self_name = fdef.args.posonlyargs[0].arg
+    _SuperRewriter(self_name).visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    from . import convert_ifelse  # noqa: F401  (module import below)
+    from .. import dy2static as _jst_module
+
+    globs = dict(fn.__globals__)
+    globs["_jst"] = _jst_module
+    # snapshot closure cells: the regenerated code has no free variables.
+    # NOTE: a snapshot — names rebound in the enclosing scope after
+    # conversion keep their conversion-time values (documented limit).
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                globs[name] = cell.cell_contents
+            except ValueError:
+                # empty cell (recursive / forward-referenced def): a
+                # silent skip would NameError at call time — don't convert
+                warnings.warn(
+                    f"to_static: cannot convert {fn.__qualname__}: free "
+                    f"variable '{name}' is not yet bound; falling back to "
+                    "trace-only compilation"
+                )
+                return (
+                    fn if bound_self is None
+                    else types.MethodType(fn, bound_self)
+                )
+
+    try:
+        code = compile(tree, f"<dy2static {fn.__qualname__}>", "exec")
+        ns = {}
+        exec(code, globs, ns)
+        new_fn = ns[fdef.name]
+    except Exception as e:  # pragma: no cover - transform must never break
+        warnings.warn(
+            f"to_static: control-flow conversion of {fn.__qualname__} "
+            f"failed ({type(e).__name__}: {e}); falling back to "
+            "trace-only compilation"
+        )
+        return fn if bound_self is None else types.MethodType(fn, bound_self)
+
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    functools.update_wrapper(new_fn, fn)
+    new_fn.__dy2static_source__ = ast.unparse(tree)
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn

@@ -41,6 +41,20 @@ class CompiledTrainStep:
 
     def __init__(self, network, loss_fn, optimizer, amp_level=None,
                  amp_dtype="bfloat16", scaler=None):
+        from .dy2static import convert_to_static
+
+        # dy2static pass on the top-level forward so Python if/while on
+        # tensor values compile (lax.cond/while_loop) inside the step.
+        # The converted forward is swapped in ONLY while tracing the step
+        # (_forward_traced) — plain eager calls keep the original method.
+        self._converted_forward = None
+        fw = network.forward
+        if callable(fw) and not hasattr(fw, "_jitted"):
+            conv = convert_to_static(fw)
+            if getattr(conv, "__func__", conv) is not getattr(
+                fw, "__func__", fw
+            ):
+                self._converted_forward = conv
         self.network = network
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -98,7 +112,21 @@ class CompiledTrainStep:
         """Network invocation inside the traced step (hook: the pipeline
         trainer overrides this to run the stacked-stage shard_map
         schedule instead of the sequential forward)."""
-        return self.network(*(Tensor(v) for v in inputs))
+        if self._converted_forward is None:
+            return self.network(*(Tensor(v) for v in inputs))
+        # temporary swap so Layer.__call__ hooks still run around the
+        # dy2static-converted body; restored even if tracing throws
+        d = self.network.__dict__
+        had_own = "forward" in d
+        prev = d.get("forward")
+        d["forward"] = self._converted_forward
+        try:
+            return self.network(*(Tensor(v) for v in inputs))
+        finally:
+            if had_own:
+                d["forward"] = prev
+            else:
+                d.pop("forward", None)
 
     # ----------------------------------------------------------- pure step
     def _build(self):
@@ -382,6 +410,28 @@ class CompiledTrainStep:
             step = base
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
 
+    def _invoke(self, *step_args):
+        """Run the jitted step, translating XLA's unbounded-while reverse-AD
+        limitation into an actionable paddle-level error."""
+        try:
+            return self._step_fn(*step_args)
+        except ValueError as e:
+            msg = str(e)
+            if "Reverse-mode differentiation" in msg and "while_loop" in msg:
+                from .dy2static import Dy2StaticError
+
+                raise Dy2StaticError(
+                    "a value-dependent `while` loop inside the training "
+                    "step is not reverse-differentiable on XLA. If the "
+                    "loop result needs gradients, bound the loop: "
+                    "paddle.static.nn.while_loop(..., maximum_trip_count="
+                    "N) lowers to a fixed-length masked scan that trains; "
+                    "or rewrite with a concrete Python trip count "
+                    "(unrolled). Unbounded tensor-condition loops are "
+                    "inference-only."
+                ) from e
+            raise
+
     # ---------------------------------------------------------------- call
     def __call__(self, inputs, labels):
         if self._step_fn is None:
@@ -389,7 +439,7 @@ class CompiledTrainStep:
         params = {k: p.value for k, p in self.network.named_parameters()}
         buffers = {k: b.value for k, b in self.network.named_buffers()}
         opt_state = self._gather_opt_state(params)
-        if self._step_fn is None:
+        if self._step_fn is None:  # (compile happens on first _invoke)
             self._finalize_jit(params, opt_state, buffers)
         self.optimizer._step_count += 1
         lr = jnp.float32(self.optimizer.get_lr())
@@ -400,7 +450,7 @@ class CompiledTrainStep:
         if self.scaler is not None:
             sc = self.scaler
             (new_params, new_state, new_buffers, loss, out_vals,
-             scale2, good2, bad2, finite) = self._step_fn(
+             scale2, good2, bad2, finite) = self._invoke(
                 params, opt_state, buffers, lr, t, rng, in_vals, lbl_vals,
                 jnp.float32(sc._scale), jnp.int32(sc._good_steps),
                 jnp.int32(sc._bad_steps),
@@ -415,7 +465,7 @@ class CompiledTrainStep:
                 self.optimizer._step_count -= 1
         else:
             new_params, new_state, new_buffers, loss, out_vals = \
-                self._step_fn(
+                self._invoke(
                     params, opt_state, buffers, lr, t, rng, in_vals,
                     lbl_vals,
                 )

@@ -33,3 +33,7 @@ class InputSpec:
 
         shape = [batch if (s is None or s < 0) else s for s in (self.shape or [])]
         return jnp.zeros(shape, self.dtype)
+
+
+# imported last: static.nn pulls in jit (which needs InputSpec above)
+from . import nn  # noqa: E402,F401  (control flow: cond/while_loop/...)

@@ -1,0 +1,86 @@
+"""paddle.static.nn — control-flow ops.
+
+Reference parity: python/paddle/static/nn/control_flow.py (cond /
+while_loop / switch_case / case — unverified, mount empty). TPU-first
+redesign: these lower to XLA's structured control flow — ``lax.cond``,
+``lax.while_loop``, ``lax.switch`` — compiled into on-device HLO
+conditionals/loops (no host interpreter like the reference's
+ConditionalBlock/While ops). With a concrete (eager) predicate they run
+as ordinary Python with tape autograd; with a traced predicate they are
+reverse-differentiable through whole-step jit (``cond``/``switch_case``
+natively; ``while_loop`` is forward-only under reverse AD, an XLA
+constraint — use ``lax.scan``-style bounded loops / unrolled Python loops
+for trainable recurrences).
+"""
+from __future__ import annotations
+
+from ...jit.dy2static import cond_impl, switch_impl, while_impl
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()``.
+
+    Both callables take no arguments (close over what they need) and must
+    return matching Tensor structures when ``pred`` is traced.
+    """
+    t = true_fn if true_fn is not None else (lambda: None)
+    f = false_fn if false_fn is not None else (lambda: None)
+    return cond_impl(pred, t, f, names=return_names, where="cond")
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
+    """Repeat ``body(*loop_vars)`` while ``cond(*loop_vars)`` holds.
+
+    ``loop_vars`` is a list/tuple; ``body`` must return the same number of
+    values. Returns the final loop variables as a list (paddle contract).
+
+    ``maximum_trip_count`` (TPU extension): bound the traced loop so it
+    lowers to a fixed-length masked scan, which reverse-mode AD supports
+    — required when the loop output is trained through (XLA cannot
+    backprop an unbounded ``lax.while_loop``).
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError(
+            "while_loop: loop_vars must be a non-empty list/tuple, got "
+            f"{type(loop_vars).__name__}"
+        )
+    if not callable(cond) or not callable(body):
+        raise TypeError("while_loop: cond and body must be callable")
+    out = while_impl(
+        cond, body, tuple(loop_vars), where="while_loop",
+        maximum_trip_count=maximum_trip_count,
+    )
+    return list(out)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Run the branch whose index matches ``branch_index``; unmatched or
+    out-of-range indices run ``default`` (paddle: the largest-index branch
+    when no default is given)."""
+    return switch_impl(
+        branch_index, branch_fns, default=default, where="switch_case"
+    )
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins condition chain (paddle.static.nn.case): pairs of
+    (scalar bool Tensor, callable)."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+
+    def build(i):
+        if i == len(pairs):
+            if default is None:
+                # paddle: the last branch doubles as the default
+                return pairs[-1][1]
+            return default
+        pred, fn = pairs[i]
+        return lambda: cond_impl(
+            pred, fn, build(i + 1), where="case"
+        )
+
+    return build(0)()

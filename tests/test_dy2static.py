@@ -1,0 +1,532 @@
+"""dy2static: paddle.static.nn control-flow ops + the to_static AST pass.
+
+Reference test strategy parity (SURVEY.md §4): eager-vs-converted parity
+on models with data-dependent branches/loops, plus error-quality checks
+for the unconvertible subset (the reference's unsupported-syntax errors).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.dy2static import Dy2StaticError, convert_to_static
+
+RNG = np.random.RandomState(7)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+# ------------------------------------------------------------ public cond
+def test_cond_eager_both_branches():
+    x = T(np.float32(2.0))
+    hi = paddle.static.nn.cond(
+        x > 1.0, lambda: x * 10.0, lambda: x - 1.0
+    )
+    lo = paddle.static.nn.cond(
+        x < 1.0, lambda: x * 10.0, lambda: x - 1.0
+    )
+    assert float(hi.numpy()) == pytest.approx(20.0)
+    assert float(lo.numpy()) == pytest.approx(1.0)
+
+
+def test_cond_traced_in_to_static():
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.static.nn.cond(
+            x.sum() > 0, lambda: x * 2.0, lambda: -x
+        )
+
+    a = RNG.randn(4).astype(np.float32) + 5.0
+    b = RNG.randn(4).astype(np.float32) - 5.0
+    np.testing.assert_allclose(f(T(a)).numpy(), a * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(f(T(b)).numpy(), -b, rtol=1e-6)
+
+
+def test_cond_nested_structure_and_statics():
+    @paddle.jit.to_static
+    def f(x):
+        out = paddle.static.nn.cond(
+            x.sum() > 0,
+            lambda: {"a": x * 2.0, "n": 3, "pair": (x + 1.0, x - 1.0)},
+            lambda: {"a": x * 0.5, "n": 3, "pair": (x * 0.0, x * 3.0)},
+        )
+        return out["a"] + out["pair"][0] * out["n"]
+
+    a = np.ones(3, np.float32)
+    np.testing.assert_allclose(
+        f(T(a)).numpy(), a * 2 + (a + 1) * 3, rtol=1e-6
+    )
+
+
+def test_cond_branch_mismatch_clear_error():
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.static.nn.cond(
+            x.sum() > 0, lambda: (x, x), lambda: x
+        )
+
+    with pytest.raises((Dy2StaticError, Exception)) as ei:
+        f(T(np.ones(3, np.float32)))
+    assert "branch" in str(ei.value).lower()
+
+
+# ------------------------------------------------------ public while_loop
+def test_while_loop_eager():
+    i = T(np.int32(0))
+    s = T(np.float32(0.0))
+    i2, s2 = paddle.static.nn.while_loop(
+        lambda i, s: i < 5, lambda i, s: (i + 1, s + 2.0), [i, s]
+    )
+    assert int(i2.numpy()) == 5
+    assert float(s2.numpy()) == pytest.approx(10.0)
+
+
+def test_while_loop_traced():
+    @paddle.jit.to_static
+    def f(x):
+        def cond(i, acc):
+            return i < x.shape[0]
+
+        def body(i, acc):
+            return i + 1, acc + x[i]
+
+        _, total = paddle.static.nn.while_loop(
+            cond, body, [T(np.int32(0)), x.sum() * 0.0]
+        )
+        return total
+
+    a = RNG.randn(6).astype(np.float32)
+    np.testing.assert_allclose(
+        float(f(T(a)).numpy()), a.sum(), rtol=1e-5
+    )
+
+
+def test_while_loop_shape_change_clear_error():
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.static.nn.while_loop(
+            lambda v: v.sum() < 100.0,
+            lambda v: (paddle.concat([v, v]),),
+            [x],
+        )[0]
+
+    with pytest.raises(Dy2StaticError) as ei:
+        f(T(np.ones(2, np.float32)))
+    assert "shape" in str(ei.value).lower() or "carr" in str(ei.value).lower()
+
+
+# ------------------------------------------------------ public switch_case
+def test_switch_case_eager_and_default():
+    fns = {1: lambda: T(np.float32(10.0)), 3: lambda: T(np.float32(30.0))}
+    out = paddle.static.nn.switch_case(T(np.int32(3)), list(fns.items()))
+    assert float(out.numpy()) == pytest.approx(30.0)
+    # unmatched -> largest-index branch doubles as default
+    out = paddle.static.nn.switch_case(T(np.int32(7)), list(fns.items()))
+    assert float(out.numpy()) == pytest.approx(30.0)
+
+
+def test_switch_case_traced():
+    @paddle.jit.to_static
+    def f(idx, x):
+        return paddle.static.nn.switch_case(
+            idx,
+            [(0, lambda: x + 1.0), (2, lambda: x * 10.0)],
+            default=lambda: x * 0.0,
+        )
+
+    x = np.ones(3, np.float32)
+    np.testing.assert_allclose(f(T(np.int32(0)), T(x)).numpy(), x + 1)
+    np.testing.assert_allclose(f(T(np.int32(2)), T(x)).numpy(), x * 10)
+    np.testing.assert_allclose(f(T(np.int32(9)), T(x)).numpy(), x * 0)
+
+
+def test_case_chain():
+    x = T(np.float32(5.0))
+    out = paddle.static.nn.case(
+        [(x < 0.0, lambda: x * 0.0), (x < 10.0, lambda: x * 2.0)],
+        default=lambda: x,
+    )
+    assert float(out.numpy()) == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------- AST conversion
+def test_ast_if_parity():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y + 1.0
+
+    sf = paddle.jit.to_static(f)
+    a = RNG.randn(4).astype(np.float32) + 5.0
+    b = RNG.randn(4).astype(np.float32) - 5.0
+    for v in (a, b):
+        np.testing.assert_allclose(
+            sf(T(v)).numpy(), f(T(v)).numpy(), rtol=1e-6
+        )
+
+
+def test_ast_if_elif_chain():
+    def f(x):
+        s = x.sum()
+        if s > 10.0:
+            y = x * 3.0
+        elif s > 0.0:
+            y = x * 2.0
+        else:
+            y = x * 0.0
+        return y
+
+    sf = paddle.jit.to_static(f)
+    for scale in (100.0, 1.0, -100.0):
+        v = np.ones(4, np.float32) * scale
+        np.testing.assert_allclose(
+            sf(T(v)).numpy(), f(T(v)).numpy(), rtol=1e-6
+        )
+
+
+def test_ast_if_boolop_predicate():
+    def f(x):
+        if (x.sum() > 0) and (x.mean() < 10.0):
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = paddle.jit.to_static(f)
+    for v in (np.ones(4, np.float32), -np.ones(4, np.float32),
+              np.full((4,), 100.0, np.float32)):
+        np.testing.assert_allclose(
+            sf(T(v)).numpy(), f(T(v)).numpy(), rtol=1e-6
+        )
+
+
+def test_ast_while_parity():
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        acc = paddle.zeros([], dtype="float32")
+        while i < 4:
+            acc = acc + x.sum()
+            i = i + 1
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    v = RNG.randn(3).astype(np.float32)
+    np.testing.assert_allclose(
+        float(sf(T(v)).numpy()), float(f(T(v)).numpy()), rtol=1e-5
+    )
+    np.testing.assert_allclose(float(sf(T(v)).numpy()), 4 * v.sum(), rtol=1e-5)
+
+
+def test_ast_while_tensor_condition():
+    def f(x):
+        # value-dependent trip count: genuinely needs lax.while_loop
+        v = x
+        while v.sum() < 100.0:
+            v = v * 2.0
+        return v
+
+    sf = paddle.jit.to_static(f)
+    start = np.ones(4, np.float32)
+    np.testing.assert_allclose(
+        sf(T(start)).numpy(), f(T(start)).numpy(), rtol=1e-6
+    )
+    assert float(sf(T(start)).numpy().sum()) >= 100.0
+
+
+def test_ast_python_if_untouched():
+    # concrete (non-tensor) conditions keep plain Python semantics
+    def f(x, flag=True):
+        if flag:
+            y = x + 1.0
+        else:
+            y = x - 1.0
+        return y
+
+    sf = paddle.jit.to_static(f)
+    v = np.zeros(3, np.float32)
+    np.testing.assert_allclose(sf(T(v)).numpy(), v + 1.0)
+
+
+def test_ast_variable_defined_one_branch_error():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            z = x * 3.0  # noqa: F841 — y undefined here
+        return y
+
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Dy2StaticError) as ei:
+        sf(T(np.ones(3, np.float32)))
+    assert "both branches" in str(ei.value)
+
+
+def test_unconvertible_early_return_clear_error():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2.0
+        return -x
+
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Exception) as ei:
+        sf(T(np.ones(3, np.float32)))
+    msg = str(ei.value)
+    assert "paddle.static.nn.cond" in msg or "to_static" in msg
+
+
+def test_item_under_trace_clear_error():
+    @paddle.jit.to_static
+    def f(x):
+        return x * x.item()
+
+    with pytest.raises(Exception) as ei:
+        f(T(np.float32(2.0)))
+    assert "item()" in str(ei.value)
+
+
+# -------------------------------------------- control flow under training
+class _BranchyNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.lin(x)
+        if h.mean() > 0:
+            out = h * 2.0
+        else:
+            out = h * 0.5
+        return out.sum()
+
+
+def _train_steps(net, xs, compiled):
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()
+    )
+    losses = []
+    if compiled:
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+
+        step = CompiledTrainStep(net, lambda out, _: out, opt)
+        for x in xs:
+            loss, _ = step([T(x)], [T(np.zeros((), np.float32))])
+            losses.append(float(np.asarray(loss.numpy())))
+    else:
+        for x in xs:
+            loss = net(T(x))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss.numpy())))
+    return losses
+
+
+def test_branchy_model_compiled_training_parity():
+    xs = [RNG.randn(2, 4).astype(np.float32) for _ in range(4)]
+    paddle.seed(11)
+    net_e = _BranchyNet()
+    paddle.seed(11)
+    net_c = _BranchyNet()
+    le = _train_steps(net_e, xs, compiled=False)
+    lc = _train_steps(net_c, xs, compiled=True)
+    np.testing.assert_allclose(le, lc, rtol=1e-4, atol=1e-5)
+    for (k, pe), (_, pc) in zip(
+        net_e.named_parameters(), net_c.named_parameters()
+    ):
+        np.testing.assert_allclose(
+            np.asarray(pe.numpy()), np.asarray(pc.numpy()),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_while_loop_maximum_trip_count_trains():
+    # bounded loop -> masked lax.scan: reverse-differentiable
+    class LoopNet(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+
+            def cond(v):
+                return v.sum() < 50.0
+
+            def body(v):
+                return (v * 2.0,)
+
+            (v,) = paddle.static.nn.while_loop(
+                cond, body, [h.abs() + 0.1], maximum_trip_count=16
+            )
+            return v.sum()
+
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    paddle.seed(3)
+    net = LoopNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    step = CompiledTrainStep(net, lambda out, _: out, opt)
+    x = RNG.randn(2, 4).astype(np.float32)
+    before = np.asarray(net.lin.weight.numpy()).copy()
+    loss, _ = step([T(x)], [T(np.zeros((), np.float32))])
+    assert np.isfinite(float(np.asarray(loss.numpy())))
+    after = np.asarray(net.lin.weight.numpy())
+    assert not np.allclose(before, after)  # grads flowed through the loop
+
+
+def test_while_loop_masked_scan_value_parity():
+    # the masked scan must compute the same value as the unbounded loop
+    @paddle.jit.to_static
+    def bounded(x):
+        return paddle.static.nn.while_loop(
+            lambda v: v.sum() < 100.0, lambda v: (v * 2.0,), [x],
+            maximum_trip_count=32,
+        )[0]
+
+    @paddle.jit.to_static
+    def unbounded(x):
+        return paddle.static.nn.while_loop(
+            lambda v: v.sum() < 100.0, lambda v: (v * 2.0,), [x],
+        )[0]
+
+    a = np.ones(4, np.float32)
+    np.testing.assert_allclose(
+        bounded(T(a)).numpy(), unbounded(T(a)).numpy(), rtol=1e-6
+    )
+
+
+def test_unbounded_while_in_training_clear_error():
+    class BadNet(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x).abs() + 0.1
+            (v,) = paddle.static.nn.while_loop(
+                lambda v: v.sum() < 50.0, lambda v: (v * 2.0,), [h]
+            )
+            return v.sum()
+
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    net = BadNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    step = CompiledTrainStep(net, lambda out, _: out, opt)
+    with pytest.raises(Dy2StaticError) as ei:
+        step([T(RNG.randn(2, 4).astype(np.float32))],
+             [T(np.zeros((), np.float32))])
+    assert "maximum_trip_count" in str(ei.value)
+
+
+class _BaseNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        return self.lin(x)
+
+
+class _SuperNet(_BaseNet):
+    def forward(self, x):
+        h = super().forward(x)  # zero-arg super inside converted code
+        if h.mean() > 0:
+            y = h * 2.0
+        else:
+            y = -h
+        return y.sum()
+
+
+def test_converted_forward_with_super():
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    paddle.seed(5)
+    net = _SuperNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = CompiledTrainStep(net, lambda out, _: out ** 2, opt)
+    x = RNG.randn(2, 4).astype(np.float32)
+    loss, _ = step([T(x)], [T(np.zeros((), np.float32))])
+    assert np.isfinite(float(np.asarray(loss.numpy())))
+    # eager forward must be the ORIGINAL method (no permanent mutation)
+    assert "forward" not in net.__dict__
+    out = net(T(x))
+    assert np.isfinite(float(np.asarray(out.numpy())))
+
+
+def test_while_loop_eager_respects_maximum_trip_count():
+    i = T(np.int32(0))
+    (i2,) = paddle.static.nn.while_loop(
+        lambda i: i < 100, lambda i: (i + 1,), [i],
+        maximum_trip_count=4,
+    )
+    assert int(i2.numpy()) == 4  # bound applies in eager too
+
+
+def test_wrapped_function_not_converted():
+    import functools
+
+    def deco(f):
+        @functools.wraps(f)
+        def inner(*a, **k):
+            return f(*a, **k)
+
+        return inner
+
+    @deco
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = -x
+        return y
+
+    assert convert_to_static(f) is f  # wrapper: refuses to recompile
+
+
+def test_user_typeerror_in_branch_not_rebranded():
+    @paddle.jit.to_static
+    def f(x):
+        def bad():
+            len(None)  # genuine user bug
+            return x
+
+        return paddle.static.nn.cond(x.sum() > 0, bad, lambda: x)
+
+    with pytest.raises(TypeError) as ei:
+        f(T(np.ones(3, np.float32)))
+    assert "len()" in str(ei.value)
+    assert not isinstance(ei.value, Dy2StaticError)
+
+
+# ------------------------------------------------------- converter direct
+def test_convert_to_static_noop_without_control_flow():
+    def f(x):
+        return x + 1.0
+
+    assert convert_to_static(f) is f
+
+
+def test_convert_preserves_defaults_and_python_semantics():
+    def f(x, k=3):
+        if k > 1:  # concrete int condition
+            y = x * k
+        else:
+            y = x
+        return y
+
+    cf = convert_to_static(f)
+    assert cf is not f
+    v = np.ones(2, np.float32)
+    np.testing.assert_allclose(cf(T(v)).numpy(), v * 3)
+    np.testing.assert_allclose(cf(T(v), 1).numpy(), v)
