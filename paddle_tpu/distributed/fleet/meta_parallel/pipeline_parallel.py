@@ -67,6 +67,7 @@ class PipelineParallel(Layer):
         # debug/correctness path
         self._use_compiled = bool(pipe_cfg.get("compiled", False))
         self._compiled_amp = pipe_cfg.get("amp_level", None)
+        self._compiled_amp_dtype = pipe_cfg.get("amp_dtype", "bfloat16")
         self._compiled_step = None
 
     # re-expose the wrapped model
@@ -102,15 +103,14 @@ class PipelineParallel(Layer):
 
     def _train_batch_compiled(self, inputs, labels, optimizer,
                               lr_scheduler=None, scaler=None):
-        if scaler is not None:
-            raise NotImplementedError(
-                "GradScaler is not supported on the compiled pipeline "
-                "path; use pipeline_configs['amp_level']='O2' (bf16)"
-            )
+        from ....jit.trainer import CompiledTrainStep
+
         if (self._compiled_step is not None
-                and self._compiled_step.optimizer is not optimizer):
-            # a fresh optimizer (e.g. after resume) needs a rebuilt step —
-            # the jitted update is bound to the optimizer's accumulators
+                and (self._compiled_step.optimizer is not optimizer
+                     or self._compiled_step.scaler
+                     is not CompiledTrainStep._normalize_scaler(scaler))):
+            # a fresh optimizer/scaler (e.g. after resume) needs a rebuilt
+            # step — the jitted update is bound to their state layout
             self._compiled_step = None
         if self._compiled_step is None:
             from ....jit.pipeline_trainer import CompiledPipelineTrainStep
@@ -127,6 +127,8 @@ class PipelineParallel(Layer):
                 micro_batches=self.accumulate_steps,
                 num_virtual=model.get_num_virtual_stages(),
                 amp_level=self._compiled_amp,
+                amp_dtype=self._compiled_amp_dtype,
+                scaler=scaler,
             )
         self._layers.train()
         loss, _ = self._compiled_step(inputs, labels)

@@ -54,7 +54,7 @@ def _block_signature(layer):
 class CompiledPipelineTrainStep(CompiledTrainStep):
     def __init__(self, layers, loss_fn, optimizer, micro_batches=1,
                  num_virtual=1, amp_level=None, amp_dtype="bfloat16",
-                 pp_axis="pp"):
+                 pp_axis="pp", scaler=None):
         from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers \
             import PipelineLayer
 
@@ -62,7 +62,15 @@ class CompiledPipelineTrainStep(CompiledTrainStep):
             raise TypeError(
                 "CompiledPipelineTrainStep expects a PipelineLayer"
             )
-        super().__init__(layers, loss_fn, optimizer, amp_level, amp_dtype)
+        # fp16 dynamic loss scaling rides the base class's in-trace
+        # mechanism unchanged: the whole-batch loss after the ppermute
+        # schedule is scaled, grads unscaled + finite-checked across ALL
+        # stages at once (SPMD: every rank sees the global grads), and the
+        # update conditionally skipped with scaler state carried through
+        # the jitted step (reference: PipelineParallel + GradScaler).
+        super().__init__(
+            layers, loss_fn, optimizer, amp_level, amp_dtype, scaler=scaler
+        )
         self.micro_batches = int(micro_batches)
         self.num_virtual = int(num_virtual)
         self.pp_axis = pp_axis

@@ -63,9 +63,7 @@ class CompiledTrainStep:
         # fp16 dynamic loss scaling fused INTO the compiled step: scale
         # the loss, unscale grads, skip the update on inf/nan, and grow/
         # shrink the scale — all in-trace (reference GradScaler + fp16)
-        self.scaler = scaler if (
-            scaler is not None and getattr(scaler, "_enable", True)
-        ) else None
+        self.scaler = self._normalize_scaler(scaler)
         self._kind = None
         for cls in self.SUPPORTED:
             if type(optimizer) is cls or isinstance(optimizer, cls):
@@ -78,6 +76,14 @@ class CompiledTrainStep:
             )
         self._step_fn = None
         self._param_names = [k for k, _ in network.named_parameters()]
+
+    @staticmethod
+    def _normalize_scaler(scaler):
+        """A disabled GradScaler is the same as no scaler (shared with
+        callers that need to compare against self.scaler)."""
+        if scaler is not None and getattr(scaler, "_enable", True):
+            return scaler
+        return None
 
     # ------------------------------------------------------------ opt state
     def _gather_opt_state(self, params):
@@ -247,9 +253,16 @@ class CompiledTrainStep:
                     for g in jax.tree_util.tree_leaves(grads)
                 )
                 gnorm = jnp.sqrt(sq)
-                scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gnorm, 1e-12))
+                # NOT named `scale`: that closure variable is the fp16
+                # loss scale, which the scaler update below reads
+                clip_coef = jnp.minimum(
+                    1.0, clip.clip_norm / jnp.maximum(gnorm, 1e-12)
+                )
                 grads = jax.tree_util.tree_map(
-                    lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads
+                    lambda g: (g.astype(jnp.float32) * clip_coef).astype(
+                        g.dtype
+                    ),
+                    grads,
                 )
             elif isinstance(clip, ClipGradByNorm):
                 def _pn(g):
