@@ -10,14 +10,18 @@ from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_parallel import (  # noqa: F401
+    DistModel,
+    Engine,
     Partial,
     ProcessMesh,
     Replicate,
     Shard,
     get_placements,
     reshard,
+    shard_dataloader,
     shard_layer,
     shard_tensor,
+    to_static,
 )
 from .communication import (  # noqa: F401
     ReduceOp,

@@ -283,3 +283,13 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
             lambda lyr, inputs, outputs: output_fn(outputs, process_mesh)
         )
     return layer
+
+
+# imported last (engine.py reads names defined above)
+from .engine import (  # noqa: E402,F401
+    DistModel,
+    Engine,
+    ShardDataloader,
+    shard_dataloader,
+    to_static,
+)
