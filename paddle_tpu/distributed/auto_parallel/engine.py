@@ -58,8 +58,8 @@ class ShardDataloader:
             v.value if isinstance(v, Tensor)
             else (v.numpy() if hasattr(v, "numpy") else v)
         )
-        if self._shard_dims is None:
-            spec = P(*([None] * arr.ndim))
+        if self._shard_dims is None or arr.ndim == 0:
+            spec = P(*([None] * arr.ndim))  # scalars: replicate
         else:
             axes = (
                 self._shard_dims if isinstance(self._shard_dims, (list, tuple))
@@ -221,30 +221,44 @@ class Engine:
     def model(self):
         return self._dist
 
-    def _split_batch(self, batch):
-        """(inputs, labels) tuple, or a dict routed by input/label_keys."""
+    def _split_batch(self, batch, for_predict=False):
+        """(inputs, labels) pair or a dict routed by input/label_keys.
+        In predict mode labels are optional and a bare batch is treated
+        as inputs."""
         if isinstance(batch, dict):
             if not self._input_keys:
                 raise ValueError(
-                    "dict batches need Engine(input_keys=[...], "
-                    "label_keys=[...]) to say which entries feed the "
-                    "network vs. the loss"
+                    "dict batches need Engine(input_keys=[...]"
+                    + ("" if for_predict else ", label_keys=[...]")
+                    + ") to say which entries feed the network"
+                    + ("" if for_predict else " vs. the loss")
+                )
+            if not for_predict and not self._label_keys:
+                raise ValueError(
+                    "dict batches in fit/evaluate need "
+                    "Engine(label_keys=[...]) naming the loss targets"
                 )
             inputs = [batch[k] for k in self._input_keys]
             labels = [batch[k] for k in (self._label_keys or [])]
             return inputs, labels
-        if not (isinstance(batch, (list, tuple)) and len(batch) == 2):
-            raise ValueError(
-                "Engine expects (inputs, labels) pair batches (wrap "
-                "multiple inputs in a list: ([x1, x2], y)), or dict "
-                f"batches with input_keys/label_keys; got "
-                f"{type(batch).__name__} of length "
-                f"{len(batch) if hasattr(batch, '__len__') else '?'}"
+        if isinstance(batch, (list, tuple)) and len(batch) == 2:
+            inputs, labels = batch
+            return (
+                inputs if isinstance(inputs, (list, tuple)) else [inputs],
+                labels if isinstance(labels, (list, tuple)) else [labels],
             )
-        inputs, labels = batch
-        return (
-            inputs if isinstance(inputs, (list, tuple)) else [inputs],
-            labels if isinstance(labels, (list, tuple)) else [labels],
+        if for_predict:
+            # bare inputs (no labels) are fine for prediction
+            return (
+                list(batch) if isinstance(batch, (list, tuple))
+                else [batch]
+            ), []
+        raise ValueError(
+            "Engine expects (inputs, labels) pair batches (wrap "
+            "multiple inputs in a list: ([x1, x2], y)), or dict "
+            f"batches with input_keys/label_keys; got "
+            f"{type(batch).__name__} of length "
+            f"{len(batch) if hasattr(batch, '__len__') else '?'}"
         )
 
     def _run_loop(self, data, steps=None):
@@ -280,17 +294,6 @@ class Engine:
         for step_i, batch in enumerate(test_data):
             if steps is not None and step_i >= steps:
                 break
-            if isinstance(batch, dict):
-                if not self._input_keys:
-                    raise ValueError(
-                        "dict batches need Engine(input_keys=[...])"
-                    )
-                inputs = [batch[k] for k in self._input_keys]
-            elif isinstance(batch, (list, tuple)) and len(batch) == 2:
-                inputs = batch[0]  # (inputs, labels) pair: drop labels
-            else:
-                inputs = batch
-            outs.append(self._dist(
-                *(inputs if isinstance(inputs, (list, tuple)) else [inputs])
-            ))
+            inputs, _ = self._split_batch(batch, for_predict=True)
+            outs.append(self._dist(*inputs))
         return outs

@@ -39,9 +39,13 @@ from . import mesh as mesh_mod
 _NEG = -1e30
 
 
-def _ring_attention_local(q, k, v, *, axis, seg, causal, scale):
-    """Local shard_map body. q/k/v: local [B, Sl, H, D] blocks."""
-    p = jax.lax.axis_index(axis)
+def _ring_attention_local(q, k, v, idx, *, axis, seg, causal, scale):
+    """Local shard_map body. q/k/v: local [B, Sl, H, D] blocks; ``idx`` is
+    this shard's position on the sep ring, delivered as a sep-sharded
+    iota operand ([1] locally) instead of ``lax.axis_index`` — whose
+    lowering binds every other mesh axis manually and therefore cannot
+    nest inside the compiled pipeline's pp-manual shard_map."""
+    p = idx[0]
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)  # [B, H, Sq, D]
     kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
     vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -76,8 +80,10 @@ def _ring_attention_local(q, k, v, *, axis, seg, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def _ulysses_local(q, k, v, *, axis, causal, scale):
-    """Local shard_map body. q/k/v: local [B, Sl, H, D] blocks."""
+def _ulysses_local(q, k, v, idx, *, axis, causal, scale):
+    """Local shard_map body. q/k/v: local [B, Sl, H, D] blocks. ``idx``
+    (ring position, unused here) keeps the shard_map signature uniform."""
+    del idx
 
     def a2a(x, split_axis, concat_axis):
         return jax.lax.all_to_all(
@@ -108,13 +114,32 @@ def _sep_spec(axis):
 
 def _sharded(kind, body, q, k, v, axis):
     mesh = mesh_mod.get_mesh()
+    # nested-shard_map composition (sep attention INSIDE the compiled pp
+    # ring): when the trace already sits inside a shard_map whose mesh has
+    # Manual axes (the pipeline is manual over pp only), the inner
+    # shard_map must be built on the context's abstract mesh
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if getattr(ctx, "axis_names", ()) and any(
+            t == jax.sharding.AxisType.Manual
+            for t in getattr(ctx, "axis_types", ())
+        ):
+            mesh = ctx
+    except Exception:
+        pass
     spec = _sep_spec(axis)
+    seg = mesh_mod.axis_size(axis)
+    # manual over sep ONLY: dp/mp stay in GSPMD auto mode so batch/head
+    # shardings compose (and pp, when present, stays the outer ring's)
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
+        body, mesh=mesh, in_specs=(spec, spec, spec, P(axis)),
+        out_specs=spec, check_vma=True, axis_names={axis},
     )
-    return dispatch.apply(kind, lambda qv, kv, vv: fn(qv, kv, vv),
-                          (q, k, v), cache=False)
+    idx = jnp.arange(seg, dtype=jnp.int32)
+    return dispatch.apply(
+        kind, lambda qv, kv, vv: fn(qv, kv, vv, idx), (q, k, v),
+        cache=False,
+    )
 
 
 def ring_flash_attention(q, k, v, causal=True, axis=None):

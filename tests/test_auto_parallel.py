@@ -256,6 +256,28 @@ def test_dist_model_missing_loss_clear_error():
         dm.eval()(Tensor(jnp.ones([2, 8])), Tensor(jnp.ones([2, 4])))
 
 
+def test_shard_dataloader_scalar_entries(pmesh):
+    # 0-d entries (metadata) replicate instead of crashing device_put
+    data = [{"image": Tensor(jnp.ones([4, 8])), "n": np.int32(7)}]
+    loader = dist.shard_dataloader(data, pmesh, shard_dims="x")
+    batch = next(iter(loader))
+    assert batch["image"].value.sharding.spec[0] == "x"
+    assert int(batch["n"].numpy()) == 7
+
+
+def test_engine_dict_batch_missing_label_keys_error(pmesh):
+    net = _MLP()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    engine = dist.Engine(net, loss=_mse, optimizer=opt,
+                         input_keys=["image"])  # label_keys missing
+    x, y = _batches(1)[0]
+    with pytest.raises(ValueError, match="label_keys"):
+        engine.fit([{"image": x, "label": y}])
+    # predict with input_keys only is fine
+    outs = engine.predict([{"image": x, "label": y}])
+    assert tuple(outs[0].shape) == (16, 4)
+
+
 def test_shard_dataloader_places_batches(pmesh):
     data = _batches(2)
     loader = dist.shard_dataloader(data, pmesh, shard_dims="x")

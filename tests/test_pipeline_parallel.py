@@ -500,3 +500,102 @@ class TestTPInsidePP:
         comp = self._run(hcg_hybrid, compiled=True)
         np.testing.assert_allclose(eager, comp, rtol=2e-4, atol=1e-5)
         assert comp[-1] < comp[0]
+
+
+class TestLongContextHybrid:
+    """pp x mp x sep in ONE compiled program (VERDICT r3 #5): ring
+    attention (sep-sharded sequence, nested shard_map) + Megatron-SP
+    linears (mp) inside the compiled pp ring."""
+
+    @pytest.fixture(scope="class")
+    def hcg_4axis(self):
+        topo = CommunicateTopology(
+            ["dp", "pp", "sharding", "sep", "mp"], [1, 2, 1, 2, 2]
+        )
+        return HybridCommunicateGroup(topo)
+
+    def _run(self, hcg, compiled, attention, steps=3):
+        from types import SimpleNamespace
+
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear,
+            ParallelCrossEntropy,
+            VocabParallelEmbedding,
+        )
+        from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils \
+            import (
+                ColumnSequenceParallelLinear,
+                RowSequenceParallelLinear,
+            )
+        from paddle_tpu.parallel.sep_ops import ring_flash_attention
+
+        VOCAB, D, H, DH = 16, 8, 2, 4
+
+        class LongCtxBlk(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.qkv = nn.Linear(D, D)
+                self.proj = nn.Linear(D, D)
+                self.up = ColumnSequenceParallelLinear(
+                    D, 2 * D, gather_output=False
+                )
+                self.down = RowSequenceParallelLinear(
+                    2 * D, D, input_is_parallel=True
+                )
+
+            def forward(self, x):
+                b, s, _ = x.shape
+                h = self.qkv(x).reshape([b, s, H, DH])
+                if attention == "ring":
+                    a = ring_flash_attention(h, h, h, causal=True)
+                else:
+                    a = F.scaled_dot_product_attention(
+                        h, h, h, is_causal=True
+                    )
+                x = x + self.proj(a.reshape([b, s, D]))
+                return x + self.down(F.gelu(self.up(x)))
+
+        pce = ParallelCrossEntropy()
+
+        def loss_fn(logits, labels):
+            return pce(
+                logits.reshape([-1, VOCAB]), labels.reshape([-1])
+            ).mean()
+
+        paddle.seed(79)
+        pipe = PipelineLayer(
+            [LayerDesc(VocabParallelEmbedding, VOCAB, D)]
+            + [LayerDesc(LongCtxBlk) for _ in range(4)]
+            + [LayerDesc(ColumnParallelLinear, D, VOCAB)],
+            num_stages=2, loss_fn=loss_fn,
+        )
+        opt = paddle.optimizer.AdamW(1e-2, parameters=pipe.parameters())
+        engine = PipelineParallel(
+            pipe, hcg,
+            SimpleNamespace(pipeline_configs={
+                "accumulate_steps": 2, "compiled": compiled,
+            }),
+        )
+        rng = np.random.RandomState(6)
+        ids = jnp.asarray(rng.randint(0, VOCAB, (2, 8)))
+        labels = jnp.asarray(rng.randint(0, VOCAB, (2, 8)))
+        return [
+            float(np.asarray(
+                engine.train_batch((Tensor(ids), Tensor(labels)),
+                                   opt).numpy()
+            ))
+            for _ in range(steps)
+        ]
+
+    def test_ring_sp_inside_compiled_pp_matches_eager(self, hcg_4axis):
+        eager = self._run(hcg_4axis, compiled=False, attention="ring")
+        comp = self._run(hcg_4axis, compiled=True, attention="ring")
+        np.testing.assert_allclose(eager, comp, rtol=2e-4, atol=1e-5)
+        assert comp[-1] < comp[0]
+
+    def test_ring_matches_full_attention_in_compiled_pp(self, hcg_4axis):
+        """The sep ring is EXACT attention: swapping it for the plain
+        composed attention changes nothing (within float tolerance)."""
+        ring = self._run(hcg_4axis, compiled=True, attention="ring")
+        full = self._run(hcg_4axis, compiled=True, attention="full")
+        np.testing.assert_allclose(ring, full, rtol=2e-4, atol=1e-5)
