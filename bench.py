@@ -1,17 +1,23 @@
 """Benchmark entry: prints ONE JSON line with the headline metric.
 
 Flagship bench: whole-step compiled training throughput of a Llama-shaped
-decoder (RMSNorm + rope + causal flash attention + SwiGLU — the BASELINE
-config #4 model family) at the largest single-chip-fitting size, bf16
-compute (AMP O2). ``vs_baseline`` is measured-MFU / 0.40 (a 40%-MFU A100
-Fleet assumption — no published reference numbers exist; BASELINE.md
-records the provenance gap). FLOPs use the standard 6N + attention
-accounting (models/llama.py:flops_per_token).
+decoder (RMSNorm + rope + causal attention + SwiGLU — BASELINE config #4's
+model family) at the largest single-chip-fitting size with fp32 Adam:
+748M params (hidden 2048, 12 layers, intermediate 5632), bf16 compute
+(AMP O2). ``vs_baseline`` is measured-MFU / 0.40 (a 40%-MFU A100 Fleet
+assumption — no published reference numbers exist; BASELINE.md records
+the provenance gap). FLOPs use the standard 6N + attention accounting
+(models/llama.py:flops_per_token).
 
-Run with --profile to additionally write a jax profiler trace to
-./bench_trace (inspect with tensorboard / xprof). See BENCH_NOTES.md for
-the measured ablation breakdown behind the current configuration
-(attention path choice, batch size, remat, CE dtype).
+``--all`` additionally times every BASELINE acceptance config (LeNet fit,
+ResNet-50, BERT-base, the round-3 Llama-330M, GPT-MoE) and prints a
+per-config table — the regression net for perf anywhere in the stack
+(results recorded in BENCH_NOTES.md). ``--profile`` writes a jax
+profiler trace to ./bench_trace.
+
+Sizing notes (measured on v5e 16G, see BENCH_NOTES.md): B=4 is the
+flagship sweet spot (B=8 OOMs by 250M; B=6 and S=2048 variants measured
+slower); 14 layers fits but scores lower MFU than 12.
 """
 from __future__ import annotations
 
@@ -23,31 +29,41 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def main(profile=False):
+def _timed_steps(step, inputs, labels, iters, warmup=3, profile=False):
+    """Shared methodology for every config: warmup (incl. compile) +
+    device sync, then the timed steady-state loop + sync. ``profile``
+    opens the jax trace around the timed window ONLY (not compile)."""
     import numpy as np
 
-    import jax
+    for _ in range(warmup):
+        loss, _ = step(inputs, labels)
+    float(np.asarray(loss.numpy()))
+    if profile:
+        import jax
+
+        jax.profiler.start_trace("bench_trace")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, _ = step(inputs, labels)
+    float(np.asarray(loss.numpy()))
+    dt = time.perf_counter() - t0
+    if profile:
+        import jax
+
+        jax.profiler.stop_trace()
+    return dt
+
+
+def _llama_step_bench(cfg, B, S, iters, amp="O2", profile=False):
+    import numpy as np
+
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.jit.trainer import CompiledTrainStep
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    if on_tpu:
-        # largest comfortable single-chip (v5e 16G HBM) config:
-        # ~330M params -> 5.3GB fp32 params+adam, plus bf16 activations
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
-            num_hidden_layers=16, num_attention_heads=16,
-            max_position_embeddings=1024,
-        )
-        B, S, iters = 8, 1024, 30
-    else:
-        cfg = LlamaConfig.tiny()
-        B, S, iters = 2, 64, 3
+    from paddle_tpu.models import LlamaForCausalLM
 
     paddle.seed(0)
     net = LlamaForCausalLM(cfg)
@@ -59,42 +75,245 @@ def main(profile=False):
         )
 
     step = CompiledTrainStep(
-        net, loss_fn, opt, amp_level="O2" if on_tpu else None,
-        amp_dtype="bfloat16",
+        net, loss_fn, opt, amp_level=amp, amp_dtype="bfloat16"
+    )
+    rng = np.random.RandomState(0)
+    ids = [Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S))))]
+    labels = [Tensor(jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S))))]
+    dt = _timed_steps(step, ids, labels, iters, profile=profile)
+    tok = B * S * iters / dt
+    flops = net.flops_per_token(S) * B * S * iters / dt
+    return tok, flops
+
+
+def _on_tpu():
+    import jax
+
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+PEAK = 197e12  # v5e bf16 peak
+
+
+def flagship(profile=False):
+    from paddle_tpu.models import LlamaConfig
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            max_position_embeddings=1024,
+        )
+        B, S, iters = 4, 1024, 30
+    else:
+        cfg = LlamaConfig.tiny()
+        B, S, iters = 2, 64, 3
+
+    tok, flops = _llama_step_bench(
+        cfg, B, S, iters, amp="O2" if on_tpu else None, profile=profile
+    )
+    mfu = flops / (PEAK if on_tpu else 1e12)
+    return {
+        "metric": "train_tokens_per_sec_per_chip_llama750m",
+        "value": round(tok, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+# ------------------------------------------------------- BASELINE configs
+def bench_llama330m():
+    """Round-3 flagship, kept for history continuity."""
+    from paddle_tpu.models import LlamaConfig
+
+    on = _on_tpu()
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+        num_hidden_layers=16, num_attention_heads=16,
+        max_position_embeddings=1024,
+    ) if on else LlamaConfig.tiny()
+    tok, flops = _llama_step_bench(
+        cfg, 8 if on else 2, 1024 if on else 64, 20 if on else 2,
+        amp="O2" if on else None,
+    )
+    return {"config": "llama-330m step", "value": round(tok, 1),
+            "unit": "tokens/s", "mfu": round(flops / PEAK, 4) if on else None}
+
+
+def bench_lenet_fit():
+    """BASELINE config #1: LeNet/MNIST via paddle.Model.fit (hapi)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+
+    on = _on_tpu()
+    n, bs, epochs = (4096, 256, 2) if on else (128, 64, 1)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(n, 1, 28, 28).astype(np.float32)
+    ys = rng.randint(0, 10, (n, 1)).astype(np.int64)
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=model.network.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+    )
+    # epoch 1 includes compile; time epoch 2 (steady state)
+    model.fit(DS(), batch_size=bs, epochs=1, verbose=0)
+    t0 = time.perf_counter()
+    model.fit(DS(), batch_size=bs, epochs=epochs - 1 or 1, verbose=0)
+    dt = (time.perf_counter() - t0) / max(epochs - 1, 1)
+    return {"config": "lenet Model.fit epoch", "value": round(n / dt, 1),
+            "unit": "images/s", "mfu": None}
+
+
+def bench_resnet50():
+    """BASELINE config #2's model: ResNet-50 train step (single chip;
+    the DP axis is exercised by tests/dryrun — one-chip throughput is
+    the per-chip term of the DP number)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    on = _on_tpu()
+    B, iters = (64, 10) if on else (2, 2)
+    paddle.seed(0)
+    net = resnet50()
+    opt = paddle.optimizer.Momentum(
+        0.1, momentum=0.9, parameters=net.parameters()
     )
 
+    def loss_fn(logits, labels):
+        import paddle_tpu.nn.functional as F
+
+        return F.cross_entropy(logits, labels)
+
+    step = CompiledTrainStep(
+        net, loss_fn, opt, amp_level="O2" if on else None,
+        amp_dtype="bfloat16",
+    )
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, 3, 224 if on else 32, 224 if on else 32),
+                    jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, (B,)))
+    dt = _timed_steps(step, [Tensor(x)], [Tensor(y)], iters)
+    return {"config": "resnet50 step", "value": round(B * iters / dt, 1),
+            "unit": "images/s", "mfu": None}
+
+
+def bench_bert_base():
+    """BASELINE config #3: BERT-base pretraining step."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.models import (
+        BertConfig,
+        BertForPretraining,
+        BertPretrainingCriterion,
+    )
+
+    on = _on_tpu()
+    cfg = BertConfig.bert_base() if on else BertConfig.tiny()
+    B, S, iters = (16, 512, 10) if on else (2, 32, 2)
+    paddle.seed(0)
+    net = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
+
+    def loss_fn(pred_scores, seq_rel, mlm_labels, nsp_labels):
+        return crit(pred_scores, seq_rel, mlm_labels, nsp_labels)
+
+    step = CompiledTrainStep(
+        net, loss_fn, opt, amp_level="O2" if on else None,
+        amp_dtype="bfloat16",
+    )
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    mlm = jnp.asarray(
+        np.where(rng.rand(B, S) < 0.15,
+                 rng.randint(0, cfg.vocab_size, (B, S)), -1)
+    )
+    nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+    dt = _timed_steps(step, [Tensor(ids)], [Tensor(mlm), Tensor(nsp)],
+                      iters)
+    return {"config": "bert-base step", "value": round(B * S * iters / dt, 1),
+            "unit": "tokens/s", "mfu": None}
+
+
+def bench_gpt_moe():
+    """BASELINE config #5: GPT-MoE train step (gshard gate, 8 experts)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.models import GPTMoEConfig, GPTMoEForCausalLM
+
+    on = _on_tpu()
+    cfg = GPTMoEConfig() if on else GPTMoEConfig.tiny()
+    B, S, iters = (8, 1024, 10) if on else (2, 32, 2)
+    paddle.seed(0)
+    net = GPTMoEForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
+
+    def loss_fn(logits, labels):
+        ce = F.cross_entropy(
+            logits.reshape([-1, cfg.vocab_size]), labels.reshape([-1])
+        )
+        return ce + cfg.aux_loss_weight * net.aux_loss()
+
+    step = CompiledTrainStep(
+        net, loss_fn, opt, amp_level="O2" if on else None,
+        amp_dtype="bfloat16",
+    )
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
     labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    dt = _timed_steps(step, [Tensor(ids)], [Tensor(labels)], iters)
+    return {"config": "gpt-moe step", "value": round(B * S * iters / dt, 1),
+            "unit": "tokens/s", "mfu": None}
 
-    # warmup (compile + 2 steady steps)
-    for _ in range(3):
-        loss, _ = step([Tensor(ids)], [Tensor(labels)])
-    float(np.asarray(loss.numpy()))
 
-    if profile:
-        jax.profiler.start_trace("bench_trace")
+def run_all():
+    rows = []
+    for fn in (bench_lenet_fit, bench_resnet50, bench_bert_base,
+               bench_llama330m, bench_gpt_moe):
+        try:
+            rows.append(fn())
+        except Exception as e:  # pragma: no cover - report, keep going
+            rows.append({"config": fn.__name__, "value": None,
+                         "unit": f"ERROR: {type(e).__name__}: {e}",
+                         "mfu": None})
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    return rows
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss, _ = step([Tensor(ids)], [Tensor(labels)])
-    float(np.asarray(loss.numpy()))  # device sync
-    dt = time.perf_counter() - t0
 
-    if profile:
-        jax.profiler.stop_trace()
-
-    tokens_per_sec = B * S * iters / dt
-    achieved = net.flops_per_token(S) * B * S * iters / dt
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak; CPU placeholder
-    mfu = achieved / peak
-    print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip_llama330m",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
-    }))
+def main(profile=False, all_configs=False):
+    if all_configs:
+        run_all()
+    print(json.dumps(flagship(profile)))
 
 
 if __name__ == "__main__":
-    main(profile="--profile" in sys.argv)
+    main(profile="--profile" in sys.argv, all_configs="--all" in sys.argv)
