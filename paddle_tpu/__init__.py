@@ -97,13 +97,28 @@ _TENSOR_METHODS = (
     "fill_diagonal_tensor_ normal_ uniform_ exponential_ geometric_ "
     "cauchy_ log_normal_ where_ rank increment shard_index multiplex "
     "addbmm baddbmm histogram_bin_edges is_complex is_floating_point "
-    "is_integer"
+    "is_integer "
+    # audit-closure tail (tools/api_audit.py): reference Tensor methods
+    # whose functions already existed top-level
+    "angle as_complex as_real bernoulli bincount bucketize conj cummax "
+    "cummin deg2rad diag_embed diagflat digamma dist floor_mod frexp gcd "
+    "heaviside histogram hypot i0 i0e i1 i1e imag index_add index_fill "
+    "inverse is_empty lcm ldexp lgamma logit masked_scatter multinomial "
+    "mv nanquantile nextafter rad2deg real renorm rot90 scatter_nd sgn "
+    "signbit sinc stanh strided_slice take tensordot unflatten unfold "
+    "unique_consecutive unstack vander add_n complex"
 ).split()
 
 for _name in _TENSOR_METHODS:
     _fn = getattr(_ops_ns, _name, None)
     if _fn is not None and not hasattr(Tensor, _name):
         setattr(Tensor, _name, _fn)
+
+# paddle.dtype: the type of Tensor.dtype values (numpy dtype objects here —
+# paddle.float32 etc. are the jnp scalar types, comparable via np equality)
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype
 
 # paddle-compat static-mode switches (static graph == jax.jit here; these are
 # retained as no-ops so reference scripts run unmodified)

@@ -6,6 +6,8 @@ ignore_index, per-class weight, reduction modes.
 """
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 
@@ -532,4 +534,145 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
     return dispatch.apply(
         "npair_loss", _npair_loss, (anchor, positive, labels),
         {"l2_reg": float(l2_reg)},
+    )
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """triplet_margin_loss with a user distance (reference:
+    nn/functional/loss.py triplet_margin_with_distance_loss). The
+    distance callable runs inside the dispatch trace, so any paddle ops
+    it uses fuse into the same compiled step."""
+    if distance_function is None:
+        from .common import pairwise_distance
+
+        distance_function = pairwise_distance
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        from ...ops.math import minimum
+
+        d_neg = minimum(d_neg, distance_function(positive, negative))
+    from ...ops.math import maximum, subtract
+    from ...ops.creation import zeros_like
+
+    loss = maximum(subtract(d_pos, d_neg) + float(margin),
+                   zeros_like(d_pos))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def _hsigmoid(x, w, b, *, codes, signs):
+    # x: [N, D]; w: [C-1, D]; codes: [N, L] int path-node ids (-1 = pad);
+    # signs: [N, L] +-1 target code (0 on pads)
+    logits = jnp.einsum("nd,nld->nl", x, w[codes.clip(0)])
+    if b is not None:
+        logits = logits + b[codes.clip(0)]
+    mask = (codes >= 0).astype(x.dtype)
+    # per-node BCE with target from the sign: -log sigmoid(sign * logit)
+    loss = jnp.logaddexp(0.0, -signs * logits) * mask
+    return jnp.sum(loss, axis=1, keepdims=True)  # [N, 1] (paddle contract)
+
+
+@_functools.lru_cache(maxsize=64)
+def _hsigmoid_tree(num_classes):
+    """Complete-binary-tree path table for the default hsigmoid tree;
+    depends only on num_classes, so cached across calls/steps."""
+    import numpy as np
+
+    n_inner = int(num_classes) - 1
+    depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+    codes = np.full((num_classes, depth), -1, np.int32)
+    signs = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + n_inner  # leaf id in the implicit heap
+        path = []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append((parent, -1.0 if node == 2 * parent + 1 else 1.0))
+            node = parent
+        for li, (p, s) in enumerate(reversed(path)):
+            if li < depth:
+                codes[c, li] = p
+                signs[c, li] = s
+    return jnp.asarray(codes), jnp.asarray(signs)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary tree, returning
+    the per-sample [N, 1] loss (reference: nn/functional/loss.py
+    hsigmoid_loss; the custom-tree form takes path_table/path_code).
+    Tree layout matches the reference default: internal node i has
+    children 2i+1 / 2i+2, classes are the leaves, and each class's path
+    is the route from the root."""
+    if path_table is None:
+        table_t, code_t = _hsigmoid_tree(int(num_classes))
+
+        def fn(x, lbl, w, b):
+            l = lbl.reshape(-1).astype(jnp.int32)
+            return _hsigmoid(x, w, b, codes=table_t[l], signs=code_t[l])
+    else:
+        def fn(x, lbl, w, b, pt=path_table, pc=path_code):
+            ptv = jnp.asarray(pt.value if hasattr(pt, "value") else pt)
+            pcv = jnp.asarray(pc.value if hasattr(pc, "value") else pc)
+            # paddle custom trees: path_code is the 0/1 branch bit
+            signs = jnp.where(pcv > 0, 1.0, -1.0) * (ptv >= 0)
+            return _hsigmoid(
+                x, w, b, codes=ptv.astype(jnp.int32),
+                signs=signs.astype(x.dtype),
+            )
+
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+
+    def wrapped(x, lbl, w, *rest):
+        return fn(x, lbl, w, rest[0] if rest else None)
+
+    return dispatch.apply("hsigmoid_loss", wrapped, args, cache=False)
+
+
+def _margin_ce(logits, lbl, *, m1, m2, m3, scale, reduction,
+               return_softmax):
+    n, c = logits.shape
+    onehot = jax.nn.one_hot(lbl, c, dtype=logits.dtype)
+    # stay strictly inside (-1, 1): d/dx arccos diverges at the bounds,
+    # and saturated bf16 cosines hit exactly +-1.0 routinely under AMP
+    eps = 1e-6
+    cos = jnp.clip(logits, -1.0 + eps, 1.0 - eps)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(m1 * theta + m2) - m3
+    adjusted = jnp.where(onehot > 0, target.astype(logits.dtype), cos)
+    scaled = adjusted * scale
+    logp = jax.nn.log_softmax(scaled, axis=1)
+    loss = -jnp.sum(onehot * logp, axis=1, keepdims=True)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-family margin softmax (reference:
+    nn/functional/loss.py margin_cross_entropy). ``logits`` are
+    cosine similarities in [-1, 1]. The reference's model-parallel
+    ``group`` form shards classes over ranks; here class-sharded logits
+    are handled by GSPMD when the call sits in a compiled step — the
+    ``group`` arg is accepted and the math is identical (softmax over
+    the full class axis)."""
+    return dispatch.apply(
+        "margin_cross_entropy", _margin_ce, (logits, label),
+        {"m1": float(margin1), "m2": float(margin2), "m3": float(margin3),
+         "scale": float(scale), "reduction": reduction,
+         "return_softmax": bool(return_softmax)},
     )

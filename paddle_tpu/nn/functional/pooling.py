@@ -70,6 +70,20 @@ def _avg_pool(x, *, nd, k, s, pad, channel_last, exclusive, ceil_mode):
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        if data_format != "NCL":
+            raise ValueError("return_mask=True supports NCL only")
+        k = _tuplize(kernel_size, 1)
+        s = _tuplize(stride if stride is not None else kernel_size, 1)
+        pad = _conv_padding(padding, 1)
+        if isinstance(pad, str):
+            raise ValueError(
+                "max_pool1d(return_mask=True) needs explicit int padding"
+            )
+        return dispatch.apply(
+            "max_pool1d_mask", _max_pool1d_with_mask, (x,),
+            {"k": k, "s": s, "pad": pad, "ceil_mode": bool(ceil_mode)},
+        )
     return _pool_entry(_max_pool, x, 1, kernel_size, stride, padding, data_format,
                        dict(ceil_mode=bool(ceil_mode)))
 
@@ -88,6 +102,20 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise ValueError("return_mask=True supports NCDHW only")
+        k = _tuplize(kernel_size, 3)
+        s = _tuplize(stride if stride is not None else kernel_size, 3)
+        pad = _conv_padding(padding, 3)
+        if isinstance(pad, str):
+            raise ValueError(
+                "max_pool3d(return_mask=True) needs explicit int padding"
+            )
+        return dispatch.apply(
+            "max_pool3d_mask", _max_pool3d_with_mask, (x,),
+            {"k": k, "s": s, "pad": pad, "ceil_mode": bool(ceil_mode)},
+        )
     return _pool_entry(_max_pool, x, 3, kernel_size, stride, padding, data_format,
                        dict(ceil_mode=bool(ceil_mode)))
 
@@ -249,6 +277,64 @@ def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0,
     )
 
 
+def _max_pool1d_with_mask(x, *, k, s, pad, ceil_mode):
+    """1-D analog of _max_pool2d_with_mask (flat per-channel L index)."""
+    n, c, l = x.shape
+    padding = _full_pad(1, pad, False, x, k, s, ceil_mode)
+    neg = (
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    (pl0, pl1) = padding[2]
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pl0, pl1)), constant_values=neg)
+    kl, = k
+    lp = xp.shape[2]
+    ol = (lp - kl) // s[0] + 1
+    taps = [xp[:, :, i:i + ol * s[0]:s[0]] for i in range(kl)]
+    xpat = jnp.stack(taps, axis=2)  # [N, C, kl, ol]
+    am = jnp.argmax(xpat, axis=2)
+    out = jnp.max(xpat, axis=2)
+    oi = jnp.arange(ol)[None, None, :]
+    mask = (oi * s[0] - pl0 + am).astype(jnp.int32)
+    return out, mask
+
+
+def _max_pool3d_with_mask(x, *, k, s, pad, ceil_mode):
+    """3-D analog of _max_pool2d_with_mask (flat per-channel D*H*W)."""
+    n, c, d, h, w = x.shape
+    padding = _full_pad(3, pad, False, x, k, s, ceil_mode)
+    neg = (
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+        else jnp.iinfo(x.dtype).min
+    )
+    (pd0, pd1), (ph0, ph1), (pw0, pw1) = padding[2], padding[3], padding[4]
+    xp = jnp.pad(
+        x, ((0, 0), (0, 0), (pd0, pd1), (ph0, ph1), (pw0, pw1)),
+        constant_values=neg,
+    )
+    kd, kh, kw = k
+    dp, hp, wp = xp.shape[2], xp.shape[3], xp.shape[4]
+    od = (dp - kd) // s[0] + 1
+    oh = (hp - kh) // s[1] + 1
+    ow = (wp - kw) // s[2] + 1
+    taps = [
+        xp[:, :, a:a + od * s[0]:s[0], i:i + oh * s[1]:s[1],
+           j:j + ow * s[2]:s[2]]
+        for a in range(kd) for i in range(kh) for j in range(kw)
+    ]
+    xpat = jnp.stack(taps, axis=2)  # [N, C, kd*kh*kw, od, oh, ow]
+    am = jnp.argmax(xpat, axis=2)
+    out = jnp.max(xpat, axis=2)
+    oz = jnp.arange(od)[:, None, None]
+    oy = jnp.arange(oh)[None, :, None]
+    ox = jnp.arange(ow)[None, None, :]
+    iz = oz * s[0] - pd0 + am // (kh * kw)
+    iy = oy * s[1] - ph0 + (am // kw) % kh
+    ix = ox * s[2] - pw0 + am % kw
+    mask = ((iz * h + iy) * w + ix).astype(jnp.int32)
+    return out, mask
+
+
 def _max_unpool2d(x, mask, *, out_hw):
     n, c, oh, ow = x.shape
     h, w = out_hw
@@ -282,4 +368,65 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         )
     return dispatch.apply(
         "max_unpool2d", _max_unpool2d, (x, indices), {"out_hw": osz}
+    )
+
+
+def _max_unpool1d(x, mask, *, out_l):
+    n, c, ol = x.shape
+    flat = jnp.zeros((n, c, out_l), x.dtype)
+    midx = mask.astype(jnp.int32)
+    flat = jax.vmap(jax.vmap(lambda f, m, v: f.at[m].set(v)))(
+        flat, midx, x
+    )
+    return flat
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True)."""
+    k = _tuplize(kernel_size, 1)
+    s = _tuplize(stride if stride is not None else kernel_size, 1)
+    if output_size is not None:
+        from ...ops._helpers import static_int_list
+
+        out_l = int(static_int_list(output_size)[-1])
+    else:
+        p = _conv_padding(padding, 1)
+        pl = p[0][0] if not isinstance(p, str) else 0
+        out_l = (int(x.shape[-1]) - 1) * s[0] - 2 * pl + k[0]
+    return dispatch.apply(
+        "max_unpool1d", _max_unpool1d, (x, indices), {"out_l": out_l}
+    )
+
+
+def _max_unpool3d(x, mask, *, out_dhw):
+    n, c = x.shape[:2]
+    d, h, w = out_dhw
+    flat = jnp.zeros((n, c, d * h * w), x.dtype)
+    midx = mask.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(lambda f, m, v: f.at[m].set(v)))(
+        flat, midx, vals
+    )
+    return flat.reshape(n, c, d, h, w)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Inverse of max_pool3d(return_mask=True)."""
+    k = _tuplize(kernel_size, 3)
+    s = _tuplize(stride if stride is not None else kernel_size, 3)
+    if output_size is not None:
+        from ...ops._helpers import static_int_list
+
+        osz = tuple(static_int_list(output_size))[-3:]
+    else:
+        p = _conv_padding(padding, 3)
+        pads = [pp[0] if not isinstance(pp, str) else 0 for pp in p]
+        osz = tuple(
+            (int(x.shape[-3 + i]) - 1) * s[i] - 2 * pads[i] + k[i]
+            for i in range(3)
+        )
+    return dispatch.apply(
+        "max_unpool3d", _max_unpool3d, (x, indices), {"out_dhw": osz}
     )

@@ -79,6 +79,11 @@ def _inv(x):
     return jnp.linalg.inv(x)
 
 
+def inverse(x, name=None):
+    """Alias of ``inv`` (paddle exposes both)."""
+    return inv(x)
+
+
 def inv(x, name=None):
     return dispatch.apply("inv", _inv, (x,))
 
@@ -384,4 +389,48 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
     return dispatch.apply(
         "ormqr", _ormqr, (x, tau, other),
         {"left": bool(left), "transpose": bool(transpose)},
+    )
+
+
+def _svd_lowrank(a, g, *, q, niter):
+    # randomized range finder (Halko et al.): Y = A G; power iterations
+    # refine the subspace; then svd of the small projected matrix.
+    # batched: transposes swap only the trailing matrix axes
+    def ht(m):
+        return jnp.swapaxes(m, -2, -1).conj()
+
+    y = a @ g
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = ht(a) @ qmat
+        qmat, _ = jnp.linalg.qr(z)
+        y = a @ qmat
+        qmat, _ = jnp.linalg.qr(y)
+    b = ht(qmat) @ a
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, ht(vh)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD: returns (U, S, V) with ~q components
+    (reference: linalg.svd_lowrank; Halko-Martinsson-Tropp sketch)."""
+    from ..core import random as random_mod
+
+    if M is not None:
+        from .math import subtract
+
+        x = subtract(x, M)
+    from ..core.tensor import Tensor as _T
+
+    n = int(x.shape[-1])
+    k = min(int(q), n)
+    batch = tuple(int(d) for d in x.shape[:-2])
+    g = jax.random.normal(
+        random_mod.next_key(), batch + (n, k),
+        dtype=x.value.dtype if hasattr(x, "value") else jnp.float32,
+    )
+    return dispatch.apply(
+        "svd_lowrank",
+        lambda a, gg: _svd_lowrank(a, gg, q=k, niter=int(niter)),
+        (x, _T(g)), cache=False,
     )

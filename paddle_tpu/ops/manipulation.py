@@ -696,3 +696,28 @@ def as_complex(x, name=None):
 
 def as_real(x, name=None):
     return dispatch.apply("as_real", _as_real, (x,))
+
+
+def _complex(re, im):
+    return jax.lax.complex(re, im)
+
+
+def complex(real, imag, name=None):
+    """Construct a complex tensor from real and imaginary parts."""
+    return dispatch.apply("complex", _complex, (real, imag))
+
+
+def _add_n(*vs):
+    out = vs[0]
+    for v in vs[1:]:
+        out = out + v
+    return out
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if not inputs:
+        raise ValueError("add_n: inputs must be a non-empty list")
+    return dispatch.apply("add_n", _add_n, tuple(inputs), cache=False)

@@ -126,6 +126,8 @@ logit = unary("logit", jax.scipy.special.logit)
 digamma = unary("digamma", jax.scipy.special.digamma)
 lgamma = unary("lgamma", jax.scipy.special.gammaln)
 i0 = unary("i0", lambda x: jax.scipy.special.i0(x))
+i0e = unary("i0e", lambda x: jax.scipy.special.i0e(x))
+i1e = unary("i1e", lambda x: jax.scipy.special.i1e(x))
 angle = unary("angle", jnp.angle)
 conj = unary("conj", jnp.conj)
 real = unary("real", jnp.real)

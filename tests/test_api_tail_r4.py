@@ -1,0 +1,195 @@
+"""Round-4 op-tail closure: new ops + the API audit gate.
+
+Reference parity targets: paddle.* docs index (tools/api_audit.py lists);
+torch oracles where available, manual math otherwise.
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(11)
+
+
+def T(a):
+    return Tensor(jnp.asarray(a))
+
+
+def test_api_audit_is_clean():
+    """The audit script is the coverage gate: exit 0 = no unjustified
+    missing names vs the reference's documented surface."""
+    r = subprocess.run(
+        [sys.executable, "tools/api_audit.py"], capture_output=True,
+        text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_i0e_i1e_vs_torch():
+    x = (RNG.rand(16) * 4 - 2).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.i0e(T(x)).numpy(), torch.special.i0e(torch.tensor(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        paddle.i1e(T(x)).numpy(), torch.special.i1e(torch.tensor(x)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_add_n_and_complex():
+    xs = [RNG.randn(3, 4).astype(np.float32) for _ in range(3)]
+    out = paddle.add_n([T(x) for x in xs])
+    np.testing.assert_allclose(out.numpy(), sum(xs), rtol=1e-6)
+    re, im = xs[0], xs[1]
+    c = paddle.complex(T(re), T(im))
+    np.testing.assert_allclose(np.asarray(c.numpy()), re + 1j * im)
+
+
+def test_inverse_alias_and_tensor_methods():
+    a = RNG.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.inverse(T(a)).numpy(), np.linalg.inv(a), rtol=1e-4,
+        atol=1e-5,
+    )
+    # audit-closure methods exist and dispatch correctly
+    t = T(a)
+    assert float(t.dist(T(a)).numpy()) == 0.0
+    assert t.ndimension() == 2
+    np.testing.assert_allclose(
+        np.asarray(t.rot90().numpy()), np.rot90(a)
+    )
+    np.testing.assert_allclose(
+        np.asarray(T(np.float32(-0.5)).sgn().numpy()), -1.0
+    )
+
+
+def test_svd_lowrank_reconstructs():
+    # a genuinely low-rank matrix: exact recovery at q >= rank
+    rank = 3
+    a = (RNG.randn(12, rank) @ RNG.randn(rank, 8)).astype(np.float32)
+    u, s, v = paddle.linalg.svd_lowrank(T(a), q=5)
+    recon = (
+        np.asarray(u.numpy())
+        * np.asarray(s.numpy())[None, :]
+    ) @ np.asarray(v.numpy()).T
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("dim", [1, 3])
+def test_max_unpool_1d_3d_roundtrip(dim):
+    if dim == 1:
+        x = RNG.randn(2, 3, 8).astype(np.float32)
+        pooled, idx = F.max_pool1d(T(x), 2, stride=2, return_mask=True)
+        un = F.max_unpool1d(pooled, idx, 2, stride=2)
+        gold = torch.nn.functional.max_unpool1d(
+            *torch.nn.functional.max_pool1d(
+                torch.tensor(x), 2, stride=2, return_indices=True
+            ), 2, stride=2,
+        )
+    else:
+        x = RNG.randn(2, 3, 4, 4, 4).astype(np.float32)
+        pooled, idx = F.max_pool3d(T(x), 2, stride=2, return_mask=True)
+        un = F.max_unpool3d(pooled, idx, 2, stride=2)
+        gold = torch.nn.functional.max_unpool3d(
+            *torch.nn.functional.max_pool3d(
+                torch.tensor(x), 2, stride=2, return_indices=True
+            ), 2, stride=2,
+        )
+    np.testing.assert_allclose(un.numpy(), gold.numpy(), rtol=1e-6)
+
+
+def test_triplet_margin_with_distance_loss():
+    a, p, n = (RNG.randn(5, 8).astype(np.float32) for _ in range(3))
+    mine = F.triplet_margin_with_distance_loss(
+        T(a), T(p), T(n), margin=0.7,
+    )
+    gold = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.7,
+    )
+    np.testing.assert_allclose(
+        float(mine.numpy()), float(gold), rtol=1e-5
+    )
+    # custom distance
+    mine2 = F.triplet_margin_with_distance_loss(
+        T(a), T(p), T(n),
+        distance_function=lambda x, y: ((x - y) ** 2).sum(axis=-1),
+        margin=0.7,
+    )
+    gold2 = torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n),
+        distance_function=lambda x, y: ((x - y) ** 2).sum(-1),
+        margin=0.7,
+    )
+    np.testing.assert_allclose(float(mine2.numpy()), float(gold2),
+                               rtol=1e-5)
+
+
+def test_hsigmoid_loss_trains():
+    # no torch oracle: check the [N, 1] contract, finiteness, and that
+    # gradients flow to the path weights
+    N, D, C = 6, 8, 7
+    x = Tensor(jnp.asarray(RNG.randn(N, D).astype(np.float32)))
+    x.stop_gradient = False
+    w = Tensor(jnp.asarray(RNG.randn(C - 1, D).astype(np.float32) * 0.1))
+    w.stop_gradient = False
+    lbl = T(RNG.randint(0, C, (N,)).astype(np.int64))
+    loss = F.hsigmoid_loss(x, lbl, C, w)
+    assert tuple(loss.shape) == (N, 1)  # per-sample (paddle contract)
+    v = np.asarray(loss.numpy())
+    assert np.all(np.isfinite(v)) and np.all(v > 0)
+    loss.sum().backward()
+    assert w.grad is not None
+    assert np.any(np.asarray(w.grad.numpy()) != 0)
+
+
+def test_svd_lowrank_batched():
+    rank = 2
+    a = np.stack([
+        (RNG.randn(9, rank) @ RNG.randn(rank, 6)).astype(np.float32)
+        for _ in range(3)
+    ])
+    u, s, v = paddle.linalg.svd_lowrank(T(a), q=4)
+    un, sn, vn = (np.asarray(t.numpy()) for t in (u, s, v))
+    recon = np.einsum("bik,bk,bjk->bij", un, sn, vn)
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+
+
+def test_margin_cross_entropy_saturated_cosine_grads_finite():
+    # exactly +-1.0 cosines (bf16 saturation case) must not NaN the grads
+    N, C = 3, 4
+    cos = np.full((N, C), -1.0, np.float32)
+    lbl = np.arange(N).astype(np.int64)
+    cos[np.arange(N), lbl] = 1.0
+    t = T(cos)
+    t.stop_gradient = False
+    loss = F.margin_cross_entropy(t, T(lbl), margin2=0.5)
+    loss.backward()
+    assert np.all(np.isfinite(np.asarray(t.grad.numpy())))
+
+
+def test_margin_cross_entropy_reduces_to_softmax():
+    # m1=1, m2=0, m3=0 -> plain scaled softmax CE on the cosine logits
+    N, C = 4, 6
+    cos = np.tanh(RNG.randn(N, C)).astype(np.float32)
+    lbl = RNG.randint(0, C, (N,)).astype(np.int64)
+    mine = F.margin_cross_entropy(
+        T(cos), T(lbl), margin1=1.0, margin2=0.0, margin3=0.0, scale=16.0,
+    )
+    gold = torch.nn.functional.cross_entropy(
+        torch.tensor(cos * 16.0), torch.tensor(lbl)
+    )
+    np.testing.assert_allclose(float(mine.numpy()), float(gold), rtol=1e-4)
+    # arcface margin increases the loss on the target class
+    harder = F.margin_cross_entropy(
+        T(cos), T(lbl), margin1=1.0, margin2=0.5, margin3=0.0, scale=16.0,
+    )
+    assert float(harder.numpy()) > float(mine.numpy())
