@@ -1,0 +1,129 @@
+"""Enforce-style argument validation (paddle error-message parity).
+
+Reference parity: paddle/common/enforce.h PADDLE_ENFORCE_* macros + the
+check_variable_and_dtype/check_type helpers in python/paddle/base/
+data_feeder.py (unverified, mount empty). The reference wraps every
+kernel in systematic precondition checks that name the op, the argument,
+the expectation, and what was actually received; without them misuse
+surfaces as raw backend errors deep in the stack.
+
+Here the highest-traffic Python entry points call these helpers so the
+common mistakes fail at the API boundary with the same message shape:
+
+    (InvalidArgument) matmul: input 'y' expected ndim >= 1, but
+    received ndim 0 (shape ()).
+
+Everything that passes the boundary checks still gets XLA's own shape
+verification as the backstop — these checks exist for message quality,
+not correctness.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "enforce", "check_ndim", "check_same_trailing", "check_dtype",
+    "check_int_dtype", "check_type", "EnforceError",
+]
+
+
+class EnforceError(ValueError):
+    """paddle-style precondition failure (a ValueError subclass so
+    existing `except ValueError` handlers keep working)."""
+
+
+def enforce(cond, op, msg, *args):
+    """PADDLE_ENFORCE analog: raise (InvalidArgument) <op>: <msg> when
+    ``cond`` is falsy. ``msg`` may be a format string over ``args``."""
+    if not cond:
+        raise EnforceError(
+            f"(InvalidArgument) {op}: " + (msg.format(*args) if args
+                                           else msg)
+        )
+
+
+def _shape_of(t):
+    s = getattr(t, "shape", None)
+    return tuple(s) if s is not None else None
+
+
+def check_type(op, name, value, types):
+    if not isinstance(value, types):
+        tn = (
+            "/".join(t.__name__ for t in types)
+            if isinstance(types, tuple) else types.__name__
+        )
+        raise EnforceError(
+            f"(InvalidArgument) {op}: argument '{name}' expected "
+            f"{tn}, but received {type(value).__name__}"
+        )
+
+
+def check_ndim(op, name, t, min_ndim=None, exact_ndim=None):
+    shape = _shape_of(t)
+    if shape is None:
+        return
+    nd = len(shape)
+    if exact_ndim is not None:
+        allowed = (
+            (exact_ndim,) if isinstance(exact_ndim, int) else tuple(exact_ndim)
+        )
+        enforce(
+            nd in allowed, op,
+            "input '{}' expected ndim {}, but received ndim {} "
+            "(shape {})",
+            name, " or ".join(map(str, allowed)), nd, shape,
+        )
+    if min_ndim is not None:
+        enforce(
+            nd >= min_ndim, op,
+            "input '{}' expected ndim >= {}, but received ndim {} "
+            "(shape {})",
+            name, min_ndim, nd, shape,
+        )
+
+
+def check_same_trailing(op, name_x, x, name_y, y, dim_x=-1, dim_y=-2):
+    """The matmul-style contract: x.shape[dim_x] == y.shape[dim_y]."""
+    sx, sy = _shape_of(x), _shape_of(y)
+    if sx is None or sy is None or not sx or not sy:
+        return
+    if len(sy) == 1:
+        dim_y = -1
+    a, b = sx[dim_x], sy[dim_y]
+    enforce(
+        int(a) == int(b), op,
+        "input '{}' shape {} is not multiplicable with '{}' shape {}: "
+        "{} != {}",
+        name_x, sx, name_y, sy, a, b,
+    )
+
+
+_FLOATING = ("float16", "bfloat16", "float32", "float64",
+             "complex64", "complex128")
+_INTEGRAL = ("int8", "uint8", "int16", "int32", "int64", "bool")
+
+
+def _dtype_name(t):
+    d = getattr(t, "dtype", None)
+    if d is None:
+        return None
+    try:
+        return np.dtype(d).name
+    except TypeError:
+        return str(d)
+
+
+def check_dtype(op, name, t, allowed=_FLOATING):
+    dn = _dtype_name(t)
+    if dn is None:
+        return
+    enforce(
+        dn in allowed, op,
+        "input '{}' expected dtype in {}, but received {}",
+        name, list(allowed), dn,
+    )
+
+
+def check_int_dtype(op, name, t):
+    check_dtype(op, name, t, allowed=_INTEGRAL[:-1])

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import enforce as _enf
 from ...core import random as random_mod
 from ...ops.manipulation import pad  # re-export, paddle exposes F.pad  # noqa: F401
 
@@ -21,6 +22,8 @@ def _linear(x, w, b):
 
 
 def linear(x, weight, bias=None, name=None):
+    _enf.check_ndim("linear", "weight", weight, exact_ndim=2)
+    _enf.check_same_trailing("linear", "x", x, "weight", weight)
     return dispatch.apply("linear", _linear, (x, weight, bias))
 
 

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import enforce as _enf
 
 
 def _tuplize(v, n):
@@ -69,7 +70,19 @@ def _conv_nd(x, w, b, *, nd, stride, padding, dilation, groups, channel_last):
 
 
 def _conv(x, w, b, nd, stride, padding, dilation, groups, data_format):
+    op = f"conv{nd}d"
     channel_last = not data_format.startswith("NC")
+    _enf.check_ndim(op, "x", x, exact_ndim=nd + 2)
+    _enf.check_ndim(op, "weight", w, exact_ndim=nd + 2)
+    if hasattr(x, "shape") and hasattr(w, "shape"):
+        in_c = int(x.shape[-1] if channel_last else x.shape[1])
+        _enf.enforce(
+            in_c == int(w.shape[1]) * int(groups), op,
+            "input channels {} != weight in-channels {} x groups {} "
+            "(x shape {}, weight shape {}, data_format {})",
+            in_c, int(w.shape[1]), int(groups), tuple(x.shape),
+            tuple(w.shape), data_format,
+        )
     kw = {
         "nd": nd,
         "stride": _tuplize(stride, nd),

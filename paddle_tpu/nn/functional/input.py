@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import enforce as _enf
 from ...ops.creation import one_hot  # noqa: F401  (paddle exposes F.one_hot)
 
 
@@ -21,6 +22,8 @@ def _embedding(weight, x, *, padding_idx, sparse):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    _enf.check_ndim("embedding", "weight", weight, exact_ndim=2)
+    _enf.check_int_dtype("embedding", "x", x)
     return dispatch.apply(
         "embedding",
         _embedding,

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import enforce as _enf
 
 
 def _reduce(loss, reduction):
@@ -81,6 +82,26 @@ def cross_entropy(
     label_smoothing=0.0,
     name=None,
 ):
+    _enf.enforce(
+        reduction in ("mean", "sum", "none"), "cross_entropy",
+        "reduction must be 'mean', 'sum' or 'none', but received {!r}",
+        reduction,
+    )
+    if not soft_label:
+        _enf.check_int_dtype("cross_entropy", "label", label)
+        if hasattr(input, "shape") and hasattr(label, "shape"):
+            nd_in, nd_lbl = len(input.shape), len(label.shape)
+            ok = nd_lbl == nd_in - 1 or (
+                nd_lbl == nd_in
+                and int(label.shape[int(axis) % nd_in]) == 1
+            )
+            _enf.enforce(
+                ok, "cross_entropy",
+                "hard label expected ndim {} (or {} with size 1 on the "
+                "class axis), but received label shape {} for input "
+                "shape {}",
+                nd_in - 1, nd_in, tuple(label.shape), tuple(input.shape),
+            )
     return dispatch.apply(
         "cross_entropy",
         _cross_entropy,

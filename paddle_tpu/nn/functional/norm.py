@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import dispatch
+from ...core import enforce as _enf
 
 
 def _batch_norm_infer(x, mean, var, w, b, *, eps, channel_axis):
@@ -103,6 +104,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
         else tuple(normalized_shape)
     )
     begin_axis = x.ndim - len(ns)
+    _enf.enforce(
+        begin_axis >= 0 and tuple(
+            int(d) for d in x.shape[begin_axis:]
+        ) == tuple(int(d) for d in ns),
+        "layer_norm",
+        "normalized_shape {} must match the trailing dims of input "
+        "shape {}", tuple(ns), tuple(x.shape),
+    )
     return dispatch.apply(
         "layer_norm",
         _layer_norm,

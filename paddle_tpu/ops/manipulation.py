@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dispatch
+from ..core import enforce as _enf
 from ..core.dtypes import convert_dtype
 from ..core.tensor import Tensor
 from ._helpers import normalize_axis, static_int_list
@@ -55,8 +56,29 @@ def _reshape(x, *, shape):
 
 
 def reshape(x, shape, name=None):
+    tgt = static_int_list(shape)
+    if isinstance(tgt, int):  # scalar target shape
+        tgt = [tgt]
+    if hasattr(x, "shape"):
+        n = int(np.prod([int(d) for d in x.shape])) if len(x.shape) else 1
+        known = int(np.prod([d for d in tgt if d not in (-1, 0)]) or 1)
+        zeros = [i for i, d in enumerate(tgt) if d == 0]
+        if not zeros:  # 0-dims copy input dims; skip the cheap check then
+            if -1 in tgt:
+                _enf.enforce(
+                    known != 0 and n % known == 0, "reshape",
+                    "cannot infer -1: input shape {} ({} elements) is "
+                    "not divisible by the known target dims {}",
+                    tuple(x.shape), n, tgt,
+                )
+            else:
+                _enf.enforce(
+                    known == n, "reshape",
+                    "target shape {} has {} elements but input shape {} "
+                    "has {}", tgt, known, tuple(x.shape), n,
+                )
     return dispatch.apply(
-        "reshape", _reshape, (x,), {"shape": static_int_list(shape)}
+        "reshape", _reshape, (x,), {"shape": tgt}
     )
 
 
@@ -172,8 +194,18 @@ def _concat(*xs, axis):
 
 def concat(x, axis=0, name=None):
     xs = list(x)
+    _enf.enforce(len(xs) > 0, "concat", "input list must be non-empty")
     if isinstance(axis, Tensor):
         axis = int(axis.item())
+    nd0 = len(xs[0].shape) if hasattr(xs[0], "shape") else None
+    for i, t in enumerate(xs[1:], 1):
+        if nd0 is not None and hasattr(t, "shape"):
+            _enf.enforce(
+                len(t.shape) == nd0, "concat",
+                "all inputs must have the same ndim; input 0 has shape "
+                "{} but input {} has shape {}",
+                tuple(xs[0].shape), i, tuple(t.shape),
+            )
     return dispatch.apply("concat", _concat, tuple(xs), {"axis": int(axis)})
 
 

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dispatch
+from ..core import enforce as _enf
 from ._helpers import binary, normalize_axis, unary
 
 # ---------------------------------------------------------------- elementwise
@@ -213,6 +214,18 @@ def _matmul(x, y, *, transpose_x, transpose_y):
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    _enf.check_ndim("matmul", "x", x, min_ndim=1)
+    _enf.check_ndim("matmul", "y", y, min_ndim=1)
+    if len(getattr(x, "shape", ())) > 1 and len(
+        getattr(y, "shape", ())
+    ) > 1:
+        _enf.check_same_trailing(
+            "matmul", "x", x, "y", y,
+            dim_x=-2 if transpose_x else -1,
+            dim_y=-1 if transpose_y else -2,
+        )
+    elif not transpose_x and not transpose_y:
+        _enf.check_same_trailing("matmul", "x", x, "y", y)
     return dispatch.apply(
         "matmul",
         _matmul,

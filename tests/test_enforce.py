@@ -1,0 +1,119 @@
+"""Enforce layer: misuse must fail at the API boundary with paddle-style
+messages naming the op, the argument, the expectation, and what arrived
+(reference: PADDLE_ENFORCE_* / check_variable_and_dtype — SURVEY §2.1
+Enforce row)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.enforce import EnforceError
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.RandomState(0)
+
+
+def T(shape, dtype=np.float32):
+    if dtype in (np.int64, np.int32):
+        return Tensor(jnp.asarray(RNG.randint(0, 4, shape).astype(dtype)))
+    return Tensor(jnp.asarray(RNG.randn(*shape).astype(dtype)))
+
+
+def _raises(fn, *fragments):
+    with pytest.raises(ValueError) as ei:
+        fn()
+    msg = str(ei.value)
+    assert "(InvalidArgument)" in msg, msg
+    for frag in fragments:
+        assert frag in msg, (frag, msg)
+
+
+def test_matmul_shape_mismatch():
+    _raises(
+        lambda: paddle.matmul(T((2, 3)), T((4, 5))),
+        "matmul", "not multiplicable", "(2, 3)", "(4, 5)",
+    )
+
+
+def test_linear_weight_mismatch():
+    _raises(
+        lambda: F.linear(T((2, 3)), T((4, 5))),
+        "linear", "'x'", "(2, 3)",
+    )
+    _raises(
+        lambda: F.linear(T((2, 3)), T((3,))),
+        "linear", "'weight'", "expected ndim 2",
+    )
+
+
+def test_embedding_float_indices():
+    _raises(
+        lambda: F.embedding(T((2, 3)), T((10, 4))),
+        "embedding", "'x'", "dtype",
+    )
+
+
+def test_concat_rank_mismatch():
+    _raises(
+        lambda: paddle.concat([T((2, 3)), T((2, 3, 1))]),
+        "concat", "same ndim", "input 1",
+    )
+    _raises(lambda: paddle.concat([]), "concat", "non-empty")
+
+
+def test_conv2d_channel_mismatch():
+    _raises(
+        lambda: F.conv2d(T((1, 3, 8, 8)), T((4, 5, 3, 3))),
+        "conv2d", "channels", "3", "5",
+    )
+    _raises(
+        lambda: F.conv2d(T((3, 8, 8)), T((4, 3, 3, 3))),
+        "conv2d", "'x'", "expected ndim 4", "ndim 3",
+    )
+
+
+def test_cross_entropy_misuse():
+    _raises(
+        lambda: F.cross_entropy(T((4, 10)), T((4,))),  # float labels
+        "cross_entropy", "'label'", "dtype",
+    )
+    _raises(
+        lambda: F.cross_entropy(
+            T((4, 10)), T((4, 2, 2), np.int64)
+        ),
+        "cross_entropy", "label shape",
+    )
+    _raises(
+        lambda: F.cross_entropy(
+            T((4, 10)), T((4,), np.int64), reduction="avg"
+        ),
+        "cross_entropy", "reduction",
+    )
+
+
+def test_layer_norm_shape_mismatch():
+    _raises(
+        lambda: F.layer_norm(T((2, 3, 8)), 16),
+        "layer_norm", "normalized_shape", "(16,)", "(2, 3, 8)",
+    )
+
+
+def test_reshape_element_mismatch():
+    _raises(
+        lambda: paddle.reshape(T((2, 3)), [4, 2]),
+        "reshape", "elements",
+    )
+    _raises(
+        lambda: paddle.reshape(T((2, 3)), [-1, 4]),
+        "reshape", "not divisible",
+    )
+    # valid -1 still works
+    out = paddle.reshape(T((2, 3)), [-1, 2])
+    assert tuple(out.shape) == (3, 2)
+
+
+def test_enforce_is_value_error():
+    # existing handlers catching ValueError keep working
+    assert issubclass(EnforceError, ValueError)
