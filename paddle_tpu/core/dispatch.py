@@ -96,6 +96,36 @@ def _jitted(fn, kw):
     return j
 
 
+_VJP_FWD_CACHE: dict = {}
+
+
+def _vjp_fwd(fn, kw, diff_idx, all_vals):
+    """(out, vjp_closure) over the differentiable positions only; shared
+    by the cached-jitted and direct eager grad paths."""
+    def f_diff(*dvals):
+        full = list(all_vals)
+        for i, v in zip(diff_idx, dvals):
+            full[i] = v
+        return fn(*full, **kw)
+
+    return jax.vjp(f_diff, *[all_vals[i] for i in diff_idx])
+
+
+def _vjp_jitted(fn, kw, diff_idx):
+    """Jitted (out, vjp_fn) forward for the eager grad path; see the
+    autograd section of _apply. jax re-keys on arg shapes/arity
+    internally, so the cache key only needs the trace-shaping statics."""
+    key = (fn, _freeze(kw), diff_idx)
+    j = _VJP_FWD_CACHE.get(key)
+    if j is None:
+        def fwd(*all_vals):
+            return _vjp_fwd(fn, kw, diff_idx, all_vals)
+
+        j = jax.jit(fwd)
+        _VJP_FWD_CACHE[key] = j
+    return j
+
+
 def _unwrap(a):
     return a.value if isinstance(a, Tensor) else a
 
@@ -159,17 +189,18 @@ def _apply(name, fn, args, kw=None, cache=True, nondiff=False):
         return _wrap_outputs(out, stop_gradient=True)
 
     # --- autograd path: vjp over the differentiable tensor args only
-    diff_idx = [i for i, a in enumerate(args) if _is_diff_tensor(a)]
+    diff_idx = tuple(i for i, a in enumerate(args) if _is_diff_tensor(a))
     diff_tensors = [args[i] for i in diff_idx]
-    diff_vals = tuple(vals[i] for i in diff_idx)
 
-    def f_diff(*dvals):
-        full = list(vals)
-        for i, v in zip(diff_idx, dvals):
-            full[i] = v
-        return fn(*full, **kw)
-
-    out, vjp_fn = jax.vjp(f_diff, *diff_vals)
+    if cache and not tape_mod.in_trace():
+        # cached jitted forward returning (out, vjp closure): jax.vjp
+        # re-traces fn per call (~500 us/op measured), which dominated
+        # eager training; the vjp closure is a jax Partial — a pytree —
+        # so it round-trips through jit and the trace happens once per
+        # (op, static-kwargs, diff-arg set, shapes)
+        out, vjp_fn = _vjp_jitted(fn, kw, diff_idx)(*vals)
+    else:
+        out, vjp_fn = _vjp_fwd(fn, kw, diff_idx, vals)
 
     is_multi = isinstance(out, (tuple, list))
     outs = tuple(out) if is_multi else (out,)

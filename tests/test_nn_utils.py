@@ -51,6 +51,7 @@ def test_weight_norm_function_preserving_and_trainable():
 
 
 def test_spectral_norm_unit_sigma():
+    paddle.seed(7)  # convergence tolerance depends on the init draw
     lin = paddle.nn.Linear(6, 5)
     spectral_norm(lin, n_power_iterations=5)
     for _ in range(3):
