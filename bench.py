@@ -119,6 +119,12 @@ def flagship(profile=False):
         "value": round(tok, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
+        # the denominator is an ASSUMPTION, not a published number
+        # (BASELINE.md provenance): vs_baseline = measured_MFU / 0.40,
+        # the 40%-MFU A100 Fleet-parity bar
+        "baseline_note": f"measured_mfu={round(mfu, 4)} vs assumed "
+                         "0.40-MFU A100 Fleet parity (no published "
+                         "reference numbers exist)",
     }
 
 

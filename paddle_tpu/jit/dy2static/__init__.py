@@ -374,6 +374,86 @@ def convert_while(cond_fn, body_fn, loop_vars, names):
     )
 
 
+def convert_for_range(range_args, body_fn, prior_i, loop_vars, names):
+    """AST-generated ``for i in range(...)`` conversion. ``body_fn`` takes
+    (i, *loop_vars) and returns the updated loop_vars tuple; ``prior_i``
+    is the loop variable's binding before the statement (or UndefinedVar)
+    — Python keeps it when the range is empty. Concrete bounds keep the
+    plain Python loop (unrolled under trace); a traced bound lowers to
+    lax.while_loop via while_impl with the counter as an extra carried
+    variable."""
+    if len(range_args) == 1:
+        start, stop, step = 0, range_args[0], 1
+    elif len(range_args) == 2:
+        (start, stop), step = range_args, 1
+    else:
+        start, stop, step = range_args
+
+    if not any(_is_traced(v) for v in (start, stop, step)):
+        out = tuple(loop_vars)
+        i = prior_i  # empty range: the prior binding survives (Python)
+        for i in range(int(_as_py(start)), int(_as_py(stop)),
+                       int(_as_py(step))):
+            out = tuple(body_fn(i, *out))
+        return (i,) + out
+
+    if _is_traced(step):
+        raise Dy2StaticError(
+            "to_static for-range: a Tensor step is not supported (XLA "
+            "loops need a sign-static step to know the loop direction); "
+            "make the step a Python int, or rewrite with "
+            "paddle.static.nn.while_loop"
+        )
+    for bname, b in (("start", start), ("stop", stop)):
+        if _is_traced(b) and not jnp.issubdtype(
+            jnp.asarray(_raw(b)).dtype, jnp.integer
+        ):
+            raise Dy2StaticError(
+                f"to_static for-range: the {bname} bound is a "
+                f"{jnp.asarray(_raw(b)).dtype} Tensor; range() bounds "
+                "must be integers (cast with .astype('int32'))"
+            )
+    step_i = int(_as_py(step))
+    if step_i == 0:
+        raise ValueError("range() arg 3 must not be zero")
+
+    def cond_fn(i, *vars_):
+        iv = jnp.asarray(_raw(i))
+        sv = jnp.asarray(_raw(stop))
+        return (iv < sv) if step_i > 0 else (iv > sv)
+
+    def body_wrap(i, *vars_):
+        new_vars = tuple(body_fn(i, *vars_))
+        return (Tensor(jnp.asarray(_raw(i)) + step_i),) + new_vars
+
+    start_t = (
+        start if isinstance(start, Tensor)
+        else Tensor(jnp.asarray(start, jnp.int32))
+    )
+    out = while_impl(
+        cond_fn, body_wrap, (start_t,) + tuple(loop_vars),
+        names=tuple(names or ()),
+        where="to_static for-range",
+    )
+    # out[0] is the counter AFTER the last increment; Python's post-loop
+    # binding is one step back. A zero-iteration traced loop cannot keep
+    # "unbound" semantics inside a trace — clamp to start (documented
+    # divergence; avoids e.g. a silent -1 index downstream).
+    final = jnp.asarray(_raw(out[0])) - step_i
+    start_v = jnp.asarray(_raw(start_t))
+    i_last = Tensor(
+        jnp.maximum(final, start_v) if step_i > 0
+        else jnp.minimum(final, start_v)
+    )
+    return (i_last,) + tuple(out[1:])
+
+
+def _as_py(v):
+    if isinstance(v, Tensor):
+        return np.asarray(v.value).item()
+    return v
+
+
 # ------------------------------------------------------------------ switch
 def switch_impl(branch_index, branch_fns, default=None, where="switch_case"):
     """paddle.static.nn.switch_case semantics over ``lax.switch``.

@@ -312,6 +312,69 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         return [true_fn, false_fn, call, unpack]
 
+    def visit_For(self, node):
+        self.generic_visit(node)
+        # only `for <name> in range(...)` without else/ctrl-flow converts;
+        # other iterables stay Python (eager semantics / unrolled in trace)
+        if (
+            node.orelse
+            or _has_escaping_ctrl(node.body)
+            or not isinstance(node.target, ast.Name)
+            or not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or node.iter.keywords
+            or not (1 <= len(node.iter.args) <= 3)
+            or any(isinstance(a, ast.Starred) for a in node.iter.args)
+        ):
+            return node
+        loop_name = node.target.id
+        body_assigned = _assigned_names(node.body)
+        if loop_name in body_assigned:
+            # the body rebinds the loop variable: Python's post-loop
+            # binding would be the body's value, which the conversion
+            # cannot reproduce — leave as plain Python
+            return node
+        assigned = sorted(
+            n for n in body_assigned if not n.startswith("__dy2st_")
+        )
+        if not assigned:
+            return node
+        uid = self._uid()
+        self.changed = True
+        body_name = f"__dy2st_forbody_{uid}"
+        out_name = f"__dy2st_out_{uid}"
+        body_fn = _make_branch_fn(
+            body_name, [loop_name] + assigned, node.body, assigned
+        )
+        call = ast.Assign(
+            targets=[_name(out_name, ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_for_range"),
+                args=[
+                    ast.Tuple(elts=list(node.iter.args), ctx=ast.Load()),
+                    _name(body_name),
+                    _capture_call(loop_name),  # prior binding (empty range)
+                    ast.Tuple(
+                        elts=[_capture_call(n) for n in assigned],
+                        ctx=ast.Load(),
+                    ),
+                    ast.Constant((loop_name,) + tuple(assigned)),
+                ],
+                keywords=[],
+            ),
+        )
+        # the loop variable stays bound after the loop (Python semantics)
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[_name(n, ast.Store())
+                      for n in [loop_name] + assigned],
+                ctx=ast.Store(),
+            )],
+            value=_name(out_name),
+        )
+        return [body_fn, call, unpack]
+
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse or _has_escaping_ctrl(node.body):

@@ -509,6 +509,102 @@ def test_user_typeerror_in_branch_not_rebranded():
     assert not isinstance(ei.value, Dy2StaticError)
 
 
+def test_ast_for_range_concrete_parity():
+    def f(x):
+        acc = x * 0.0
+        for i in range(3):
+            acc = acc + x * float(i + 1)
+        return acc, i  # noqa: F821 — Python binds i after the loop
+
+    sf = paddle.jit.to_static(f)
+    v = RNG.randn(4).astype(np.float32)
+    out, i_last = sf(T(v))
+    np.testing.assert_allclose(out.numpy(), v * 6.0, rtol=1e-6)
+    assert int(i_last) == 2
+
+
+def test_ast_for_range_tensor_bound():
+    def f(x, n):
+        acc = x * 0.0
+        for _ in range(n):
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    v = RNG.randn(4).astype(np.float32)
+    for n in (0, 1, 5):
+        np.testing.assert_allclose(
+            sf(T(v), T(np.int32(n))).numpy(), v * float(n), rtol=1e-6,
+            atol=1e-7,
+        )
+
+
+def test_ast_for_range_step_and_start():
+    def f(x, n):
+        s = x.sum() * 0.0
+        for i in range(2, n, 3):
+            s = s + float(1.0) * i
+        return s
+
+    sf = paddle.jit.to_static(f)
+    v = np.ones(2, np.float32)
+    gold = float(sum(range(2, 11, 3)))
+    assert float(sf(T(v), T(np.int32(11))).numpy()) == pytest.approx(gold)
+
+
+def test_ast_for_range_loopvar_reassigned_in_body_untouched():
+    # body rebinds the loop var: conversion must bail (Python semantics)
+    def f(x):
+        acc = x.sum() * 0.0
+        for i in range(3):
+            i = i * 10
+            acc = acc + float(i)
+        return acc, i  # noqa: F821
+
+    sf = paddle.jit.to_static(f)
+    out, i_last = sf(T(np.ones(2, np.float32)))
+    assert float(out.numpy()) == pytest.approx(0.0 + 10.0 + 20.0)
+    assert int(i_last) == 20  # Python post-loop binding preserved
+
+
+def test_ast_for_range_empty_keeps_prior_binding():
+    def f(x):
+        i = 5
+        acc = x * 1.0
+        for i in range(0):
+            acc = acc + x
+        return acc * float(i)
+
+    sf = paddle.jit.to_static(f)
+    v = np.ones(3, np.float32)
+    np.testing.assert_allclose(sf(T(v)).numpy(), v * 5.0, rtol=1e-6)
+
+
+def test_ast_for_range_float_tensor_bound_error():
+    def f(x, b):
+        acc = x * 0.0
+        for _ in range(b):
+            acc = acc + x
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    with pytest.raises(Dy2StaticError, match="integer"):
+        sf(T(np.ones(2, np.float32)), T(np.float32(2.9)))
+
+
+def test_ast_for_over_list_untouched():
+    # non-range iterables keep plain Python semantics
+    def f(x):
+        acc = x * 0.0
+        for s in [1.0, 2.0]:
+            acc = acc + x * s
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    v = RNG.randn(3).astype(np.float32)
+    np.testing.assert_allclose(sf(T(v)).numpy(), v * 3.0, rtol=1e-6)
+
+
 # ------------------------------------------------------- converter direct
 def test_convert_to_static_noop_without_control_flow():
     def f(x):
