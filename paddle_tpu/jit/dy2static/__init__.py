@@ -392,8 +392,8 @@ def convert_for_range(range_args, body_fn, prior_i, loop_vars, names):
     if not any(_is_traced(v) for v in (start, stop, step)):
         out = tuple(loop_vars)
         i = prior_i  # empty range: the prior binding survives (Python)
-        for i in range(int(_as_py(start)), int(_as_py(stop)),
-                       int(_as_py(step))):
+        for i in range(_as_index(start), _as_index(stop),
+                       _as_index(step)):
             out = tuple(body_fn(i, *out))
         return (i,) + out
 
@@ -413,9 +413,11 @@ def convert_for_range(range_args, body_fn, prior_i, loop_vars, names):
                 f"{jnp.asarray(_raw(b)).dtype} Tensor; range() bounds "
                 "must be integers (cast with .astype('int32'))"
             )
-    step_i = int(_as_py(step))
+    step_i = _as_index(step)
     if step_i == 0:
         raise ValueError("range() arg 3 must not be zero")
+    if not _is_traced(start):
+        start = _as_index(start)  # float start: TypeError (range parity)
 
     def cond_fn(i, *vars_):
         iv = jnp.asarray(_raw(i))
@@ -452,6 +454,19 @@ def _as_py(v):
     if isinstance(v, Tensor):
         return np.asarray(v.value).item()
     return v
+
+
+def _as_index(v):
+    """range()-parity bound conversion: floats raise like Python."""
+    p = _as_py(v)
+    if isinstance(p, float) or (
+        hasattr(p, "dtype") and not np.issubdtype(p.dtype, np.integer)
+    ):
+        raise TypeError(
+            f"'{type(p).__name__}' object cannot be interpreted as an "
+            "integer (range() bound in to_static-converted loop)"
+        )
+    return int(p)
 
 
 # ------------------------------------------------------------------ switch

@@ -1,0 +1,48 @@
+"""Build hook: compile the shm-ring C extension into the wheel.
+
+The extension exposes no Python symbols (it is loaded via ctypes —
+paddle_tpu/native/__init__.py), so it is built as a plain shared object
+through a small build_ext override rather than a CPython extension
+module; source checkouts that skip setup.py entirely still work via the
+runtime cc fallback in the same module.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+class BinaryDistribution(Distribution):
+    """The wheel ships a compiled .so (ctypes-loaded, not a CPython
+    extension module), so it must carry a platform tag — a py3-none-any
+    wheel would install an ELF binary on foreign platforms."""
+
+    def has_ext_modules(self):
+        return True
+
+
+class BuildWithRing(build_py):
+    def run(self):
+        super().run()
+        src = os.path.join("paddle_tpu", "native", "shm_ring.c")
+        out_dir = os.path.join(self.build_lib, "paddle_tpu", "native")
+        os.makedirs(out_dir, exist_ok=True)
+        out = os.path.join(out_dir, "_shm_ring.so")
+        cc = os.environ.get("CC", "cc")
+        try:
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", out, src],
+                check=True,
+            )
+        except (OSError, subprocess.CalledProcessError):
+            # no toolchain at build time: the runtime fallback compiles
+            # on first use; the DataLoader degrades to threads without it
+            pass
+
+
+setup(cmdclass={"build_py": BuildWithRing},
+      distclass=BinaryDistribution)
