@@ -33,6 +33,49 @@ def test_api_audit_is_clean():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_audit_tensor_methods_dispatch_like_functions():
+    """Every audit-closure method binding must route to the same op as
+    the top-level function (spot-check one per family + existence of
+    the full set)."""
+    a = (RNG.rand(4, 4) * 0.8 + 0.1).astype(np.float32)
+    t = T(a)
+    pairs = [
+        ("cummax", dict(axis=1)), ("cummin", dict(axis=1)),
+        ("deg2rad", {}), ("rad2deg", {}), ("digamma", {}),
+        ("lgamma", {}), ("logit", {}), ("sinc", {}), ("i0", {}),
+        ("signbit", {}), ("sgn", {}), ("conj", {}), ("real", {}),
+        ("imag", {}), ("frac", {}),
+    ]
+    for name, kw in pairs:
+        fn_out = getattr(paddle, name)(t, **kw)
+        m_out = getattr(t, name)(**kw)
+        fl = fn_out if isinstance(fn_out, (tuple, list)) else [fn_out]
+        ml = m_out if isinstance(m_out, (tuple, list)) else [m_out]
+        assert len(fl) == len(ml), name
+        for f, m in zip(fl, ml):
+            np.testing.assert_array_equal(
+                np.asarray(f.numpy()), np.asarray(m.numpy()),
+                err_msg=name,
+            )
+    # binary/method-with-args families
+    b = (RNG.rand(4, 4) + 0.5).astype(np.float32)
+    for name in ("heaviside", "hypot", "nextafter", "ldexp", "dist",
+                 "floor_mod"):
+        arg = T(b.astype(np.int32)) if name == "ldexp" else T(b)
+        np.testing.assert_allclose(
+            np.asarray(getattr(paddle, name)(t, arg).numpy()),
+            np.asarray(getattr(t, name)(arg).numpy()),
+            rtol=1e-6,
+        )
+    ints = T(RNG.randint(1, 30, (4, 4)).astype(np.int64))
+    other = T(RNG.randint(1, 30, (4, 4)).astype(np.int64))
+    for name in ("gcd", "lcm"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(paddle, name)(ints, other).numpy()),
+            np.asarray(getattr(ints, name)(other).numpy()),
+        )
+
+
 def test_i0e_i1e_vs_torch():
     x = (RNG.rand(16) * 4 - 2).astype(np.float32)
     np.testing.assert_allclose(
