@@ -50,6 +50,7 @@ from .core.dtypes import (
     set_default_dtype,
     uint8,
 )
+from .core.lazy import LazyGuard
 from .core.random import get_rng_state, seed, set_rng_state
 from .core.tape import is_grad_enabled, no_grad, set_grad_enabled
 from .core.tensor import Parameter, Tensor, is_tensor
@@ -114,11 +115,33 @@ for _name in _TENSOR_METHODS:
     if _fn is not None and not hasattr(Tensor, _name):
         setattr(Tensor, _name, _fn)
 
-# paddle.dtype: the type of Tensor.dtype values (numpy dtype objects here —
-# paddle.float32 etc. are the jnp scalar types, comparable via np equality)
+# paddle.dtype: the type of Tensor.dtype values. Tensor.dtype yields numpy
+# dtype objects; the literals (paddle.float32, ...) are the jnp scalar
+# types. In the reference the literals ARE instances of paddle.dtype, so
+# scripts write ``isinstance(paddle.float32, paddle.dtype)`` — honoured
+# here via __instancecheck__ accepting both forms. Calling paddle.dtype(x)
+# constructs a numpy dtype, like the alias it replaces.
 import numpy as _np  # noqa: E402
 
-dtype = _np.dtype
+
+class _DTypeMeta(type):
+    _literals = frozenset(
+        map(id, (bfloat16, bool_, complex64, complex128, float16, float32,
+                 float64, int8, int16, int32, int64, uint8))
+    )
+
+    def __instancecheck__(cls, obj):
+        return isinstance(obj, _np.dtype) or id(obj) in cls._literals
+
+    def __call__(cls, obj):
+        # no default: np.dtype() raises too — paddle.dtype(None) silently
+        # meaning float64 would be a wrong-dtype trap on a fp32 framework
+        return _np.dtype(obj)
+
+
+class dtype(metaclass=_DTypeMeta):
+    """The type of dtype values: ``isinstance`` accepts numpy dtypes and
+    the paddle dtype literals; calling it coerces to a numpy dtype."""
 
 # paddle-compat static-mode switches (static graph == jax.jit here; these are
 # retained as no-ops so reference scripts run unmodified)

@@ -447,6 +447,8 @@ class Parameter(Tensor):
         "need_clip",
         "split_axis",
         "sequence_parallel",
+        "_lazy_initializer",  # set under LazyGuard; see Layer.materialize
+        "_lazy_seq",  # creation-order ticket for materialize() RNG replay
     )
 
     def __init__(self, value, trainable=True, name=None):

@@ -58,13 +58,25 @@ def shard_constraint(t, *spec):
 
 
 def _place(param, *spec):
-    """Shard a freshly initialized full parameter over the global mesh."""
+    """Shard a freshly initialized full parameter over the global mesh.
+
+    Under ``paddle.LazyGuard`` the parameter is abstract: the sharding is
+    attached to the ShapeDtypeStruct instead of moving bytes, so a lazily
+    built TP model lowers with its real parameter layout."""
     if param is None:
         return None
+    from .....core import lazy as lazy_mod
+
     mesh = mesh_mod.get_mesh()
-    param.value = jax.device_put(
-        param.value, NamedSharding(mesh, P(*spec))
-    )
+    if lazy_mod.is_abstract(param.value):
+        param.value = lazy_mod.abstract_like(
+            param.value.shape, param.value.dtype,
+            sharding=NamedSharding(mesh, P(*spec)),
+        )
+    else:
+        param.value = jax.device_put(
+            param.value, NamedSharding(mesh, P(*spec))
+        )
     return param
 
 

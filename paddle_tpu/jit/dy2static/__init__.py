@@ -340,14 +340,23 @@ def while_impl(cond_fn, body_fn, loop_vars, names=None, where="while_loop",
     try:
         if maximum_trip_count is not None:
             # masked scan: fixed length, iterations past the condition
-            # are identity — reverse-differentiable on TPU
+            # are identity — reverse-differentiable on TPU. The identity
+            # arm is a real lax.cond branch, NOT a jnp.where over an
+            # unconditionally-executed body: with where, a body op that
+            # is NaN on the frozen carry (sqrt/log/division one step past
+            # the exit) poisons reverse-mode through 0*NaN; with cond the
+            # stale body does not run. Caveat: under a batching
+            # transform (jax.vmap) cond lowers to select_n and both arms
+            # execute again — vmapping a bounded loop whose body is
+            # NaN past the exit reinstates the hazard.
             def scan_body(carry, _):
                 leaves, done = carry
                 cont = jnp.logical_and(cond_wrapped(leaves), ~done)
-                new_leaves = body_wrapped(leaves)
-                kept = tuple(
-                    jnp.where(cont, n, o)
-                    for o, n in zip(leaves, new_leaves)
+                kept = jax.lax.cond(
+                    cont,
+                    lambda ls: tuple(body_wrapped(ls)),
+                    lambda ls: ls,
+                    leaves,
                 )
                 return (kept, ~cont), None
 

@@ -450,6 +450,15 @@ class CompiledTrainStep:
         if self._step_fn is None:
             self._build()
         params = {k: p.value for k, p in self.network.named_parameters()}
+        for k, v in params.items():
+            if isinstance(v, jax.ShapeDtypeStruct):
+                raise RuntimeError(
+                    f"parameter {k!r} is still abstract (built under "
+                    "paddle.LazyGuard): call network.materialize() or "
+                    "load a checkpoint before training. Abstract "
+                    "networks can only be lowered (jit(...).lower), "
+                    "not executed."
+                )
         buffers = {k: b.value for k, b in self.network.named_buffers()}
         opt_state = self._gather_opt_state(params)
         if self._step_fn is None:  # (compile happens on first _invoke)

@@ -16,3 +16,7 @@ from .bert import (  # noqa: F401
 )
 from .gpt_moe import GPTMoEConfig, GPTMoEForCausalLM  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .llama_pipe import (  # noqa: F401
+    LlamaDecoderLayerTP,
+    LlamaForCausalLMPipe,
+)

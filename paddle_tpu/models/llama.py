@@ -153,7 +153,24 @@ class LlamaModel(nn.Layer):
         return self.norm(h)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaFlopsMixin:
+    """Shared param/FLOPs accounting for every Llama head (single-device
+    and pipe): 6*N + attention quadratic term (12*L*H*S per token with
+    H=hidden — standard PaLM-appendix accounting). Single home so the
+    bench's MFU math cannot drift between model variants."""
+
+    def num_params(self):
+        return sum(int(p.size) for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        cfg = self.config
+        return (
+            6 * self.num_params()
+            + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+        )
+
+
+class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -171,15 +188,3 @@ class LlamaForCausalLM(nn.Layer):
             return F.linear(h, self.model.embed_tokens.weight.t())
         return self.lm_head(h)
 
-    def num_params(self):
-        return sum(int(p.size) for p in self.parameters())
-
-    def flops_per_token(self, seq_len):
-        """Training FLOPs/token: 6*N + attention quadratic term
-        (12*L*H*S per token with H=hidden, standard PaLM appendix
-        accounting)."""
-        cfg = self.config
-        return (
-            6 * self.num_params()
-            + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
-        )

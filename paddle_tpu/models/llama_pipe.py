@@ -1,0 +1,159 @@
+"""Llama under Fleet hybrid parallel — the BASELINE config #4 path.
+
+Reference parity: PaddleNLP's ``LlamaForCausalLMPipe`` (a PipelineLayer
+of TP decoder blocks driven by fleet's PipelineParallel — unverified,
+mount empty). TPU-first design: the same [prefix | uniform TP blocks |
+suffix] structure, but executed as ONE jitted SPMD program — Megatron TP
+via GSPMD shardings (mp axis), the microbatch schedule via the compiled
+ppermute ring (pp axis), data parallel via batch sharding (dp axis).
+
+Sharding layout per decoder block (mesh axes (dp, pp, mp)):
+- q/k/v projections: ColumnParallelLinear, weight P(None, 'mp') — heads
+  split across mp ranks;
+- o_proj: RowParallelLinear, weight P('mp', None) — the attention
+  output's head dim is contracted locally, XLA inserts the mp allreduce;
+- gate/up projections: ColumnParallelLinear (SwiGLU operands stay
+  mp-sharded, multiplied elementwise shard-local);
+- down_proj: RowParallelLinear;
+- RMSNorm weights: replicated (tiny);
+- embedding: VocabParallelEmbedding, weight P('mp', None) (vocab rows);
+- lm head: ColumnParallelLinear gather_output=False + the distributed
+  softmax of ParallelCrossEntropy over vocab-sharded logits.
+
+Each block rebuilds its rope cache from the static sequence length —
+XLA constant-folds it once per compilation; blocks carry no buffers (a
+requirement of the compiled pipeline's stacked-scan schedule).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..incubate.nn import functional as IF
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    LayerDesc,
+    ParallelCrossEntropy,
+    PipelineLayer,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .llama import LlamaConfig, LlamaFlopsMixin
+
+
+class LlamaDecoderLayerTP(nn.Layer):
+    """One uniform pipeline block: TP attention + TP SwiGLU MLP."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.cfg = config
+        h, d = config.hidden_size, config.head_dim
+        self.input_layernorm = nn.RMSNorm(h, epsilon=config.rms_norm_eps)
+        self.q_proj = ColumnParallelLinear(
+            h, config.num_attention_heads * d, has_bias=False,
+            gather_output=False,
+        )
+        self.k_proj = ColumnParallelLinear(
+            h, config.kv_heads * d, has_bias=False, gather_output=False
+        )
+        self.v_proj = ColumnParallelLinear(
+            h, config.kv_heads * d, has_bias=False, gather_output=False
+        )
+        self.o_proj = RowParallelLinear(
+            config.num_attention_heads * d, h, has_bias=False,
+            input_is_parallel=True,
+        )
+        self.post_attention_layernorm = nn.RMSNorm(
+            h, epsilon=config.rms_norm_eps
+        )
+        ffn = config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(
+            h, ffn, has_bias=False, gather_output=False
+        )
+        self.up_proj = ColumnParallelLinear(
+            h, ffn, has_bias=False, gather_output=False
+        )
+        self.down_proj = RowParallelLinear(
+            ffn, h, has_bias=False, input_is_parallel=True
+        )
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, S = int(x.shape[0]), int(x.shape[1])
+        from ..kernels.rope import build_rope_cache
+
+        cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
+        h = self.input_layernorm(x)
+        q = self.q_proj(h).reshape(
+            [B, S, cfg.num_attention_heads, cfg.head_dim]
+        )
+        k = self.k_proj(h).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        v = self.v_proj(h).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        q, k, _ = IF.fused_rotary_position_embedding(
+            q, k, None, sin=Tensor(sin), cos=Tensor(cos),
+            rotary_emb_base=cfg.rope_theta,
+        )
+        if cfg.kv_heads != cfg.num_attention_heads:
+            rep = cfg.num_attention_heads // cfg.kv_heads
+            k = k.repeat_interleave(rep, axis=2)
+            v = v.repeat_interleave(rep, axis=2)
+        a = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, training=self.training
+        )
+        x = x + self.o_proj(a.reshape([B, S, -1]))
+        h2 = self.post_attention_layernorm(x)
+        return x + self.down_proj(
+            IF.swiglu(self.gate_proj(h2), self.up_proj(h2))
+        )
+
+
+class _FinalNorm(nn.RMSNorm):
+    pass  # distinct type so the block-run detector keeps it in the suffix
+
+
+class LlamaForCausalLMPipe(LlamaFlopsMixin, PipelineLayer):
+    """PipelineLayer over TP Llama decoder blocks with the vocab-parallel
+    embedding prefix and the TP head + distributed-softmax loss suffix.
+
+    ``num_stages`` defaults to the hybrid mesh's pp degree. Train it with
+    ``fleet.distributed_model`` / ``PipelineParallel.train_batch``
+    (pipeline_configs={'compiled': True} for the single-program path) —
+    exactly the reference's Fleet hybrid flow for BASELINE config #4.
+    """
+
+    def __init__(self, config: LlamaConfig, num_stages=None,
+                 num_virtual_pipeline_stages=1, recompute_interval=0,
+                 topology=None):
+        from ..parallel import mesh as mesh_mod
+
+        if num_stages is None:
+            num_stages = mesh_mod.global_mesh_shape().get("pp", 1)
+        self.config = config
+        pce = ParallelCrossEntropy()
+
+        def loss_fn(logits, labels):
+            return pce(
+                logits.reshape([-1, config.vocab_size]),
+                labels.reshape([-1]),
+            ).mean()
+
+        super().__init__(
+            [LayerDesc(VocabParallelEmbedding, config.vocab_size,
+                       config.hidden_size)]
+            + [LayerDesc(LlamaDecoderLayerTP, config)
+               for _ in range(config.num_hidden_layers)]
+            + [
+                LayerDesc(_FinalNorm, config.hidden_size,
+                          epsilon=config.rms_norm_eps),
+                LayerDesc(ColumnParallelLinear, config.hidden_size,
+                          config.vocab_size, has_bias=False,
+                          gather_output=False),
+            ],
+            num_stages=num_stages,
+            loss_fn=loss_fn,
+            num_virtual_pipeline_stages=num_virtual_pipeline_stages,
+            recompute_interval=recompute_interval,
+            topology=topology,
+        )

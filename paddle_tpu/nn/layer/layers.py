@@ -151,12 +151,69 @@ class Layer:
             initializer = (
                 init_mod.Constant(0.0) if is_bias else init_mod.XavierUniform()
             )
+        from ...core import lazy as lazy_mod
+
+        if lazy_mod.in_lazy_mode():
+            # LazyGuard: abstract parameter — no allocation, no init
+            # compute; materializable later, lowerable immediately
+            value = lazy_mod.abstract_like(
+                tuple(int(s) for s in shape), dtype
+            )
+            p = Parameter(value, trainable=attr.trainable, name=attr.name)
+            p._lazy_initializer = initializer  # for materialize()
+            # creation order, so materialize() replays the RNG stream in
+            # the exact sequence eager init would have consumed it
+            p._lazy_seq = lazy_mod.next_seq()
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+            p.regularizer = attr.regularizer
+            p.need_clip = getattr(attr, "need_clip", True)
+            self._maybe_lazy = True  # checked (then cleared) on __call__
+            return p
         value = initializer(tuple(int(s) for s in shape), dtype)
         p = Parameter(value, trainable=attr.trainable, name=attr.name)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = getattr(attr, "need_clip", True)
         return p
+
+    def materialize(self):
+        """Materialize every LazyGuard-created (abstract) parameter.
+
+        Each parameter's recorded initializer is compiled with the
+        parameter's sharding as ``out_shardings`` — on a device mesh the
+        weight is initialized SHARD-LOCAL on its owning chips and a full
+        host-resident copy never exists, which is the only way a
+        LazyGuard-built 7B-class model can come up on real hardware.
+        Initializers run in parameter CREATION order (not traversal
+        order), so under the same seed materialize() reproduces eager
+        init exactly. No-op for parameters that are already concrete.
+        """
+        import jax
+
+        from ...core import lazy as lazy_mod
+
+        todo = [
+            p for _, p in self.named_parameters()
+            if lazy_mod.is_abstract(p.value)
+        ]
+        todo.sort(key=lambda p: getattr(p, "_lazy_seq", 0))
+        for p in todo:
+            init = getattr(p, "_lazy_initializer", None)
+            if init is None:
+                init = init_mod.XavierUniform()
+            shape = tuple(p.value.shape)
+            dt = p.value.dtype
+            sharding = getattr(p.value, "sharding", None)
+            if sharding is not None:
+                p.value = jax.jit(
+                    lambda i=init, s=shape, d=dt: i(s, d),
+                    out_shardings=sharding,
+                )()
+            else:
+                p.value = init(shape, dt)
+        for l in self.sublayers(include_self=True):
+            l.__dict__.pop("_maybe_lazy", None)
+        return self
 
     def create_tensor(self, name=None, dtype=None, default_initializer=None):
         dtype = convert_dtype(dtype) or self._dtype
@@ -365,6 +422,8 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if self.__dict__.get("_maybe_lazy"):
+            self._check_lazy_executable()
         for hook in self._forward_pre_hooks.values():
             result = hook(self, inputs)
             if result is not None:
@@ -375,6 +434,26 @@ class Layer:
             if result is not None:
                 outputs = result
         return outputs
+
+    def _check_lazy_executable(self):
+        """One-time (flag-gated) guard: a LazyGuard-built layer must be
+        materialized — or have concrete values loaded — before it can
+        execute; without this the failure is a raw jax TypeError deep in
+        dispatch. Clears the flag once all parameters are concrete (e.g.
+        after set_state_dict), so the walk never repeats."""
+        from ...core import lazy as lazy_mod
+
+        for k, p in self.named_parameters():
+            if lazy_mod.is_abstract(p.value):
+                raise RuntimeError(
+                    f"parameter {k!r} is still abstract (built under "
+                    "paddle.LazyGuard): call .materialize() or load a "
+                    "checkpoint before running the layer. Abstract "
+                    "networks can only be lowered (jit(...).lower), "
+                    "not executed."
+                )
+        for l in self.sublayers(include_self=True):
+            l.__dict__.pop("_maybe_lazy", None)
 
     def full_name(self):
         return self._full_name

@@ -382,6 +382,47 @@ def test_while_loop_maximum_trip_count_trains():
     assert not np.allclose(before, after)  # grads flowed through the loop
 
 
+def test_while_loop_masked_scan_nan_safe_gradients():
+    # the masked scan's identity arm is a real lax.cond branch: a body op
+    # that is NaN one step past the exit (here sqrt of a negative) must
+    # NOT poison reverse-mode gradients (0*NaN through a where would)
+    class SqrtLoopNet(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+
+            def cond(v):
+                return v.sum() > 1.0
+
+            def body(v):
+                # sqrt(sum-1) is finite while cond (sum>1) holds but NaN
+                # one step past the exit — and sqrt's VJP partial is NaN
+                # too, so 0-cotangent * NaN-partial poisons grads if the
+                # stale body actually executes
+                return (v * 0.5 + 0.0 * (v.sum() - 1.0).sqrt(),)
+
+            (v,) = paddle.static.nn.while_loop(
+                cond, body, [h.abs() + 2.0], maximum_trip_count=8
+            )
+            return v.sum()
+
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    paddle.seed(5)
+    net = SqrtLoopNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    step = CompiledTrainStep(net, lambda out, _: out, opt)
+    x = RNG.randn(2, 4).astype(np.float32)
+    loss, _ = step([T(x)], [T(np.zeros((), np.float32))])
+    assert np.isfinite(float(np.asarray(loss.numpy())))
+    after = np.asarray(net.lin.weight.numpy())
+    assert np.isfinite(after).all()  # no NaN leaked into the update
+
+
 def test_while_loop_masked_scan_value_parity():
     # the masked scan must compute the same value as the unbounded loop
     @paddle.jit.to_static

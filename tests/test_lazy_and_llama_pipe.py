@@ -1,0 +1,207 @@
+"""LazyGuard abstract init + LlamaForCausalLMPipe hybrid model.
+
+Reference parity targets: paddle.LazyGuard (lazy big-model init) and
+PaddleNLP's LlamaForCausalLMPipe under fleet hybrid parallel (BASELINE
+config #4) — unverified paths, mount empty.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
+
+RNG = np.random.RandomState(0)
+
+
+@pytest.fixture(scope="module")
+def hcg():
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [2, 2, 1, 1, 2]
+    )
+    return HybridCommunicateGroup(topo)
+
+
+def _tiny_cfg(mp=2):
+    return LlamaConfig.tiny(
+        vocab_size=16 * mp, hidden_size=32, intermediate_size=16 * mp,
+        num_hidden_layers=4, num_attention_heads=mp,
+    )
+
+
+# ------------------------------------------------------------- LazyGuard
+def test_lazy_guard_abstract_params():
+    with paddle.LazyGuard():
+        net = nn.Linear(8, 4)
+    assert isinstance(net.weight.value, jax.ShapeDtypeStruct)
+    assert isinstance(net.bias.value, jax.ShapeDtypeStruct)
+    assert net.weight.shape == [8, 4]
+    # guard exits cleanly: new layers are concrete again
+    net2 = nn.Linear(3, 3)
+    assert not isinstance(net2.weight.value, jax.ShapeDtypeStruct)
+
+
+def test_lazy_guard_materialize_matches_seeded_init():
+    paddle.seed(7)
+    with paddle.LazyGuard():
+        net = nn.Linear(8, 4)
+    paddle.seed(7)
+    net.materialize()
+    paddle.seed(7)
+    gold = nn.Linear(8, 4)
+    np.testing.assert_allclose(
+        np.asarray(net.weight.numpy()), np.asarray(gold.weight.numpy())
+    )
+    # materialized net trains/executes normally
+    y = net(Tensor(jnp.ones((2, 8), jnp.float32)))
+    assert np.isfinite(np.asarray(y.numpy())).all()
+
+
+def test_lazy_guard_materialize_creation_order_parity():
+    # own-param created AFTER a sublayer: materialize must replay the
+    # RNG stream in CREATION order, not named_parameters() order
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.sub = nn.Linear(4, 4)
+            self.w = self.create_parameter([4, 4])
+
+        def forward(self, x):
+            return self.sub(x) @ self.w
+
+    paddle.seed(13)
+    with paddle.LazyGuard():
+        net = Net()
+    paddle.seed(13)
+    net.materialize()
+    paddle.seed(13)
+    gold = Net()
+    for (k, a), (_, b) in zip(net.named_parameters(),
+                              gold.named_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(a.numpy()), np.asarray(b.numpy()), err_msg=k
+        )
+
+
+def test_lazy_eager_call_refuses_with_clear_error():
+    with paddle.LazyGuard():
+        net = nn.Linear(4, 2)
+    with pytest.raises(RuntimeError, match="materialize"):
+        net(Tensor(jnp.ones((2, 4), jnp.float32)))
+    # loading concrete values clears the guard without materialize()
+    concrete = nn.Linear(4, 2)
+    net.set_state_dict(concrete.state_dict())
+    y = net(Tensor(jnp.ones((2, 4), jnp.float32)))
+    assert np.isfinite(np.asarray(y.numpy())).all()
+
+
+def test_dtype_call_signature():
+    with pytest.raises(TypeError):
+        paddle.dtype()
+
+
+def test_lazy_network_refuses_execution_with_clear_error():
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    with paddle.LazyGuard():
+        net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = CompiledTrainStep(net, lambda out, lbl: out.sum(), opt)
+    with pytest.raises(RuntimeError, match="materialize"):
+        step([Tensor(jnp.ones((2, 4), jnp.float32))],
+             [Tensor(jnp.zeros((), jnp.float32))])
+
+
+def test_lazy_tp_params_carry_sharding(hcg):
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear,
+    )
+
+    with paddle.LazyGuard():
+        lin = ColumnParallelLinear(8, 8, gather_output=False)
+    v = lin.weight.value
+    assert isinstance(v, jax.ShapeDtypeStruct)
+    assert v.sharding is not None and "mp" in str(v.sharding.spec)
+    # materialization honours the recorded sharding (shard-local init)
+    lin.materialize()
+    assert "mp" in str(lin.weight.value.sharding.spec)
+
+
+# ------------------------------------------------- LlamaForCausalLMPipe
+def test_llama_pipe_compiled_hybrid_step_trains(hcg):
+    from types import SimpleNamespace
+
+    paddle.seed(11)
+    cfg = _tiny_cfg()
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+    engine = PipelineParallel(
+        pipe, hcg,
+        SimpleNamespace(pipeline_configs={
+            "accumulate_steps": 2, "compiled": True,
+        }),
+    )
+    ids = jax.device_put(
+        jnp.asarray(RNG.randint(0, cfg.vocab_size, (4, 8))),
+        NamedSharding(hcg.mesh, P("dp")),
+    )
+    losses = []
+    for _ in range(4):
+        loss = engine.train_batch((Tensor(ids), Tensor(ids)), opt)
+        losses.append(float(np.asarray(loss.numpy())))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # it actually learns the batch
+
+
+def test_llama_pipe_tp_layout(hcg):
+    cfg = _tiny_cfg()
+    with paddle.LazyGuard():
+        pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    specs = {
+        k: str(getattr(p.value, "sharding", None) and p.value.sharding.spec)
+        for k, p in pipe.named_parameters()
+    }
+    qkv = [k for k in specs if "q_proj" in k or "k_proj" in k
+           or "v_proj" in k or "gate_proj" in k or "up_proj" in k]
+    assert qkv and all("mp" in specs[k] for k in qkv)
+    rows = [k for k in specs if "o_proj" in k or "down_proj" in k]
+    assert rows and all("mp" in specs[k] for k in rows)
+    norms = [k for k in specs if "layernorm" in k]
+    assert norms and all("mp" not in specs[k] for k in norms)
+
+
+def test_lower_7b_harness_on_small_config(hcg):
+    """The lower_7b flow end-to-end with a small-but-real config (the
+    full 7B build runs in the dryrun/bench path; this keeps CI fast
+    while covering the same code: LazyGuard -> abstract opt state ->
+    jit.lower -> collective/sharding assertions)."""
+    import tools.lower_7b as l7
+
+    small = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4,
+        max_position_embeddings=64,
+    )
+    rep = l7.lower_7b(dp=2, pp=2, mp=2, B=4, S=16, micro_batches=2,
+                      cfg=small, min_params=0)
+    assert rep["ok"] and rep["collective_permute_ops"] > 0
+
+
+def test_lower_7b_small_asserts_n_params():
+    # the n_params guard in lower_7b must trip for a non-7B config
+    import tools.lower_7b as l7
+
+    with pytest.raises(AssertionError, match="params"):
+        l7.lower_7b(dp=2, pp=2, mp=2, B=4, S=16, micro_batches=2,
+                    cfg=_tiny_cfg())

@@ -1,0 +1,43 @@
+"""Run a python payload in a subprocess with an n-device virtual CPU mesh.
+
+jax backend init is process-global and irreversible; once a process has
+claimed the real TPU chip (or a 1-device CPU platform), the only way to
+get an n-device mesh is a fresh interpreter. The axon sitecustomize
+imports jax at interpreter start and can override JAX_PLATFORMS, so the
+payload must also flip ``jax.config`` in-process before any backend
+touch — the same trick tests/conftest.py uses. This helper is the single
+home of that recipe (used by ``bench.py --lower-7b`` and
+``__graft_entry__.dryrun_multichip``).
+"""
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+
+def run_in_virtual_cpu_mesh(n_devices: int, payload: str, cwd: str,
+                            timeout: int = 1800):
+    """Execute ``payload`` (python source) in a subprocess that sees
+    ``n_devices`` CPU devices. The payload runs AFTER the cpu-platform
+    bootstrap. Returns the CompletedProcess (output captured)."""
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    flags = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        f"import os; os.environ['XLA_FLAGS'] = {flags!r}; "
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        + payload
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=cwd, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
