@@ -286,11 +286,15 @@ class Tensor:
                 f"{what} of a traced Tensor: inside to_static/jit the "
                 "value is not available, so data-dependent Python control "
                 "flow cannot be compiled. to_static auto-converts "
-                "`if`/`while` on Tensor conditions when the branch/body "
-                "has no early return/break/continue; otherwise use "
-                "paddle.static.nn.cond / while_loop / switch_case, or "
-                "express the branch as a select with paddle.where. "
-                "(reference: dy2static unsupported-syntax errors)"
+                "`if`/`elif`/`while`/`for i in range(...)` on Tensor "
+                "conditions, including early return/break/continue "
+                "inside them — but only when the function's source is "
+                "importable (defined in a file, not a REPL) and the "
+                "exit does not escape a try/except or a generator. "
+                "Otherwise use paddle.static.nn.cond / while_loop / "
+                "switch_case, or express the branch as a select with "
+                "paddle.where. (reference: dy2static unsupported-syntax "
+                "errors)"
             )
 
     def __bool__(self):

@@ -85,6 +85,34 @@ def ld(thunk, name):
         return UndefinedVar(name)
 
 
+def false_():
+    """Early-exit flag initializer (the AST rewriter's `__es_*` flags).
+
+    A jnp bool scalar, NOT Python ``False``: converted branches/loops
+    assign a traced bool into the flag, and an XLA loop carry / cond
+    output must keep one structure — a Python-bool static would flip to
+    a tensor leaf mid-trace and fail the template check."""
+    return jnp.asarray(False)
+
+
+def true_():
+    """Traced-compatible ``True`` for early-exit flag assignment."""
+    return jnp.asarray(True)
+
+
+def int0_():
+    """Pre-loop init for a for-index snapshot slot: int32 to match the
+    traced range counter (convert_for_range's start_t)."""
+    return jnp.asarray(0, jnp.int32)
+
+
+def index_snap(i):
+    """Snapshot a loop index into a carried slot at a deferred-return
+    site. Always an int32 jnp scalar, so unrolled (python-int index) and
+    scanned (traced index) loops produce one carry structure."""
+    return jnp.asarray(_raw(i)).astype(jnp.int32)
+
+
 def _raw(x):
     return x.value if isinstance(x, Tensor) else x
 
@@ -201,6 +229,21 @@ def _describe_template(t):
     return repr(t)
 
 
+def _branch_mismatch_error(where, names, recorded):
+    hint = ""
+    if names:
+        hint = f" (captured variables, in order: {names})"
+    return Dy2StaticError(
+        f"{where}: branches of a Tensor-dependent `if` must produce "
+        "matching outputs — every assigned variable must be a Tensor "
+        f"(or an equal static) in BOTH branches{hint}. "
+        f"true branch: {_describe_template(recorded['t'])}; "
+        f"false branch: {_describe_template(recorded['f'])}. "
+        "Assign the variable in both branches, or compute it with "
+        "paddle.where instead."
+    )
+
+
 def cond_impl(pred, true_thunk, false_thunk, names=None, where="cond"):
     """Core of paddle.static.nn.cond and the AST if-conversion.
 
@@ -231,23 +274,21 @@ def cond_impl(pred, true_thunk, false_thunk, names=None, where="cond"):
     except TypeError as e:
         if not _is_structure_error(e):
             raise  # a genuine user bug inside a branch: keep its traceback
+        if (
+            "t" in recorded and "f" in recorded
+            and not _templates_equal(recorded["t"], recorded["f"])
+        ):
+            # leaf-count mismatches (a var Tensor in one branch,
+            # unassigned/static in the other) fail inside lax.cond before
+            # our own template check runs — surface the paddle-level
+            # explanation, not jax's pytree dump
+            raise _branch_mismatch_error(where, names, recorded) from e
         raise Dy2StaticError(
             f"{where}: the two branches of a Tensor-condition must "
             "return matching shapes/dtypes; jax reported: " + str(e)
         ) from e
     if not _templates_equal(recorded["t"], recorded["f"]):
-        hint = ""
-        if names:
-            hint = f" (captured variables, in order: {names})"
-        raise Dy2StaticError(
-            f"{where}: branches of a Tensor-dependent `if` must produce "
-            "matching outputs — every assigned variable must be a Tensor "
-            f"(or an equal static) in BOTH branches{hint}. "
-            f"true branch: {_describe_template(recorded['t'])}; "
-            f"false branch: {_describe_template(recorded['f'])}. "
-            "Assign the variable in both branches, or compute it with "
-            "paddle.where instead."
-        )
+        raise _branch_mismatch_error(where, names, recorded)
     return _rebuild_outputs(recorded["t"], leaves)
 
 

@@ -1,21 +1,27 @@
 """AST pass: rewrite Python `if`/`while` into runtime-converter calls.
 
 Reference parity: python/paddle/jit/dy2static/transformers/* (IfElse,
-Loop, LogicalOp transformers — unverified, mount empty). Scope here is
-deliberately the common subset that maps onto XLA structured control flow:
+Loop, LogicalOp, Return, BreakContinue transformers — unverified, mount
+empty). Scope is the subset that maps onto XLA structured control flow:
 
-* ``if``/``elif``/``else`` whose branches contain no ``return`` /
-  ``break`` / ``continue`` / ``yield`` -> ``_jst.convert_ifelse``.
-* ``while`` (no ``else`` clause, body free of the same statements)
-  -> ``_jst.convert_while``.
+* ``if``/``elif``/``else`` -> ``_jst.convert_ifelse``.
+* ``while`` (no ``else`` clause) -> ``_jst.convert_while``.
+* ``for i in range(...)`` -> ``_jst.convert_for_range``.
+* ``return`` / ``break`` / ``continue`` inside the above: the
+  ``_EarlyExitRewriter`` pre-pass else-merges guard returns and
+  flag-gates the rest (see its docstring), after which the statements
+  above convert normally. Early returns along traced paths must produce
+  matching structures (a ``lax.cond`` requirement); mismatches raise
+  the converters' structure errors.
 * ``and`` / ``or`` / ``not`` inside converted predicates
   -> ``_jst.convert_and/or/not`` (Python short-circuit semantics are
   preserved for concrete operands; traced operands become logical ops).
 
-Anything outside this subset is left untouched: with a concrete predicate
-it runs as plain Python; with a traced predicate, ``Tensor.__bool__``
-raises an actionable error naming the rewrite options (this module's
-skip-list is intentionally mirrored in that message).
+Still outside the subset: ``yield``, exits escaping ``try``, loop
+``else`` clauses, non-range ``for`` iterables. These are left untouched:
+with a concrete predicate they run as plain Python; with a traced
+predicate, ``Tensor.__bool__`` raises an actionable error naming the
+rewrite options (this module's skip-list is mirrored in that message).
 
 The conversion is value-semantics-preserving for names: every name a
 branch/body assigns is captured before the statement (``_jst.ld``: value
@@ -148,6 +154,451 @@ def _has_escaping_ctrl(stmts):
     return v.found
 
 
+# ------------------------------------------------------- early-exit rewrite
+def _find_in_block(stmts, types, stop_loops=False):
+    """Nodes of ``types`` within a statement list, not descending into
+    nested function/class scopes; ``stop_loops`` additionally stops at
+    nested loops (for finding THIS loop's break/continue)."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, n):
+            if isinstance(n, types):
+                found.append(n)  # the def itself counts; its body is
+            # a separate scope — never descended
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+        def visit_While(self, n):
+            if isinstance(n, types):
+                found.append(n)
+            if not stop_loops:
+                self.generic_visit(n)
+
+        visit_For = visit_While
+
+        def generic_visit(self, n):
+            if isinstance(n, types):
+                found.append(n)
+            super().generic_visit(n)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return found
+
+
+def _terminates(stmts):
+    """Every path through the list ends in return/break/continue/raise
+    (so code after it is unreachable)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Break, ast.Continue, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return _terminates(last.body) and _terminates(last.orelse)
+    return False
+
+
+class _ExitCtx:
+    """Per-block rewrite context. ``defer`` is set inside loop bodies
+    whose returns are DEFERRED: a ``return expr`` there only raises a
+    site flag (the gating freezes every carried name afterwards), and
+    ``expr`` is evaluated by post-loop dispatch ifs — the only way a
+    return value of unknown structure can cross an XLA loop carry.
+    ``index_name``/``index_snap`` snapshot a for-loop's index into a
+    carried slot, since the post-loop index holds the range end, not the
+    fire-time value."""
+
+    __slots__ = ("ret_active", "brk", "cont", "defer", "index_name",
+                 "index_snap")
+
+    def __init__(self, ret_active, brk=None, cont=None, defer=None,
+                 index_name=None, index_snap=None):
+        self.ret_active = ret_active
+        self.brk = brk
+        self.cont = cont
+        self.defer = defer
+        self.index_name = index_name
+        self.index_snap = index_snap
+
+
+class _RenameLoad(ast.NodeTransformer):
+    def __init__(self, old, new):
+        self.old, self.new = old, new
+
+    def visit_Name(self, node):
+        if node.id == self.old and isinstance(node.ctx, ast.Load):
+            return _name(self.new)
+        return node
+
+    def visit_Lambda(self, node):
+        # a lambda capturing the index would close over the new name's
+        # outer binding anyway after regeneration; rewrite inside too
+        self.generic_visit(node)
+        return node
+
+
+class _EarlyExitRewriter:
+    """Rewrite ``return`` / ``break`` / ``continue`` inside control flow
+    into bool-flag assignments + gating, the reference's
+    return/break-continue transformer strategy
+    (python/paddle/jit/dy2static/transformers/return_transformer.py,
+    break_continue_transformer.py — unverified, mount empty), adapted to
+    the XLA lowering:
+
+    * guard-pattern returns (``if c: return a`` followed by more code)
+      are ELSE-MERGED — the remainder moves into the if's else — so the
+      dominant early-return shape lowers to a clean ``lax.cond`` with
+      matching branch structures and no flags at all;
+    * where merging can't apply (a branch only MAY return, loop bodies),
+      flags gate the remainder: ``__es_ret``/``__es_retval`` for
+      returns, per-loop ``__es_brk``/``__es_cont``. Flags initialize via
+      ``_jst.false_()`` (a jnp bool, not Python False) so an XLA loop
+      carry / cond output keeps one structure when a traced branch
+      assigns into them;
+    * while-conditions gain ``not (ret or brk) and ...``; a converted
+      for-range keeps scanning its full range with the body gated to
+      identity (correct, mildly wasteful — documented).
+
+    The rewrite output is ordinary Python with identical semantics, so
+    functions with concrete predicates behave exactly as before; the
+    main transformer then converts the generated ifs/loops like any
+    user-written ones. Functions with try/except around an exit, or
+    generators, are left untouched (unconvertible, as before).
+    """
+
+    RET, RETVAL = "__es_ret", "__es_retval"
+
+    def __init__(self):
+        self.uid = 0
+        self.changed = False
+
+    # ----------------------------------------------------- AST snippets
+    @staticmethod
+    def _assign(name, value):
+        return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+    def _set_false(self, name):
+        return self._assign(
+            name, ast.Call(func=_jst_attr("false_"), args=[], keywords=[])
+        )
+
+    def _set_true(self, name):
+        return self._assign(
+            name, ast.Call(func=_jst_attr("true_"), args=[], keywords=[])
+        )
+
+    @staticmethod
+    def _not_flags(flags):
+        test = (
+            _name(flags[0]) if len(flags) == 1
+            else ast.BoolOp(op=ast.Or(), values=[_name(f) for f in flags])
+        )
+        return ast.UnaryOp(op=ast.Not(), operand=test)
+
+    def _gate(self, flags, body):
+        return ast.If(test=self._not_flags(flags), body=body, orelse=[])
+
+    # ------------------------------------------------------- detection
+    def _exit_kinds(self, stmts, ctx):
+        """(has_ret, has_brk, has_cont) for the ORIGINAL (pre-rewrite)
+        statements, relative to the active context."""
+        has_ret = (
+            (ctx.ret_active or ctx.defer is not None)
+            and bool(_find_in_block(stmts, ast.Return))
+        )
+        has_brk = bool(
+            ctx.brk and _find_in_block(stmts, ast.Break, stop_loops=True)
+        )
+        has_cont = bool(
+            ctx.cont
+            and _find_in_block(stmts, ast.Continue, stop_loops=True)
+        )
+        return has_ret, has_brk, has_cont
+
+    def _ret_flags(self, ctx, d0):
+        """Names that signal 'a return fired' in this context: the
+        deferred site flags created since ``d0``, or the function-level
+        RET flag."""
+        if ctx.defer is not None:
+            return [f for f, _ in ctx.defer[d0:]]
+        return [self.RET] if ctx.ret_active else []
+
+    # ------------------------------------------------------ processing
+    def process_block(self, stmts, ctx):
+        out = []
+        for i, s in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if isinstance(s, ast.Return) and ctx.defer is not None:
+                # deferred: raise a site flag (plus the for-index
+                # snapshot); the post-loop dispatch evaluates the value
+                self.changed = True
+                self.uid += 1
+                flag = f"__es_lret{self.uid}"
+                expr = s.value
+                if expr is not None and ctx.index_name:
+                    expr = _RenameLoad(
+                        ctx.index_name, ctx.index_snap
+                    ).visit(expr)
+                    out.append(self._assign(
+                        ctx.index_snap,
+                        ast.Call(func=_jst_attr("index_snap"),
+                                 args=[_name(ctx.index_name)],
+                                 keywords=[]),
+                    ))
+                ctx.defer.append((flag, expr))
+                out.append(self._set_true(flag))
+                return out
+            if isinstance(s, ast.Return) and ctx.ret_active:
+                self.changed = True
+                out.append(self._assign(
+                    self.RETVAL, s.value or ast.Constant(None)
+                ))
+                out.append(self._set_true(self.RET))
+                return out  # anything after a return is dead
+            if isinstance(s, ast.Break) and ctx.brk:
+                self.changed = True
+                out.append(self._set_true(ctx.brk))
+                return out
+            if isinstance(s, ast.Continue) and ctx.cont:
+                self.changed = True
+                out.append(self._set_true(ctx.cont))
+                return out
+            if isinstance(s, ast.If):
+                has_ret, has_brk, has_cont = self._exit_kinds([s], ctx)
+                any_exit = has_ret or has_brk or has_cont
+                d0 = len(ctx.defer) if ctx.defer is not None else 0
+                body_t, else_t = _terminates(s.body), _terminates(s.orelse)
+                if any_exit and body_t and else_t:
+                    s.body = self.process_block(s.body, ctx)
+                    s.orelse = self.process_block(s.orelse, ctx)
+                    out.append(s)
+                    return out  # rest dead
+                if any_exit and body_t and rest:
+                    # else-merge: remainder becomes the else branch
+                    self.changed = True
+                    s.body = self.process_block(s.body, ctx)
+                    s.orelse = self.process_block(
+                        list(s.orelse) + rest, ctx
+                    )
+                    out.append(s)
+                    return out
+                if any_exit and else_t and s.orelse and rest:
+                    self.changed = True
+                    s.orelse = self.process_block(s.orelse, ctx)
+                    s.body = self.process_block(list(s.body) + rest, ctx)
+                    out.append(s)
+                    return out
+                # general: recurse, then gate the remainder on the flags
+                s.body = self.process_block(s.body, ctx)
+                s.orelse = self.process_block(s.orelse, ctx)
+                out.append(s)
+                flags = (
+                    (self._ret_flags(ctx, d0) if has_ret else [])
+                    + ([ctx.brk] if has_brk else [])
+                    + ([ctx.cont] if has_cont else [])
+                )
+                if flags and rest:
+                    self.changed = True
+                    out.append(self._gate(
+                        flags, self.process_block(rest, ctx)
+                    ))
+                    return out
+                continue
+            if isinstance(s, (ast.While, ast.For)):
+                processed, post = self._process_loop(s, ctx)
+                out.extend(processed)
+                if post:
+                    # post-loop dispatch returns: hand them + the rest
+                    # back to CPS (they else-merge like user returns)
+                    out.extend(self.process_block(list(post) + rest, ctx))
+                    return out
+                continue
+            if isinstance(s, ast.Match):
+                has_ret, has_brk, has_cont = self._exit_kinds([s], ctx)
+                d0 = len(ctx.defer) if ctx.defer is not None else 0
+                for c in s.cases:
+                    c.body = self.process_block(c.body, ctx)
+                out.append(s)
+                flags = (
+                    (self._ret_flags(ctx, d0) if has_ret else [])
+                    + ([ctx.brk] if has_brk else [])
+                    + ([ctx.cont] if has_cont else [])
+                )
+                if flags and rest:
+                    self.changed = True
+                    out.append(self._gate(
+                        flags, self.process_block(rest, ctx)
+                    ))
+                    return out
+                continue
+            if isinstance(s, ast.With):
+                has_ret, has_brk, has_cont = self._exit_kinds(
+                    s.body, ctx
+                )
+                d0 = len(ctx.defer) if ctx.defer is not None else 0
+                s.body = self.process_block(s.body, ctx)
+                out.append(s)
+                flags = (
+                    (self._ret_flags(ctx, d0) if has_ret else [])
+                    + ([ctx.brk] if has_brk else [])
+                    + ([ctx.cont] if has_cont else [])
+                )
+                if flags and rest:
+                    self.changed = True
+                    out.append(self._gate(
+                        flags, self.process_block(rest, ctx)
+                    ))
+                    return out
+                continue
+            out.append(s)
+        return out
+
+    @staticmethod
+    def _is_range_for(loop):
+        return (
+            isinstance(loop, ast.For)
+            and isinstance(loop.target, ast.Name)
+            and isinstance(loop.iter, ast.Call)
+            and isinstance(loop.iter.func, ast.Name)
+            and loop.iter.func.id == "range"
+            and not loop.iter.keywords
+            and 1 <= len(loop.iter.args) <= 3
+            and not any(
+                isinstance(a, ast.Starred) for a in loop.iter.args
+            )
+        )
+
+    def _process_loop(self, loop, ctx):
+        """Returns (statements-to-emit, post-dispatch-stmts). The post
+        list holds UNPROCESSED ``if <site-flag>: return <expr>`` nodes
+        for the caller's CPS to fold into the remainder."""
+        if (
+            not (isinstance(loop, ast.While) or self._is_range_for(loop))
+            or loop.orelse
+        ):
+            # non-range iterable or loop-else clause: the flag rewrite
+            # would change semantics (a gated-to-identity `for` still
+            # drains its iterator; a flag-exited while always runs its
+            # else) — leave this loop's own exits as real Python
+            # statements and only recurse for nested structures
+            neutral = _ExitCtx(False)
+            loop.body = self.process_block(loop.body, neutral)
+            loop.orelse = self.process_block(loop.orelse, neutral)
+            return [loop], []
+        defer_ret = (
+            (ctx.ret_active or ctx.defer is not None)
+            and bool(_find_in_block(loop.body, ast.Return))
+        )
+        has_brk = bool(
+            _find_in_block(loop.body, ast.Break, stop_loops=True)
+        )
+        has_cont = bool(
+            _find_in_block(loop.body, ast.Continue, stop_loops=True)
+        )
+        pre = []
+        brk = cont = snap = None
+        sites = []
+        if has_brk:
+            self.uid += 1
+            brk = f"__es_brk{self.uid}"
+            pre.append(self._set_false(brk))
+            self.changed = True
+        if has_cont:
+            self.uid += 1
+            cont = f"__es_cont{self.uid}"
+            self.changed = True
+        index_name = None
+        if defer_ret and isinstance(loop, ast.For) and isinstance(
+            loop.target, ast.Name
+        ):
+            self.uid += 1
+            snap = f"__es_i{self.uid}"
+            index_name = loop.target.id
+            pre.append(self._assign(
+                snap,
+                ast.Call(func=_jst_attr("int0_"), args=[], keywords=[]),
+            ))
+        inner = _ExitCtx(
+            ctx.ret_active, brk=brk, cont=cont,
+            defer=sites if defer_ret else None,
+            index_name=index_name, index_snap=snap,
+        )
+        new_body = self.process_block(loop.body, inner)
+        for flag, _ in sites:
+            pre.append(self._set_false(flag))
+        if has_cont:
+            # continue-flag resets at the top of every iteration
+            new_body = [self._set_false(cont)] + new_body
+        exit_flags = [f for f, _ in sites] + ([brk] if brk else [])
+        if isinstance(loop, ast.While):
+            if exit_flags:
+                loop.test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[self._not_flags(exit_flags), loop.test],
+                )
+            loop.body = new_body
+        else:  # For: the converted range-scan runs all iterations; the
+            #   body is gated to identity once an exit flag fires
+            loop.body = (
+                [self._gate(exit_flags, new_body)] if exit_flags
+                else new_body
+            )
+        post = [
+            ast.If(
+                test=_name(flag),
+                body=[ast.Return(value=expr or ast.Constant(None))],
+                orelse=[],
+            )
+            for flag, expr in sites
+        ]
+        return pre + [loop], post
+
+    # ----------------------------------------------------------- entry
+    def rewrite(self, fdef):
+        """Rewrite fdef.body in place. Returns True if anything changed."""
+        body = fdef.body
+        if _find_in_block(body, (ast.Yield, ast.YieldFrom)):
+            return False  # generators stay unconvertible
+        for t in _find_in_block(body, ast.Try):
+            inner = (
+                t.body
+                + [s for h in t.handlers for s in h.body]
+                + t.orelse
+                + t.finalbody
+            )
+            if _find_in_block(inner, (ast.Return, ast.Break, ast.Continue)):
+                return False  # exit through try/except: leave untouched
+        all_rets = _find_in_block(body, ast.Return)
+        top_rets = [s for s in body if isinstance(s, ast.Return)]
+        nested_ret = len(all_rets) > len(top_rets)
+        loops_active = any(
+            _find_in_block(l.body, (ast.Break, ast.Continue),
+                           stop_loops=True)
+            for l in _find_in_block(body, (ast.While, ast.For))
+        )
+        if not nested_ret and not loops_active:
+            return False
+        ctx = _ExitCtx(ret_active=nested_ret)
+        new_body = self.process_block(body, ctx)
+        if nested_ret:
+            prologue = [
+                self._set_false(self.RET),
+                self._assign(self.RETVAL, ast.Constant(None)),
+            ]
+            if not _terminates(new_body):
+                new_body = new_body + [
+                    ast.Return(value=_name(self.RETVAL))
+                ]
+            new_body = prologue + new_body
+        fdef.body = new_body
+        return self.changed
+
+
 # ------------------------------------------------------------- AST building
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
@@ -249,7 +700,32 @@ class _SuperRewriter(ast.NodeTransformer):
         return node
 
 
-class _ControlFlowTransformer(ast.NodeTransformer):
+def _loads(stmts):
+    """Conservative liveness: every name that COULD be read by these
+    statements (plain loads, aug-assign reads, global/nonlocal, loads
+    inside nested scopes — closures count)."""
+    names = set()
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                names.add(n.id)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                names.add(n.target.id)
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                names.update(n.names)
+    return names
+
+
+class _ControlFlowTransformer:
+    """Statement-list walker (NOT an ast.NodeTransformer): conversion of
+    an ``if`` needs to know which of its assigned names are still live
+    AFTER it — dead names are not threaded out of the generated branch
+    functions, so a name bound on only one path (the early-exit
+    rewriter's else-merge produces these constantly) doesn't force a
+    cond structure mismatch when nothing ever reads it again."""
+
     def __init__(self):
         self.counter = 0
         self.changed = False
@@ -258,34 +734,76 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.counter += 1
         return self.counter
 
-    # Nested def/lambda/class keep their own (untransformed) scope: the
-    # conversion targets the decorated function's body only, like the
-    # reference's per-function transform entry.
-    def visit_FunctionDef(self, node):
-        return node
+    # ------------------------------------------------------ block walk
+    def process_stmts(self, stmts, live):
+        """Transform a statement list; ``live`` is the set of names that
+        may be read after this list ends (enclosing-scope liveness)."""
+        out = []
+        for i, s in enumerate(stmts):
+            live_i = _loads(stmts[i + 1:]) | live
+            out.extend(self._process_stmt(s, live_i))
+        return out
 
-    visit_AsyncFunctionDef = visit_FunctionDef
-    visit_Lambda = visit_FunctionDef
-    visit_ClassDef = visit_FunctionDef
+    def _process_stmt(self, s, live):
+        # Nested def/lambda/class keep their own (untransformed) scope:
+        # the conversion targets the decorated function's body only,
+        # like the reference's per-function transform entry.
+        if isinstance(s, ast.If):
+            return self._convert_if(s, live)
+        if isinstance(s, ast.While):
+            return self._convert_while(s, live)
+        if isinstance(s, ast.For):
+            return self._convert_for(s, live)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            s.body = self.process_stmts(s.body, live)
+            return [s]
+        if isinstance(s, ast.Match):
+            for c in s.cases:
+                c.body = self.process_stmts(c.body, live)
+            return [s]
+        if isinstance(s, ast.AsyncFor):
+            s.body = self.process_stmts(
+                s.body, live | _loads([s]) | _assigned_names(s.body)
+            )
+            s.orelse = self.process_stmts(s.orelse, live)
+            return [s]
+        if isinstance(s, ast.Try):
+            ctx = live | _loads(
+                [x for h in s.handlers for x in h.body]
+                + s.orelse + s.finalbody
+            )
+            s.body = self.process_stmts(s.body, ctx)
+            for h in s.handlers:
+                h.body = self.process_stmts(h.body, live)
+            s.orelse = self.process_stmts(s.orelse, live)
+            s.finalbody = self.process_stmts(s.finalbody, live)
+            return [s]
+        return [s]
 
-    def visit_If(self, node):
-        self.generic_visit(node)
+    # ------------------------------------------------------ conversions
+    def _convert_if(self, node, live):
+        node.body = self.process_stmts(node.body, live)
+        node.orelse = self.process_stmts(node.orelse, live)
         if _has_escaping_ctrl(node.body) or _has_escaping_ctrl(node.orelse):
-            return node
+            return [node]
         assigned = sorted(
             n
             for n in _assigned_names(node.body) | _assigned_names(node.orelse)
             if not n.startswith("__dy2st_")  # inner conversions' machinery
         )
-        if not assigned:
-            return node  # side-effect-only if: leave as Python
+        # thread OUT only names still live after the if: a name bound on
+        # one path and never read again must not constrain the cond's
+        # output structure (else-merged early returns rely on this)
+        result = [n for n in assigned if n in live]
+        if not result:
+            return [node]  # side-effect-only / dead-out if: leave as Python
         uid = self._uid()
         self.changed = True
         true_name, false_name = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
         out_name = f"__dy2st_out_{uid}"
-        true_fn = _make_branch_fn(true_name, assigned, node.body, assigned)
+        true_fn = _make_branch_fn(true_name, assigned, node.body, result)
         false_fn = _make_branch_fn(
-            false_name, assigned, node.orelse or [ast.Pass()], assigned
+            false_name, assigned, node.orelse or [ast.Pass()], result
         )
         call = ast.Assign(
             targets=[_name(out_name, ast.Store())],
@@ -298,22 +816,26 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                         elts=[_capture_call(n) for n in assigned],
                         ctx=ast.Load(),
                     ),
-                    ast.Constant(tuple(assigned)),
+                    ast.Constant(tuple(result)),
                 ],
                 keywords=[],
             ),
         )
         unpack = ast.Assign(
             targets=[ast.Tuple(
-                elts=[_name(n, ast.Store()) for n in assigned],
+                elts=[_name(n, ast.Store()) for n in result],
                 ctx=ast.Store(),
             )],
             value=_name(out_name),
         )
         return [true_fn, false_fn, call, unpack]
 
-    def visit_For(self, node):
-        self.generic_visit(node)
+    def _convert_for(self, node, live):
+        # loop-carried names are live at the end of the body (the next
+        # iteration reads them), as are the loop's own test/iter loads
+        body_live = live | _loads([node]) | _assigned_names(node.body)
+        node.body = self.process_stmts(node.body, body_live)
+        node.orelse = self.process_stmts(node.orelse, live)
         # only `for <name> in range(...)` without else/ctrl-flow converts;
         # other iterables stay Python (eager semantics / unrolled in trace)
         if (
@@ -327,19 +849,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             or not (1 <= len(node.iter.args) <= 3)
             or any(isinstance(a, ast.Starred) for a in node.iter.args)
         ):
-            return node
+            return [node]
         loop_name = node.target.id
         body_assigned = _assigned_names(node.body)
         if loop_name in body_assigned:
             # the body rebinds the loop variable: Python's post-loop
             # binding would be the body's value, which the conversion
             # cannot reproduce — leave as plain Python
-            return node
+            return [node]
         assigned = sorted(
             n for n in body_assigned if not n.startswith("__dy2st_")
         )
         if not assigned:
-            return node
+            return [node]
         uid = self._uid()
         self.changed = True
         body_name = f"__dy2st_forbody_{uid}"
@@ -375,16 +897,18 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         return [body_fn, call, unpack]
 
-    def visit_While(self, node):
-        self.generic_visit(node)
+    def _convert_while(self, node, live):
+        body_live = live | _loads([node]) | _assigned_names(node.body)
+        node.body = self.process_stmts(node.body, body_live)
+        node.orelse = self.process_stmts(node.orelse, live)
         if node.orelse or _has_escaping_ctrl(node.body):
-            return node
+            return [node]
         assigned = sorted(
             n for n in _assigned_names(node.body)
             if not n.startswith("__dy2st_")
         )
         if not assigned:
-            return node
+            return [node]
         uid = self._uid()
         self.changed = True
         cond_name, body_name = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
@@ -449,17 +973,24 @@ def convert_to_static(fn):
         return fn if bound_self is None else types.MethodType(fn, bound_self)
     fdef.decorator_list = []  # avoid re-running to_static/wrappers
 
+    # record pre-transform facts for the conversion-time warnings below
+    # (after transformation the tree contains generated __dy2st_* defs)
+    user_nested_defs = [
+        n.name if hasattr(n, "name") else "<lambda>"
+        for n in _find_in_block(
+            fdef.body, (ast.FunctionDef, ast.AsyncFunctionDef)
+        )
+    ] + (["<lambda>"] if _find_in_block(fdef.body, ast.Lambda) else [])
+
+    # early-exit pre-pass: return/break/continue -> else-merging +
+    # flag-gating, so the control-flow conversion below sees none of them
+    _EarlyExitRewriter().rewrite(fdef)
+
     tr = _ControlFlowTransformer()
-    # visit the body statements (visit(fdef) itself would skip: nested
-    # FunctionDefs are deliberately opaque to the transformer)
-    new_body = []
-    for stmt in fdef.body:
-        res = tr.visit(stmt)
-        if isinstance(res, list):
-            new_body.extend(res)
-        elif res is not None:
-            new_body.append(res)
-    fdef.body = new_body
+    # block-walk the body (nested FunctionDefs stay opaque; liveness at
+    # function end is empty — only the return statement's loads matter,
+    # and those are inside the body list itself)
+    fdef.body = tr.process_stmts(fdef.body, set())
     if not tr.changed:
         return fn if bound_self is None else types.MethodType(fn, bound_self)
 
@@ -482,7 +1013,28 @@ def convert_to_static(fn):
     # snapshot closure cells: the regenerated code has no free variables.
     # NOTE: a snapshot — names rebound in the enclosing scope after
     # conversion keep their conversion-time values (documented limit).
+    # Both limits warn at conversion time: silent wrong-capture is worse
+    # than a noisy-but-actionable message.
+    if user_nested_defs:
+        warnings.warn(
+            f"to_static: {fn.__qualname__} contains nested function(s) "
+            f"{sorted(set(user_nested_defs))}; their bodies are NOT "
+            "transformed — tensor-dependent if/while/for inside them "
+            "will not convert (move such control flow into the "
+            "decorated function, or decorate the nested function too)"
+        )
     if fn.__closure__:
+        snap_names = [
+            n for n in fn.__code__.co_freevars if n != "__class__"
+        ]
+        if snap_names:
+            warnings.warn(
+                f"to_static: {fn.__qualname__} closes over "
+                f"{snap_names}; these are SNAPSHOTTED at conversion "
+                "time — rebinding them in the enclosing scope later "
+                "will not be seen by the converted function (pass them "
+                "as arguments for live values)"
+            )
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
             try:
                 globs[name] = cell.cell_contents
