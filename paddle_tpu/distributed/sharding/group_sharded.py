@@ -102,6 +102,16 @@ def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
     Returns (model, optimizer, scaler) like the reference. The same
     imperative objects are returned — sharding is carried by array
     placements and consumed by CompiledTrainStep/eager ops alike.
+
+    Stage-2 semantics on TPU (an intentional divergence from the
+    reference's eager reducer): gradient sharding is a COMPILED-path
+    property. Inside ``CompiledTrainStep`` the installed
+    ``_grad_placements`` constrain each grad to its owner shard and XLA
+    realizes the reduce-scatter + sharded-update pattern; on the eager
+    path gradients stay replicated as produced — eager ZeRO-2 gives no
+    memory win here (use the compiled trainer, which is the TPU perf
+    path anyway). Stage-1 (optimizer state) and stage-3 (parameter)
+    placements apply on both paths.
     """
     if level not in _LEVELS:
         raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")

@@ -109,8 +109,23 @@ def int0_():
 def index_snap(i):
     """Snapshot a loop index into a carried slot at a deferred-return
     site. Always an int32 jnp scalar, so unrolled (python-int index) and
-    scanned (traced index) loops produce one carry structure."""
+    scanned (traced index) loops produce one carry structure. (int32:
+    matches convert_for_range's counter; ranges past 2**31 would
+    truncate — far beyond any unrollable/scannable loop.)"""
     return jnp.asarray(_raw(i)).astype(jnp.int32)
+
+
+def index_unsnap(v):
+    """Inverse of index_snap for the concrete path: a non-traced scalar
+    goes back to a Python int so deferred `return i` keeps plain-Python
+    types; tracers pass through untouched."""
+    raw = _raw(v)
+    if isinstance(raw, jax.core.Tracer):
+        return v
+    try:
+        return int(raw)
+    except TypeError:  # pragma: no cover - non-scalar snapshots
+        return v
 
 
 def _raw(x):

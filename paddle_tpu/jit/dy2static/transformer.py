@@ -227,6 +227,10 @@ class _ExitCtx:
 
 
 class _RenameLoad(ast.NodeTransformer):
+    """Rename loads of ``old`` to ``new`` — but NOT inside scopes that
+    rebind ``old`` (lambda params, comprehension targets, nested defs),
+    where the inner binding shadows the loop index."""
+
     def __init__(self, old, new):
         self.old, self.new = old, new
 
@@ -236,10 +240,37 @@ class _RenameLoad(ast.NodeTransformer):
         return node
 
     def visit_Lambda(self, node):
-        # a lambda capturing the index would close over the new name's
-        # outer binding anyway after regeneration; rewrite inside too
+        a = node.args
+        params = (
+            [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+            + ([a.vararg.arg] if a.vararg else [])
+            + ([a.kwarg.arg] if a.kwarg else [])
+        )
+        if self.old in params:
+            return node  # shadowed: leave the lambda body alone
         self.generic_visit(node)
         return node
+
+    def visit_FunctionDef(self, node):
+        return node  # own scope; loads there resolve at call time
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _comp(self, node):
+        bound = set()
+        for gen in node.generators:
+            for n in ast.walk(gen.target):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+        if self.old in bound:
+            return node  # comprehension rebinds the index: shadowed
+        self.generic_visit(node)
+        return node
+
+    visit_ListComp = _comp
+    visit_SetComp = _comp
+    visit_DictComp = _comp
+    visit_GeneratorExp = _comp
 
 
 class _EarlyExitRewriter:
@@ -436,6 +467,17 @@ class _EarlyExitRewriter:
                     ))
                     return out
                 continue
+            if isinstance(s, ast.Try):
+                # the rewrite() guard guarantees no exit escapes a try;
+                # loops wholly inside still get their own treatment
+                neutral = _ExitCtx(False)
+                s.body = self.process_block(s.body, neutral)
+                for h in s.handlers:
+                    h.body = self.process_block(h.body, neutral)
+                s.orelse = self.process_block(s.orelse, neutral)
+                s.finalbody = self.process_block(s.finalbody, neutral)
+                out.append(s)
+                continue
             if isinstance(s, ast.With):
                 has_ret, has_brk, has_cont = self._exit_kinds(
                     s.body, ctx
@@ -511,6 +553,10 @@ class _EarlyExitRewriter:
         if has_cont:
             self.uid += 1
             cont = f"__es_cont{self.uid}"
+            # pre-loop init as well as the per-iteration reset below: an
+            # XLA loop carry needs the flag bound (same structure) BEFORE
+            # the first iteration
+            pre.append(self._set_false(cont))
             self.changed = True
         index_name = None
         if defer_ret and isinstance(loop, ast.For) and isinstance(
@@ -548,7 +594,18 @@ class _EarlyExitRewriter:
                 [self._gate(exit_flags, new_body)] if exit_flags
                 else new_body
             )
-        post = [
+        post = []
+        if snap is not None and sites:
+            # restore a concrete snapshot to a Python int before the
+            # dispatch evaluates the deferred expression (the carried
+            # slot is a jnp scalar; plain-Python semantics promise an
+            # int return on the concrete path). Tracers pass through.
+            post.append(self._assign(
+                snap,
+                ast.Call(func=_jst_attr("index_unsnap"),
+                         args=[_name(snap)], keywords=[]),
+            ))
+        post += [
             ast.If(
                 test=_name(flag),
                 body=[ast.Return(value=expr or ast.Constant(None))],
@@ -571,7 +628,12 @@ class _EarlyExitRewriter:
                 + t.orelse
                 + t.finalbody
             )
-            if _find_in_block(inner, (ast.Return, ast.Break, ast.Continue)):
+            # only exits that ESCAPE the try disable the rewrite: any
+            # return, or a break/continue not consumed by a loop inside
+            # the try (stop_loops skips loop-internal ones)
+            if _find_in_block(inner, ast.Return) or _find_in_block(
+                inner, (ast.Break, ast.Continue), stop_loops=True
+            ):
                 return False  # exit through try/except: leave untouched
         all_rets = _find_in_block(body, ast.Return)
         top_rets = [s for s in body if isinstance(s, ast.Return)]
@@ -737,11 +799,18 @@ class _ControlFlowTransformer:
     # ------------------------------------------------------ block walk
     def process_stmts(self, stmts, live):
         """Transform a statement list; ``live`` is the set of names that
-        may be read after this list ends (enclosing-scope liveness)."""
+        may be read after this list ends (enclosing-scope liveness).
+        Suffix-load sets are accumulated in one reverse pass (O(nodes),
+        not O(n^2) re-walks of the tail per statement)."""
+        n = len(stmts)
+        sufs = [None] * n
+        acc = set(live)
+        for i in range(n - 1, -1, -1):
+            sufs[i] = acc
+            acc = acc | _loads([stmts[i]])
         out = []
         for i, s in enumerate(stmts):
-            live_i = _loads(stmts[i + 1:]) | live
-            out.extend(self._process_stmt(s, live_i))
+            out.extend(self._process_stmt(s, sufs[i]))
         return out
 
     def _process_stmt(self, s, live):

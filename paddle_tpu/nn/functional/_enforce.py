@@ -1,0 +1,165 @@
+"""Enforce coverage for the nn.functional surface.
+
+Reference parity: every phi kernel is wrapped in PADDLE_ENFORCE_*
+precondition checks (paddle/phi/core/enforce.h — unverified, mount
+empty); the Python layer mirrors them via check_variable_and_dtype in
+data_feeder.py. Reproducing that breadth one hand-written check at a
+time does not scale, so this module is a declarative table: each entry
+names an op, the argument positions to validate, the dtype class, and
+the ndim contract. ``install`` wraps the already-imported functions in
+the package namespace — internal modules that import from the
+submodules directly skip the wrapper (no double-checking on internal
+call chains); the public ``paddle.nn.functional`` surface gets it.
+
+Checks run per call on the eager path and once per trace under jit;
+they exist for message quality — XLA remains the correctness backstop.
+"""
+from __future__ import annotations
+
+import functools
+
+from ...core.enforce import check_dtype, check_int_dtype, check_ndim
+
+_MISSING = object()
+
+# (arg_index, arg_name, dtype_kind, ndim_spec)
+#   dtype_kind: "float" | "int" | None
+#   ndim_spec:  None | int (min_ndim) | ("exact", n_or_tuple)
+_X_FLOAT = [(0, "x", "float", None)]
+
+
+def _conv(n):
+    return [(0, "x", "float", ("exact", n)),
+            (1, "weight", "float", ("exact", n))]
+
+
+def _pool(n):
+    return [(0, "x", "float", ("exact", n))]
+
+
+TABLE = {
+    # --------------------------------------------------- activations
+    **{name: _X_FLOAT for name in (
+        "celu", "elu", "gelu", "hardshrink", "hardsigmoid", "hardswish",
+        "hardtanh", "leaky_relu", "log_sigmoid", "log_softmax", "mish",
+        "relu", "relu6", "rrelu", "selu", "sigmoid", "silu", "softmax",
+        "softplus", "softshrink", "softsign", "swish", "tanh",
+        "tanhshrink", "thresholded_relu",
+    )},
+    "glu": [(0, "x", "float", 1)],
+    "maxout": [(0, "x", "float", ("exact", 4))],
+    "prelu": [(0, "x", "float", None), (1, "weight", "float", None)],
+    "gumbel_softmax": [(0, "x", "float", 1)],
+    # -------------------------------------------------------- common
+    "linear": [(0, "x", "float", 1), (1, "weight", "float", ("exact", 2))],
+    "bilinear": [(0, "x1", "float", ("exact", 2)),
+                 (1, "x2", "float", ("exact", 2))],
+    "cosine_similarity": [(0, "x1", "float", 1), (1, "x2", "float", 1)],
+    "dropout": _X_FLOAT,
+    "dropout2d": [(0, "x", "float", ("exact", 4))],
+    "dropout3d": [(0, "x", "float", ("exact", 5))],
+    "alpha_dropout": _X_FLOAT,
+    "pad": [(0, "x", None, 1)],
+    "interpolate": [(0, "x", "float", 3)],
+    "upsample": [(0, "x", "float", 3)],
+    "fold": [(0, "x", "float", ("exact", 3))],
+    "unfold": [(0, "x", "float", ("exact", 4))],
+    "pixel_shuffle": [(0, "x", "float", ("exact", 4))],
+    "pixel_unshuffle": [(0, "x", "float", ("exact", 4))],
+    "channel_shuffle": [(0, "x", "float", ("exact", 4))],
+    "zeropad2d": [(0, "x", None, ("exact", 4))],
+    "label_smooth": [(0, "label", "float", 1)],
+    # --------------------------------------------------- conv / pool
+    "conv1d": _conv(3),
+    "conv2d": _conv(4),
+    "conv3d": _conv(5),
+    "conv1d_transpose": _conv(3),
+    "conv2d_transpose": _conv(4),
+    "conv3d_transpose": _conv(5),
+    "avg_pool1d": _pool(3),
+    "avg_pool2d": _pool(4),
+    "avg_pool3d": _pool(5),
+    "max_pool1d": _pool(3),
+    "max_pool2d": _pool(4),
+    "max_pool3d": _pool(5),
+    "adaptive_avg_pool1d": _pool(3),
+    "adaptive_avg_pool2d": _pool(4),
+    "adaptive_avg_pool3d": _pool(5),
+    "adaptive_max_pool1d": _pool(3),
+    "adaptive_max_pool2d": _pool(4),
+    "adaptive_max_pool3d": _pool(5),
+    # ---------------------------------------------------------- norm
+    "batch_norm": [(0, "x", "float", 2)],
+    "layer_norm": [(0, "x", "float", 1)],
+    "instance_norm": [(0, "x", "float", 3)],
+    "group_norm": [(0, "x", "float", 2)],
+    "local_response_norm": [(0, "x", "float", 3)],
+    "normalize": [(0, "x", "float", 1)],
+    "rms_norm": [(0, "x", "float", 1)],
+    # ---------------------------------------------------------- loss
+    "cross_entropy": [(0, "input", "float", 1)],
+    "mse_loss": [(0, "input", "float", None), (1, "label", "float", None)],
+    "l1_loss": [(0, "input", "float", None), (1, "label", "float", None)],
+    "smooth_l1_loss": [(0, "input", "float", None),
+                       (1, "label", "float", None)],
+    "kl_div": [(0, "input", "float", None), (1, "label", "float", None)],
+    "nll_loss": [(0, "input", "float", 2), (1, "label", "int", 1)],
+    "binary_cross_entropy": [(0, "input", "float", None),
+                             (1, "label", "float", None)],
+    "binary_cross_entropy_with_logits": [
+        (0, "logit", "float", None), (1, "label", "float", None)],
+    "margin_ranking_loss": [(0, "input", "float", None),
+                            (1, "other", "float", None)],
+    "hinge_embedding_loss": [(0, "input", "float", None)],
+    "triplet_margin_loss": [(0, "input", "float", 1)],
+    "cosine_embedding_loss": [(0, "input1", "float", 1),
+                              (1, "input2", "float", 1)],
+    # --------------------------------------------------------- input
+    "embedding": [(0, "x", "int", None),
+                  (1, "weight", "float", ("exact", 2))],
+    "one_hot": [(0, "x", "int", None)],
+    # ----------------------------------------------------- attention
+    "scaled_dot_product_attention": [
+        (0, "query", "float", ("exact", 4)),
+        (1, "key", "float", ("exact", 4)),
+        (2, "value", "float", ("exact", 4)),
+    ],
+}
+
+
+def _wrap(fn, op, checks):
+    @functools.wraps(fn)
+    def inner(*args, **kwargs):
+        for idx, name, kind, nd in checks:
+            v = args[idx] if idx < len(args) else kwargs.get(name, _MISSING)
+            if v is _MISSING or v is None or isinstance(
+                v, (int, float, bool)
+            ):
+                continue  # scalars broadcast; absent args -> fn's error
+            if kind == "float":
+                check_dtype(op, name, v)
+            elif kind == "int":
+                check_int_dtype(op, name, v)
+            if isinstance(nd, int):
+                check_ndim(op, name, v, min_ndim=nd)
+            elif isinstance(nd, tuple):
+                check_ndim(op, name, v, exact_ndim=nd[1])
+        return fn(*args, **kwargs)
+
+    inner.__enforced__ = True
+    return inner
+
+
+def install(namespace):
+    """Wrap every TABLE entry present in ``namespace`` (the package's
+    globals()). Missing names are an error — the table must not drift
+    from the surface it claims to cover."""
+    missing = [k for k in TABLE if k not in namespace]
+    if missing:
+        raise RuntimeError(
+            f"enforce table names absent from nn.functional: {missing}"
+        )
+    for op, checks in TABLE.items():
+        fn = namespace[op]
+        if not getattr(fn, "__enforced__", False):
+            namespace[op] = _wrap(fn, op, checks)

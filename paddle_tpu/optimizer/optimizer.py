@@ -95,13 +95,45 @@ def _rmsprop_update(p, ms, mom, g, lr, rho, eps, momentum, centered, mg):
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
+        from ..core.enforce import enforce
+
+        op = type(self).__name__
         if parameters is None:
             raise ValueError(
                 "parameters must be provided (dygraph mode requires an "
                 "explicit parameter list, paddle parity)"
             )
+        if isinstance(learning_rate, (int, float)):
+            enforce(
+                learning_rate >= 0, op,
+                "learning_rate expected >= 0, but received {}",
+                learning_rate,
+            )
+        else:
+            enforce(
+                hasattr(learning_rate, "last_lr")
+                or hasattr(learning_rate, "get_lr"), op,
+                "learning_rate expected a float or an LRScheduler, but "
+                "received {}", type(learning_rate).__name__,
+            )
+        if weight_decay is not None and isinstance(
+            weight_decay, (int, float)
+        ):
+            enforce(
+                weight_decay >= 0, op,
+                "weight_decay expected >= 0, but received {}",
+                weight_decay,
+            )
         self._lr = learning_rate
         self._param_groups = self._build_groups(parameters)
+        from ..core.tensor import Tensor
+
+        for g, p in self._all_params():
+            enforce(
+                isinstance(p, Tensor), op,
+                "parameters expected Tensors, but received {}",
+                type(p).__name__,
+            )
         self._grad_clip = grad_clip
         self._weight_decay = weight_decay
         self._accumulators: dict = {}

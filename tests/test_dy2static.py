@@ -955,3 +955,76 @@ def test_tensor_if_inside_match_converts():
     a = np.ones((2, 2), np.float32)
     np.testing.assert_allclose(np.asarray(sf(T(a)).numpy()), a * 2)
     np.testing.assert_allclose(np.asarray(sf(T(-a)).numpy()), a * 2)
+
+
+def test_continue_in_tensor_condition_while():
+    # the cont flag must be pre-initialized before the loop (XLA carry
+    # structure is fixed from iteration 0)
+    def f(x):
+        while x.sum() < 20.0:
+            x = x + 1.0
+            if x.max() > 3.0:
+                continue
+            x = x * 1.1
+        return x
+
+    sf = paddle.jit.to_static(f)
+    a = np.ones(2, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sf(T(a)).numpy()), np.asarray(f(T(a)).numpy()),
+        rtol=1e-6,
+    )
+
+
+def test_deferred_return_index_not_shadow_renamed():
+    # comprehension/lambda bindings of the index name shadow it: the
+    # snapshot rename must not reach inside them
+    def f(x):
+        for i in range(3):
+            if x.sum() > 0.0:
+                return float(sum([i for i in [10, 20]]))
+        return -1.0
+
+    conv = convert_to_static(f)
+    assert conv(T(np.ones(2, np.float32))) == 30.0
+
+    def g(x):
+        for i in range(3):
+            if x.sum() > 0.0:
+                return [j * 2 for j in map(lambda i: i + 1, [1, 2])]
+        return []
+
+    conv_g = convert_to_static(g)
+    assert conv_g(T(np.ones(2, np.float32))) == [4, 6]
+
+
+def test_deferred_return_index_keeps_python_int():
+    # plain-Python (concrete) path: `return i` must stay an int
+    def f(xs):
+        for i in range(len(xs)):
+            if xs[i] > 5:
+                return i
+        return -1
+
+    conv = convert_to_static(f)
+    r = conv([1, 9, 3])
+    assert r == 1 and type(r) is int
+
+
+def test_break_inside_try_does_not_disable_rewrite():
+    # a break consumed by a loop wholly inside a try does not escape it;
+    # the function's OTHER early returns must still convert
+    def f(x):
+        try:
+            for i in range(3):
+                break
+        except ValueError:
+            pass
+        if x.sum() > 0.0:
+            return x * 2.0
+        return -x
+
+    sf = paddle.jit.to_static(f)
+    a = np.ones(2, np.float32)
+    np.testing.assert_allclose(np.asarray(sf(T(a)).numpy()), a * 2.0)
+    np.testing.assert_allclose(np.asarray(sf(T(-a)).numpy()), a)

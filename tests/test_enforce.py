@@ -117,3 +117,68 @@ def test_reshape_element_mismatch():
 def test_enforce_is_value_error():
     # existing handlers catching ValueError keep working
     assert issubclass(EnforceError, ValueError)
+
+
+# ------------------------------------------------ round-5 breadth sweep
+# every TABLE op must reject a wrong-dtype and/or wrong-ndim input with
+# the (InvalidArgument) message naming the op and argument
+from paddle_tpu.nn.functional._enforce import TABLE
+
+
+def _bad_value(kind, nd_spec):
+    """An input that violates the op's FIRST declared check."""
+    if kind == "float":
+        return Tensor(jnp.asarray(np.ones((2, 2), np.int32)))
+    if kind == "int":
+        return Tensor(jnp.asarray(np.ones((2, 2), np.float32)))
+    # dtype-agnostic: violate ndim with a 0-d tensor
+    return Tensor(jnp.asarray(np.float32(1.0)))
+
+
+@pytest.mark.parametrize("op", sorted(TABLE))
+def test_enforce_sweep(op):
+    fn = getattr(F, op)
+    checks = TABLE[op]
+    idx, name, kind, nd = checks[0]
+    bad = _bad_value(kind, nd)
+    # wrong dtype (or wrong ndim for dtype-agnostic ops) in position 0;
+    # fill later declared positions with the same bad value — the first
+    # failing check wins and must carry the op + arg name
+    args = [bad] * (max(c[0] for c in checks) + 1)
+    with pytest.raises(ValueError) as ei:
+        fn(*args)
+    msg = str(ei.value)
+    assert "(InvalidArgument)" in msg, (op, msg)
+    assert op in msg, (op, msg)
+
+
+def test_enforce_sweep_covers_fifty_ops():
+    assert len(TABLE) >= 50, len(TABLE)
+
+
+def test_optimizer_entry_enforce():
+    lin = paddle.nn.Linear(2, 2)
+    _raises(
+        lambda: paddle.optimizer.Adam(
+            learning_rate=-1.0, parameters=lin.parameters()
+        ),
+        "Adam", "learning_rate",
+    )
+    _raises(
+        lambda: paddle.optimizer.SGD(
+            learning_rate="fast", parameters=lin.parameters()
+        ),
+        "SGD", "LRScheduler",
+    )
+    _raises(
+        lambda: paddle.optimizer.AdamW(
+            parameters=[1, 2, 3]
+        ),
+        "AdamW", "Tensor",
+    )
+    _raises(
+        lambda: paddle.optimizer.Adam(
+            weight_decay=-0.1, parameters=lin.parameters()
+        ),
+        "Adam", "weight_decay",
+    )

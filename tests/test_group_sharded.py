@@ -188,3 +188,32 @@ class TestGroupSharded:
         step._finalize_jit(params, opt_state, {})
         # single-device placements are not "explicit" -> base step, unpinned
         assert step._step_fn.__wrapped__ is step._step
+
+
+def test_stage2_eager_grads_stay_replicated_documented(hcg):
+    """Stage-2 grad sharding is a compiled-path property by design: the
+    eager path keeps grads replicated as produced (documented in the
+    group_sharded_parallel docstring). This pins the expectation so a
+    future change is deliberate, not accidental."""
+    net = _net()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    net, opt, _ = group_sharded_parallel(net, opt, "os_g")
+    rng = np.random.RandomState(1)
+    x = Tensor(jnp.asarray(rng.randn(B, IN).astype(np.float32)))
+    y = Tensor(jnp.asarray(rng.randn(B, OUT).astype(np.float32)))
+    loss = nn.MSELoss()(net(x), y)
+    loss.backward()
+    p0 = dict(net.named_parameters())["0.weight"]
+    g = p0.grad
+    assert g is not None
+    # eager grad: full (replicated) shape on the local shard — NOT the
+    # 1/DEGREE shard the compiled path constrains to
+    assert g.value.addressable_shards[0].data.shape == (IN, HID)
+    # while the policy the compiled path consumes IS installed and names
+    # the sharding axis
+    spec = str(opt._grad_placements["0.weight"].spec)
+    assert "sharding" in spec
+    opt.clear_grad()
+    # and the docstring actually states the divergence
+    assert "eager" in group_sharded_parallel.__doc__
+    assert "COMPILED-path" in group_sharded_parallel.__doc__
