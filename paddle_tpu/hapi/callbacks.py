@@ -114,10 +114,14 @@ class ProgBarLogger(Callback):
             print(f"Epoch {epoch + 1}/{self.epochs}")
 
     def on_train_batch_end(self, step, logs=None):
-        logs = logs or {}
+        # NOTE: no `logs or {}` here — truth-testing materializes a
+        # lazy logs mapping (device sync); only touch it ON the
+        # log_freq cadence so the sync-free fit path stays sync-free
         self._step += 1
         if self.verbose and self._step % self.log_freq == 0:
-            items = " - ".join(f"{k}: {_fmt(v)}" for k, v in logs.items())
+            items = " - ".join(
+                f"{k}: {_fmt(v)}" for k, v in (logs or {}).items()
+            )
             total = self.steps if self.steps is not None else "?"
             print(f"step {self._step}/{total} - {items}")
             sys.stdout.flush()

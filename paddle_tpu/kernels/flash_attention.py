@@ -42,35 +42,72 @@ def _pallas_fa():
         return None
 
 
-# Measured on TPU v5e (fwd+bwd, causal, H=16 D=64, 8192 tokens total):
-#   S=512:  composed 22.2ms  pallas 21.6ms
-#   S=1024: composed 12.7ms  pallas 22.4ms
-#   S=2048: composed 20.5ms  pallas 31.1ms
-#   S=4096: composed 21.6ms  pallas 47.7ms
-#   S=8192: composed 37.1ms  pallas 78.6ms
-# XLA's fused attention beats the generic pallas flash kernel on time at
-# every size tested, so the pallas path is selected on MEMORY grounds
-# only: composed materializes O(B*H*S^2) scores (fp32 for the softmax),
-# which stops fitting alongside a real model's activations somewhere in
-# the multi-GB range. Above the threshold flash's O(S) memory wins.
+# Round-5 v5e ablation (fwd+bwd, causal, B=4 H=16 D=128 — the flagship
+# head geometry; interleaved A/B medians, BENCH_NOTES for the full
+# table). The round-3 "pallas always loses on time" result was an
+# artifact of the kernel's DEFAULT block sizes (8x128 q-blocks); with
+# blocks tuned for v5e (block_q=512, block_k_major=1024, block_k=512 —
+# and the same for both backward passes) the causal kernel's
+# block-skipping of upper-triangle work wins outright once S is large
+# enough for the skipped half to dominate:
+#   S=1024: composed 23.9ms  pallas-tuned 24.2ms   (parity, within noise)
+#   S=2048: composed 29.6ms  pallas-tuned 27.7ms   (pallas)
+#   S=4096: composed 30.7ms  pallas-tuned 20.1ms   (pallas, 1.5x)
+#   (default blocks for reference: 10.0/23.9/78.3ms at 1024/2048/4096)
+# Selection: the tuned pallas kernel for causal attention from S>=2048
+# (the isolated A/B is parity at 1024, but inside the full compiled
+# flagship step composed still edges it there — 64.2% vs 62.6% MFU
+# measured — so the threshold sits where the win is real), and for ANY
+# shape whose fp32 score matrix exceeds the memory threshold (composed
+# materializes O(B*H*S^2) scores; flash is O(S)). Non-causal below the
+# threshold stays composed — there is no triangle to skip and XLA's
+# fused attention is at parity or better there.
 _COMPOSED_SCORE_BYTES_MAX = 2 << 30
+_PALLAS_CAUSAL_MIN_SEQ = 2048
 
 
-def _pallas_ok(q, k, v):
+def _tuned_block_sizes(sq, sk):
+    """v5e-tuned BlockSizes (measured above); clamped for short seqs."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+    )
+
+    bq = min(512, sq)
+    bkm = min(1024, sk)
+    bk = min(512, sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bkm, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bkm, block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bkm, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
+def _pallas_ok(q, k, v, causal):
     if all(d.platform == "cpu" for d in jax.devices()):
         return False
     if _pallas_fa() is None:
         return False
     b, sq, h, d = q.shape
-    score_bytes = 4 * b * h * sq * k.shape[1]  # fp32 softmax intermediate
-    if score_bytes <= _COMPOSED_SCORE_BYTES_MAX:
-        return False  # composed is faster whenever it fits (see table)
-    # pallas kernel wants seq multiples of its block sizes on BOTH q and kv
-    # sides and a supported head_dim; anything else falls back to composed
+    sk = k.shape[1]
+    score_bytes = 4 * b * h * sq * sk  # fp32 softmax intermediate
+    wanted = (
+        (causal and sk >= _PALLAS_CAUSAL_MIN_SEQ)
+        or score_bytes > _COMPOSED_SCORE_BYTES_MAX
+    )
+    if not wanted:
+        return False
+    # the kernel asserts divisibility by its ACTUAL block sizes (the
+    # tuned ones we pass, not the 128-lane minimum) on both q and kv
+    # sides; anything else falls back to composed
+    bs = _tuned_block_sizes(sq, sk)
     return (
-        sq % 128 == 0
-        and k.shape[1] % 128 == 0
-        and v.shape[1] == k.shape[1]
+        sq % bs.block_q == 0
+        and sq % bs.block_q_dq == 0
+        and sq % bs.block_q_major_dkv == 0
+        and sk % bs.block_k_major == 0
+        and sk % bs.block_k == 0
+        and v.shape[1] == sk
         and d in (64, 128, 256)
     )
 
@@ -79,7 +116,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """q/k/v: [B, S, H, D] -> [B, S, H, D]."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if _pallas_ok(q, k, v):
+    if _pallas_ok(q, k, v, causal):
         fa = _pallas_fa()
         # pallas kernel layout: [B, H, S, D]
         out = fa(
@@ -88,6 +125,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
             jnp.swapaxes(v, 1, 2),
             causal=causal,
             sm_scale=scale,
+            block_sizes=_tuned_block_sizes(q.shape[1], k.shape[1]),
         )
         return jnp.swapaxes(out, 1, 2)
     return _composed(q, k, v, causal=causal, scale=scale)

@@ -103,9 +103,12 @@ class Optimizer:
                 "parameters must be provided (dygraph mode requires an "
                 "explicit parameter list, paddle parity)"
             )
-        if isinstance(learning_rate, (int, float)):
+        import numbers
+
+        if isinstance(learning_rate, numbers.Real):
+            # numbers.Real covers numpy scalars (np.float32 configs etc.)
             enforce(
-                learning_rate >= 0, op,
+                float(learning_rate) >= 0, op,
                 "learning_rate expected >= 0, but received {}",
                 learning_rate,
             )

@@ -1,0 +1,68 @@
+"""Flash-attention path selection (kernels/flash_attention.py).
+
+The policy is measurement-driven (BENCH_NOTES round-5 ablation): tuned
+pallas for causal S>=2048 or any >2GiB score matrix, composed
+otherwise. These tests pin the decision logic and the v5e block
+clamping on CPU (the kernels themselves are exercised on the chip).
+"""
+import numpy as np
+
+import paddle_tpu.kernels.flash_attention as fa
+
+
+class _FakeTpu:
+    platform = "tpu"
+
+
+def _force_tpu(monkeypatch):
+    monkeypatch.setattr(fa.jax, "devices", lambda: [_FakeTpu()])
+    monkeypatch.setattr(fa, "_pallas_fa", lambda: object())
+
+
+def _qkv(b, s, h, d):
+    x = np.zeros((b, s, h, d), np.float32)
+    return x, x, x
+
+
+def test_selection_causal_threshold(monkeypatch):
+    _force_tpu(monkeypatch)
+    q, k, v = _qkv(4, 1024, 16, 128)
+    assert not fa._pallas_ok(q, k, v, causal=True)  # flagship stays composed
+    q, k, v = _qkv(4, 2048, 16, 128)
+    assert fa._pallas_ok(q, k, v, causal=True)
+    assert not fa._pallas_ok(q, k, v, causal=False)  # no triangle to skip
+
+
+def test_selection_memory_threshold_non_causal(monkeypatch):
+    _force_tpu(monkeypatch)
+    # 4*B*H*S^2 > 2 GiB -> pallas even without causality
+    q, k, v = _qkv(8, 8192, 16, 128)
+    assert fa._pallas_ok(q, k, v, causal=False)
+
+
+def test_selection_shape_constraints(monkeypatch):
+    _force_tpu(monkeypatch)
+    q, k, v = _qkv(4, 2048 + 2, 16, 128)  # not a lane multiple
+    assert not fa._pallas_ok(q, k, v, causal=True)
+    q, k, v = _qkv(4, 2048, 16, 96)  # unsupported head_dim
+    assert not fa._pallas_ok(q, k, v, causal=True)
+    # divisible by 128 but NOT by the tuned blocks (2176 = 17*128): the
+    # kernel would assert on block_q=512 — must fall back to composed
+    q, k, v = _qkv(4, 2176, 16, 128)
+    assert not fa._pallas_ok(q, k, v, causal=True)
+    # multiples of the tuned blocks are accepted (3072 = 6*512 = 3*1024)
+    q, k, v = _qkv(4, 3072, 16, 128)
+    assert fa._pallas_ok(q, k, v, causal=True)
+
+
+def test_selection_off_on_cpu():
+    q, k, v = _qkv(4, 4096, 16, 128)
+    assert not fa._pallas_ok(q, k, v, causal=True)  # CPU CI: composed
+
+
+def test_tuned_blocks_clamp_short_seqs():
+    bs = fa._tuned_block_sizes(256, 256)
+    assert bs.block_q == 256 and bs.block_k_major == 256
+    bs = fa._tuned_block_sizes(4096, 4096)
+    assert (bs.block_q, bs.block_k_major, bs.block_k) == (512, 1024, 512)
+    assert bs.block_q_dkv == 512 and bs.block_k_major_dq == 1024

@@ -322,3 +322,100 @@ def test_compiled_step_with_grad_scaler():
     # recovery: training continues from the unpoisoned state
     l2 = float(np.asarray(step([Tensor(x)], [Tensor(y)])[0].numpy()))
     assert np.isfinite(l2) and l2 <= losses[-1] * 1.5
+
+
+def test_fit_deferred_metrics_match_eager():
+    """The sync-free fit path defers metric updates; end-of-epoch
+    accuracy must equal the per-step eager computation (VERDICT r4 #4:
+    callbacks read cached host scalars, metrics drain at boundaries)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    n, bs = 256, 32
+    xs = rng.randn(n, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (n, 1)).astype(np.int64)
+
+    class DS:
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    def build(jit):
+        paddle.seed(7)
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+            paddle.nn.Linear(32, 4),
+        )
+        m = paddle.Model(net)
+        m.prepare(
+            paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy(),
+            jit_compile=jit,
+        )
+        return m
+
+    captured = {}
+
+    class Spy(paddle.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            captured[epoch] = dict(logs.items())
+
+    m_jit = build(True)
+    m_jit.fit(DS(), batch_size=bs, epochs=2, shuffle=False, verbose=0,
+              callbacks=[Spy()])
+    jit_logs = dict(captured)
+
+    captured.clear()
+    m_eager = build(False)
+    m_eager.fit(DS(), batch_size=bs, epochs=2, shuffle=False, verbose=0,
+                callbacks=[Spy()])
+    for ep in (0, 1):
+        assert abs(jit_logs[ep]["acc"] - captured[ep]["acc"]) < 1e-6, (
+            jit_logs[ep], captured[ep]
+        )
+        assert abs(jit_logs[ep]["loss"] - captured[ep]["loss"]) < 5e-3
+
+
+def test_lazy_logs_materialize_on_read():
+    from paddle_tpu.hapi.model import _LazyLogs
+
+    calls = []
+
+    def drain(d):
+        calls.append(1)
+        d["loss"] = 1.5
+
+    logs = _LazyLogs(drain)
+    assert not calls  # nothing fetched yet
+    assert logs["loss"] == 1.5
+    assert calls == [1]
+    assert logs.get("loss") == 1.5
+    assert calls == [1]  # drained once, cached after
+
+
+def test_lazy_logs_dict_snapshot_materializes():
+    # dict(logs) / {**logs} must not silently snapshot empty (the
+    # reason _LazyLogs is a Mapping, not a dict subclass)
+    from paddle_tpu.hapi.model import _LazyLogs
+
+    logs = _LazyLogs(lambda d: d.update(loss=0.25, acc=0.5))
+    snap = dict(logs)
+    assert snap == {"loss": 0.25, "acc": 0.5}
+    logs2 = _LazyLogs(lambda d: d.update(loss=1.0))
+    assert {**logs2} == {"loss": 1.0}
+
+
+def test_optimizer_accepts_numpy_scalar_lr():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    lin = paddle.nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(learning_rate=np.float32(0.01),
+                                parameters=lin.parameters())
+    assert abs(opt.get_lr() - 0.01) < 1e-8
