@@ -129,21 +129,21 @@ class Model:
         raise RuntimeError("prepare() must be called with a loss for training")
 
     def train_batch(self, inputs, labels=None, update=True):
+        # jit fast path shared with fit (_fit_step); this public entry
+        # materializes eagerly — per-step floats are its contract
+        res = self._fit_step(inputs, labels, update)
+        if res is not None:
+            loss, outputs, lbls = res
+            metrics = []
+            for m in self._metrics:
+                m_in = m.compute(*(_to_list(outputs) + lbls))
+                metrics.append(m.update(*_to_list(m_in)))
+            out_loss = [float(np.asarray(loss.numpy()))]
+            return (out_loss, metrics) if metrics else out_loss
+
         self.network.train()
         inputs = [_tensorize(x) for x in _to_list(inputs)]
         labels = [_tensorize(y) for y in _to_list(labels)]
-
-        # grad accumulation needs cross-batch .grad state, which the fused
-        # jit step doesn't model — route the whole accumulation to eager
-        if self._jit_enabled and update and not self._accumulating:
-            outputs, loss = self._jit_train_batch(inputs, labels)
-            if outputs is not None:
-                metrics = []
-                for m in self._metrics:
-                    m_in = m.compute(*(_to_list(outputs) + labels))
-                    metrics.append(m.update(*_to_list(m_in)))
-                out_loss = [float(np.asarray(loss.numpy()))]
-                return (out_loss, metrics) if metrics else out_loss
 
         from ..amp import auto_cast
 

@@ -92,8 +92,13 @@ def _pallas_ok(q, k, v, causal):
     sk = k.shape[1]
     score_bytes = 4 * b * h * sq * sk  # fp32 softmax intermediate
     wanted = (
-        (causal and sk >= _PALLAS_CAUSAL_MIN_SEQ)
-        or score_bytes > _COMPOSED_SCORE_BYTES_MAX
+        # sq == sk required: for cross-length causal attention the
+        # pallas kernel's top-left-aligned causal mask disagrees with
+        # composed's bottom-right-aligned one (tril k=sk-sq)
+        (causal and sq == sk and sk >= _PALLAS_CAUSAL_MIN_SEQ)
+        or (not causal and score_bytes > _COMPOSED_SCORE_BYTES_MAX)
+        or (causal and sq == sk
+            and score_bytes > _COMPOSED_SCORE_BYTES_MAX)
     )
     if not wanted:
         return False

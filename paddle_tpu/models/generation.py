@@ -1,0 +1,141 @@
+"""Autoregressive decoding for the Llama family — the TPU way.
+
+Reference parity: PaddleNLP's ``model.generate`` (greedy/sampling
+decode strategies over a KV cache — unverified, mount empty).
+
+TPU-first design: the ENTIRE generate — prefill plus every decode step
+— is one jitted program. The KV cache is a static [B, S_max, kvH, D]
+buffer per layer written with ``dynamic_update_slice``; the decode loop
+is a ``lax.scan`` over ``max_new_tokens`` with the caches in the carry.
+No growing tensors, no per-token dispatch: one compile per
+(batch, prompt_len, max_new_tokens) signature, then every token is a
+single fused device step. Finished sequences (EOS seen) keep emitting
+``eos_token_id`` — the standard static-shape treatment.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape
+from ..core.tensor import Tensor
+
+
+def _select_next(logits, do_sample, temperature, top_k, key):
+    """logits [B, V] -> next token ids [B]."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
+                  has_eos):
+    """Whole-generate program for one shape signature. The compiled fn
+    is cached ON the net (``net._generate_cache``) so its lifetime is
+    the model's — no module-global registry pinning dropped models
+    alive. Weights enter as arguments, so updated weights do NOT need
+    a recompile."""
+    cfg = net.config
+    S_max = S_prompt + max_new
+
+    def run(params, buffers, ids, temperature, eos_id, key):
+        net.load_functional_state(params, buffers)
+        net.eval()
+        caches = [
+            (
+                jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
+                          jnp.float32),
+                jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
+                          jnp.float32),
+            )
+            for _ in range(cfg.num_hidden_layers)
+        ]
+        with tape.trace_scope(), tape.no_grad():
+            # prefill: the whole prompt in one pass, caches filled [0, S)
+            logits, caches = net(
+                Tensor(ids), caches=caches, pos=jnp.int32(0)
+            )
+        logits = logits.value[:, -1, :]
+        key, sub = jax.random.split(key)
+        next_tok = _select_next(logits, do_sample, temperature, top_k,
+                                sub)
+        finished = (
+            (next_tok == eos_id) if has_eos
+            else jnp.zeros((B,), bool)
+        )
+        flat = [a for kv in caches for a in kv]
+
+        def step(carry, _):
+            tok, pos, flat, finished, key = carry
+            caches = [
+                (flat[2 * i], flat[2 * i + 1])
+                for i in range(cfg.num_hidden_layers)
+            ]
+            with tape.trace_scope(), tape.no_grad():
+                logits, caches = net(
+                    Tensor(tok[:, None]), caches=caches, pos=pos
+                )
+            logits = logits.value[:, -1, :]
+            key, sub = jax.random.split(key)
+            nxt = _select_next(logits, do_sample, temperature, top_k,
+                               sub)
+            if has_eos:
+                nxt = jnp.where(finished, eos_id, nxt)
+                finished = finished | (nxt == eos_id)
+            flat = [a for kv in caches for a in kv]
+            return (nxt, pos + 1, flat, finished, key), nxt
+
+        (_, _, _, _, _), toks = jax.lax.scan(
+            step,
+            (next_tok, jnp.int32(S_prompt), flat, finished, key),
+            None, length=max_new - 1,
+        ) if max_new > 1 else ((None,) * 5, jnp.zeros(
+            (0, B), jnp.int32
+        ))
+        out = jnp.concatenate(
+            [ids.astype(jnp.int32), next_tok[:, None],
+             jnp.swapaxes(toks, 0, 1)], axis=1,
+        )
+        return out
+
+    return jax.jit(run)
+
+
+def generate(net, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+    """Greedy / top-k-sampling decode. Returns Tensor [B, S + new]."""
+    ids = input_ids.value if isinstance(input_ids, Tensor) else jnp.asarray(
+        input_ids
+    )
+    B, S = int(ids.shape[0]), int(ids.shape[1])
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    cache = net.__dict__.setdefault("_generate_cache", {})
+    sig = (B, S, int(max_new_tokens), bool(do_sample), int(top_k),
+           eos_token_id is not None)
+    fn = cache.get(sig)
+    if fn is None:
+        fn = cache[sig] = _build_decode(net, *sig)
+    params = {k: p.value for k, p in net.named_parameters()}
+    buffers = {k: b.value for k, b in net.named_buffers()}
+    was_training = net.training
+    try:
+        out = fn(
+            params, buffers, ids, jnp.float32(temperature),
+            jnp.int32(eos_token_id if eos_token_id is not None else -1),
+            jax.random.PRNGKey(seed),
+        )
+    finally:
+        # tracing swapped tracers into the imperative Layer objects;
+        # restore the concrete weights (CompiledTrainStep's write-back
+        # pattern) and the caller's train/eval mode
+        net.load_functional_state(params, buffers)
+        if was_training:
+            net.train()
+        else:
+            net.eval()
+    return Tensor(out)

@@ -78,7 +78,15 @@ class LlamaAttention(nn.Layer):
         self.v_proj = nn.Linear(h, config.kv_heads * d, bias_attr=False)
         self.o_proj = nn.Linear(config.num_attention_heads * d, h, bias_attr=False)
 
-    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None):
+    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None,
+                cache=None, pos=None):
+        """Training/eval path unchanged when ``cache is None``. With a
+        ``cache=(k_cache, v_cache)`` pair ([B, S_max, kvH, D] jnp arrays)
+        and a scalar ``pos`` (number of tokens already cached), the new
+        keys/values are written at [pos, pos+S) and attention runs over
+        the whole static cache with a position mask — the TPU decode
+        pattern (static shapes, no growing tensors). Returns
+        (out, new_cache) in cache mode."""
         cfg = self.cfg
         B, S = int(x.shape[0]), int(x.shape[1])
         q = self.q_proj(x).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
@@ -88,6 +96,47 @@ class LlamaAttention(nn.Layer):
             q, k, None, sin=rope_sin, cos=rope_cos,
             rotary_emb_base=cfg.rope_theta,
         )
+        if cache is not None:
+            import jax
+            import jax.numpy as jnp
+
+            k_cache, v_cache = cache
+            S_max = k_cache.shape[1]
+            p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
+            z = jnp.zeros((), p.dtype)  # index dtypes must all match p's
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.value.astype(k_cache.dtype), (z, p, z, z)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.value.astype(v_cache.dtype), (z, p, z, z)
+            )
+            kk, vv = Tensor(k_cache), Tensor(v_cache)
+            if cfg.kv_heads != cfg.num_attention_heads:
+                rep = cfg.num_attention_heads // cfg.kv_heads
+                kk = kk.repeat_interleave(rep, axis=2)
+                vv = vv.repeat_interleave(rep, axis=2)
+            # mask[t, s]: token (p + t) may read cache slot s iff s <= p+t
+            valid = (
+                jnp.arange(S_max)[None, :]
+                <= (p + jnp.arange(S))[:, None]
+            )
+            mask = jnp.where(valid, 0.0, -jnp.inf)[None, None, :, :]
+            if attn_mask is not None:
+                # combine with a user mask (e.g. left-padded prompts);
+                # must broadcast over [B, H, S, S_max] in cache mode
+                am = (
+                    attn_mask.value if hasattr(attn_mask, "value")
+                    else jnp.asarray(attn_mask)
+                )
+                mask = mask + am
+            out = F.scaled_dot_product_attention(
+                q, kk, vv, attn_mask=Tensor(mask), is_causal=False,
+                training=False,
+            )
+            return (
+                self.o_proj(out.reshape([B, S, -1])),
+                (k_cache, v_cache),
+            )
         if cfg.kv_heads != cfg.num_attention_heads:
             rep = cfg.num_attention_heads // cfg.kv_heads
             k = k.repeat_interleave(rep, axis=2)
@@ -123,7 +172,15 @@ class LlamaDecoderLayer(nn.Layer):
         )
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None):
+    def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None,
+                cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.self_attn(
+                self.input_layernorm(x), rope_cos, rope_sin, attn_mask,
+                cache=cache, pos=pos,
+            )
+            h = x + a
+            return h + self.mlp(self.post_attention_layernorm(h)), new_cache
         h = x + self.self_attn(
             self.input_layernorm(x), rope_cos, rope_sin, attn_mask
         )
@@ -140,11 +197,33 @@ class LlamaModel(nn.Layer):
         )
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+        """``caches``: list of per-layer (k_cache, v_cache) for decode
+        (returns (hidden, new_caches)); None for the training path."""
         cfg = self.config
         S = int(input_ids.shape[1])
         from ..kernels.rope import build_rope_cache
 
+        if caches is not None:
+            import jax
+            import jax.numpy as jnp
+
+            S_max = caches[0][0].shape[1]
+            cos, sin = build_rope_cache(
+                S_max, cfg.head_dim, base=cfg.rope_theta
+            )
+            p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
+            # rope rows for the tokens being fed: [p, p+S)
+            cos = jax.lax.dynamic_slice_in_dim(cos, p, S, axis=1)
+            sin = jax.lax.dynamic_slice_in_dim(sin, p, S, axis=1)
+            cos_t, sin_t = Tensor(cos), Tensor(sin)
+            h = self.embed_tokens(input_ids)
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                h, c2 = layer(h, cos_t, sin_t, attn_mask,
+                              cache=cache, pos=pos)
+                new_caches.append(c2)
+            return self.norm(h), new_caches
         cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
         cos_t, sin_t = Tensor(cos), Tensor(sin)
         h = self.embed_tokens(input_ids)
@@ -182,9 +261,28 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
                 config.hidden_size, config.vocab_size, bias_attr=False
             )
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+        if caches is not None:
+            h, new_caches = self.model(
+                input_ids, attn_mask, caches=caches, pos=pos
+            )
+            logits = (
+                F.linear(h, self.model.embed_tokens.weight.t())
+                if self.lm_head is None else self.lm_head(h)
+            )
+            return logits, new_caches
         h = self.model(input_ids, attn_mask)
         if self.lm_head is None:
             return F.linear(h, self.model.embed_tokens.weight.t())
         return self.lm_head(h)
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+        from .generation import generate as _generate
+
+        return _generate(
+            self, input_ids, max_new_tokens=max_new_tokens,
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            eos_token_id=eos_token_id, seed=seed,
+        )
 

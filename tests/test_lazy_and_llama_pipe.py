@@ -164,6 +164,33 @@ def test_llama_pipe_compiled_hybrid_step_trains(hcg):
     assert losses[-1] < losses[0]  # it actually learns the batch
 
 
+def test_llama_pipe_gqa_hybrid_step(hcg):
+    # grouped-query attention under TP: kv heads split over mp like q
+    # heads (Llama-3-style configs on the same pipe class)
+    from types import SimpleNamespace
+
+    paddle.seed(17)
+    cfg = LlamaConfig.tiny(
+        vocab_size=32, hidden_size=32, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2,  # GQA: 2 kv heads over mp=2
+    )
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pipe.parameters())
+    engine = PipelineParallel(
+        pipe, hcg,
+        SimpleNamespace(pipeline_configs={
+            "accumulate_steps": 2, "compiled": True,
+        }),
+    )
+    ids = jax.device_put(
+        jnp.asarray(RNG.randint(0, cfg.vocab_size, (4, 8))),
+        NamedSharding(hcg.mesh, P("dp")),
+    )
+    loss = engine.train_batch((Tensor(ids), Tensor(ids)), opt)
+    assert np.isfinite(float(np.asarray(loss.numpy())))
+
+
 def test_llama_pipe_tp_layout(hcg):
     cfg = _tiny_cfg()
     with paddle.LazyGuard():
