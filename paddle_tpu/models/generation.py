@@ -21,7 +21,7 @@ from ..core import tape
 from ..core.tensor import Tensor
 
 
-def _select_next(logits, do_sample, temperature, top_k, key):
+def _select_next(logits, do_sample, temperature, top_k, top_p, key):
     """logits [B, V] -> next token ids [B]."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -29,11 +29,21 @@ def _select_next(logits, do_sample, temperature, top_k, key):
     if top_k and top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p is not None and top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # with cumulative probability >= top_p (the kept set always
+        # includes the most-probable token)
+        srt = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # token enters before mass reached p
+        cutoff = jnp.where(keep, srt, jnp.inf).min(axis=-1, keepdims=True)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
 def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
-                  has_eos):
+                  top_p, has_eos):
     """Whole-generate program for one shape signature. The compiled fn
     is cached ON the net (``net._generate_cache``) so its lifetime is
     the model's — no module-global registry pinning dropped models
@@ -62,7 +72,7 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
         logits = logits.value[:, -1, :]
         key, sub = jax.random.split(key)
         next_tok = _select_next(logits, do_sample, temperature, top_k,
-                                sub)
+                                top_p, sub)
         finished = (
             (next_tok == eos_id) if has_eos
             else jnp.zeros((B,), bool)
@@ -82,7 +92,7 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
             logits = logits.value[:, -1, :]
             key, sub = jax.random.split(key)
             nxt = _select_next(logits, do_sample, temperature, top_k,
-                               sub)
+                               top_p, sub)
             if has_eos:
                 nxt = jnp.where(finished, eos_id, nxt)
                 finished = finished | (nxt == eos_id)
@@ -106,7 +116,8 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
 
 
 def generate(net, input_ids, max_new_tokens=32, do_sample=False,
-             temperature=1.0, top_k=0, eos_token_id=None, seed=0):
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             seed=0):
     """Greedy / top-k-sampling decode. Returns Tensor [B, S + new]."""
     ids = input_ids.value if isinstance(input_ids, Tensor) else jnp.asarray(
         input_ids
@@ -116,6 +127,7 @@ def generate(net, input_ids, max_new_tokens=32, do_sample=False,
         raise ValueError("max_new_tokens must be >= 1")
     cache = net.__dict__.setdefault("_generate_cache", {})
     sig = (B, S, int(max_new_tokens), bool(do_sample), int(top_k),
+           float(top_p) if top_p is not None else 1.0,
            eos_token_id is not None)
     fn = cache.get(sig)
     if fn is None:

@@ -122,3 +122,22 @@ def test_cache_path_honors_attn_mask(net):
     blocked[..., 0] = -np.inf  # hide the first token from everyone
     out = run(Tensor(jnp.asarray(blocked)))
     assert not np.allclose(base[:, 1:], out[:, 1:])
+
+
+def test_generate_top_p(net):
+    prompt = RNG.randint(0, 64, (2, 4))
+    a = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5, do_sample=True,
+        top_p=0.8, temperature=1.0, seed=21).numpy())
+    b = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5, do_sample=True,
+        top_p=0.8, temperature=1.0, seed=21).numpy())
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 9)
+    # top_p -> 0 collapses sampling to greedy (only the argmax survives)
+    g = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5).numpy())
+    t = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5, do_sample=True,
+        top_p=1e-6, seed=33).numpy())
+    np.testing.assert_array_equal(g, t)
