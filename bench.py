@@ -386,7 +386,67 @@ def lower_7b_check():
         raise SystemExit(r.returncode)
 
 
+def probe_backend(timeout=240):
+    """Classify backend health in a KILLABLE subprocess: "tpu" /
+    "cpu" (responsive backends) or "wedged" (init hung or crashed). A
+    wedged chip claim (observed: a mid-compile SIGTERM left the axon
+    relay lease stuck and every later process hung inside jax.devices()
+    for hours) must not turn the bench into an infinite hang. A fast
+    "cpu" answer is a HEALTHY backend on a chipless box, not a wedge.
+    Set PADDLE_TPU_ASSUME_CHIP=1 to skip the probe (saves one backend
+    init when the caller knows the chip is fine)."""
+    import subprocess
+
+    if os.environ.get("PADDLE_TPU_ASSUME_CHIP"):
+        return "tpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return "wedged"
+    if r.returncode != 0:
+        return "wedged"
+    return "tpu" if "tpu" in r.stdout else "cpu"
+
+
 def main(profile=False, all_configs=False):
+    if (
+        os.environ.get("JAX_PLATFORMS", "") != "cpu"
+        and probe_backend() == "wedged"
+    ):
+        # chip claim wedged: report it honestly instead of hanging, with
+        # a CPU smoke run (fresh subprocess; this process must not touch
+        # the wedged backend) so the record still proves the code runs
+        from tools.vmesh import run_in_virtual_cpu_mesh
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        r = run_in_virtual_cpu_mesh(
+            1, "import json, bench; print(json.dumps(bench.flagship()))",
+            cwd=here, timeout=900,
+        )
+        sys.stderr.write(r.stderr)
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = {}
+        rec["metric"] = "tpu_unreachable_cpu_smoke"
+        rec["tpu_unreachable"] = True
+        rec["cpu_smoke_ok"] = r.returncode == 0 and "value" in rec
+        rec["baseline_note"] = (
+            "TPU backend init did not respond within the probe timeout "
+            "(wedged chip claim); this is a CPU smoke record, NOT a "
+            "flagship measurement — see BENCH_NOTES r5 note"
+        )
+        print(json.dumps(rec))
+        if r.returncode != 0:
+            raise SystemExit(r.returncode)  # smoke itself failed: say so
+        return
+    # responsive backend (tpu OR plain cpu box): flagship() itself
+    # handles the cpu case with the honest *_cpu_smoke metric name
     if all_configs:
         run_all()
     print(json.dumps(flagship(profile)))

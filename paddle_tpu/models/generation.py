@@ -37,6 +37,9 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
         probs = jax.nn.softmax(srt, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep = cum - probs < top_p  # token enters before mass reached p
+        # the argmax token always survives (top_p -> 0 must collapse to
+        # greedy, not to an all-masked distribution emitting token 0)
+        keep = keep.at[:, 0].set(True)
         cutoff = jnp.where(keep, srt, jnp.inf).min(axis=-1, keepdims=True)
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)

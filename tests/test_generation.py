@@ -141,3 +141,13 @@ def test_generate_top_p(net):
         Tensor(jnp.asarray(prompt)), max_new_tokens=5, do_sample=True,
         top_p=1e-6, seed=33).numpy())
     np.testing.assert_array_equal(g, t)
+
+
+def test_generate_top_p_zero_collapses_to_greedy(net):
+    prompt = RNG.randint(0, 64, (1, 4))
+    g = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4).numpy())
+    z = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4, do_sample=True,
+        top_p=0.0, seed=2).numpy())
+    np.testing.assert_array_equal(g, z)
