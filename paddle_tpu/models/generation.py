@@ -45,6 +45,71 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
+def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
+                temperature, eos_id, key):
+    """The traced decode body (prefill + scan); callable from both the
+    generate() jit and the exportable GreedyDecoder layer. ``ids`` is a
+    jnp [B, S_prompt] int array; returns jnp [B, S_prompt + max_new]."""
+    cfg = net.config
+    B, S_prompt = int(ids.shape[0]), int(ids.shape[1])
+    S_max = S_prompt + max_new
+    caches = [
+        (
+            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
+                      jnp.float32),
+            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
+                      jnp.float32),
+        )
+        for _ in range(cfg.num_hidden_layers)
+    ]
+    with tape.trace_scope(), tape.no_grad():
+        # prefill: the whole prompt in one pass, caches filled [0, S)
+        logits, caches = net(
+            Tensor(ids), caches=caches, pos=jnp.int32(0)
+        )
+    logits = logits.value[:, -1, :]
+    key, sub = jax.random.split(key)
+    next_tok = _select_next(logits, do_sample, temperature, top_k,
+                            top_p, sub)
+    finished = (
+        (next_tok == eos_id) if has_eos
+        else jnp.zeros((B,), bool)
+    )
+    flat = [a for kv in caches for a in kv]
+
+    def step(carry, _):
+        tok, pos, flat, finished, key = carry
+        caches = [
+            (flat[2 * i], flat[2 * i + 1])
+            for i in range(cfg.num_hidden_layers)
+        ]
+        with tape.trace_scope(), tape.no_grad():
+            logits, caches = net(
+                Tensor(tok[:, None]), caches=caches, pos=pos
+            )
+        logits = logits.value[:, -1, :]
+        key, sub = jax.random.split(key)
+        nxt = _select_next(logits, do_sample, temperature, top_k,
+                           top_p, sub)
+        if has_eos:
+            nxt = jnp.where(finished, eos_id, nxt)
+            finished = finished | (nxt == eos_id)
+        flat = [a for kv in caches for a in kv]
+        return (nxt, pos + 1, flat, finished, key), nxt
+
+    (_, _, _, _, _), toks = jax.lax.scan(
+        step,
+        (next_tok, jnp.int32(S_prompt), flat, finished, key),
+        None, length=max_new - 1,
+    ) if max_new > 1 else ((None,) * 5, jnp.zeros(
+        (0, B), jnp.int32
+    ))
+    return jnp.concatenate(
+        [ids.astype(jnp.int32), next_tok[:, None],
+         jnp.swapaxes(toks, 0, 1)], axis=1,
+    )
+
+
 def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
                   top_p, has_eos):
     """Whole-generate program for one shape signature. The compiled fn
@@ -52,70 +117,58 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
     the model's — no module-global registry pinning dropped models
     alive. Weights enter as arguments, so updated weights do NOT need
     a recompile."""
-    cfg = net.config
-    S_max = S_prompt + max_new
 
     def run(params, buffers, ids, temperature, eos_id, key):
         net.load_functional_state(params, buffers)
         net.eval()
-        caches = [
-            (
-                jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
-                          jnp.float32),
-                jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
-                          jnp.float32),
-            )
-            for _ in range(cfg.num_hidden_layers)
-        ]
-        with tape.trace_scope(), tape.no_grad():
-            # prefill: the whole prompt in one pass, caches filled [0, S)
-            logits, caches = net(
-                Tensor(ids), caches=caches, pos=jnp.int32(0)
-            )
-        logits = logits.value[:, -1, :]
-        key, sub = jax.random.split(key)
-        next_tok = _select_next(logits, do_sample, temperature, top_k,
-                                top_p, sub)
-        finished = (
-            (next_tok == eos_id) if has_eos
-            else jnp.zeros((B,), bool)
-        )
-        flat = [a for kv in caches for a in kv]
-
-        def step(carry, _):
-            tok, pos, flat, finished, key = carry
-            caches = [
-                (flat[2 * i], flat[2 * i + 1])
-                for i in range(cfg.num_hidden_layers)
-            ]
-            with tape.trace_scope(), tape.no_grad():
-                logits, caches = net(
-                    Tensor(tok[:, None]), caches=caches, pos=pos
-                )
-            logits = logits.value[:, -1, :]
-            key, sub = jax.random.split(key)
-            nxt = _select_next(logits, do_sample, temperature, top_k,
-                               top_p, sub)
-            if has_eos:
-                nxt = jnp.where(finished, eos_id, nxt)
-                finished = finished | (nxt == eos_id)
-            flat = [a for kv in caches for a in kv]
-            return (nxt, pos + 1, flat, finished, key), nxt
-
-        (_, _, _, _, _), toks = jax.lax.scan(
-            step,
-            (next_tok, jnp.int32(S_prompt), flat, finished, key),
-            None, length=max_new - 1,
-        ) if max_new > 1 else ((None,) * 5, jnp.zeros(
-            (0, B), jnp.int32
-        ))
-        out = jnp.concatenate(
-            [ids.astype(jnp.int32), next_tok[:, None],
-             jnp.swapaxes(toks, 0, 1)], axis=1,
-        )
-        return out
+        return _decode_ids(net, ids, max_new, do_sample, top_k, top_p,
+                           has_eos, temperature, eos_id, key)
 
     return jax.jit(run)
+
+
+class GreedyDecoder:
+    """Exportable greedy decode head: ``forward(ids) -> ids + new``.
+
+    Wraps a LlamaForCausalLM so the WHOLE decode (prefill + KV-cache
+    scan) exports through ``paddle.jit.save`` as one StableHLO program
+    and serves through ``inference.create_predictor`` — the deploy
+    chain for generation. Greedy only (deployment-deterministic; no
+    RNG input). Decode programs are shape-specialized: export with a
+    concrete [B, S_prompt] InputSpec.
+    """
+
+    def __init__(self, net, max_new_tokens, eos_token_id=None):
+        from .. import nn
+
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        outer_new = int(max_new_tokens)
+        outer_eos = eos_token_id
+
+        class _Mod(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.net = net
+
+            def forward(self, ids):
+                v = ids.value if isinstance(ids, Tensor) else jnp.asarray(
+                    ids
+                )
+                out = _decode_ids(
+                    self.net, v, outer_new, False, 0, 1.0,
+                    outer_eos is not None, jnp.float32(1.0),
+                    jnp.int32(outer_eos if outer_eos is not None else -1),
+                    jax.random.PRNGKey(0),
+                )
+                return Tensor(out)
+
+        self.layer = _Mod()
+
+    def save(self, path, input_spec):
+        from ..jit.api import save as jit_save
+
+        jit_save(self.layer, path, input_spec=input_spec)
 
 
 def generate(net, input_ids, max_new_tokens=32, do_sample=False,
