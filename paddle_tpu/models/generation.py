@@ -51,7 +51,7 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
     generate() jit and the exportable GreedyDecoder layer. ``ids`` is a
     jnp [B, S_prompt] int array; returns jnp [B, S_prompt + max_new]."""
     cfg = net.config
-    B, S_prompt = int(ids.shape[0]), int(ids.shape[1])
+    B, S_prompt = ids.shape[0], ids.shape[1]  # no int(): jnp accepts dims
     S_max = S_prompt + max_new
     caches = [
         (
@@ -68,7 +68,10 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
             Tensor(ids), caches=caches, pos=jnp.int32(0)
         )
     logits = logits.value[:, -1, :]
-    key, sub = jax.random.split(key)
+    if do_sample:  # greedy never reads the key: keep it out of the
+        key, sub = jax.random.split(key)  # program entirely (smaller
+    else:  # exported StableHLO, no per-token threefry work)
+        sub = key
     next_tok = _select_next(logits, do_sample, temperature, top_k,
                             top_p, sub)
     finished = (
@@ -88,7 +91,10 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
                 Tensor(tok[:, None]), caches=caches, pos=pos
             )
         logits = logits.value[:, -1, :]
-        key, sub = jax.random.split(key)
+        if do_sample:
+            key, sub = jax.random.split(key)
+        else:
+            sub = key
         nxt = _select_next(logits, do_sample, temperature, top_k,
                            top_p, sub)
         if has_eos:
@@ -127,6 +133,38 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
     return jax.jit(run)
 
 
+def _make_greedy_mod():
+    from .. import nn
+
+    class _GreedyMod(nn.Layer):
+        """forward(ids) -> full decoded ids; see GreedyDecoder."""
+
+        def __init__(self, net, max_new, eos):
+            super().__init__()
+            self.net = net
+            self.max_new = max_new
+            self.eos = eos
+            # export must not flip the wrapped model's mode: jit.save
+            # restores the OWNER's (this wrapper's) training flag onto
+            # the whole tree afterwards, so mirror the net's mode here
+            if net.training:
+                self.train()
+            else:
+                self.eval()
+
+        def forward(self, ids):
+            v = ids.value if isinstance(ids, Tensor) else jnp.asarray(ids)
+            out = _decode_ids(
+                self.net, v, self.max_new, False, 0, 1.0,
+                self.eos is not None, jnp.float32(1.0),
+                jnp.int32(self.eos if self.eos is not None else -1),
+                jax.random.PRNGKey(0),
+            )
+            return Tensor(out)
+
+    return _GreedyMod
+
+
 class GreedyDecoder:
     """Exportable greedy decode head: ``forward(ids) -> ids + new``.
 
@@ -139,35 +177,25 @@ class GreedyDecoder:
     """
 
     def __init__(self, net, max_new_tokens, eos_token_id=None):
-        from .. import nn
-
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        outer_new = int(max_new_tokens)
-        outer_eos = eos_token_id
-
-        class _Mod(nn.Layer):
-            def __init__(self):
-                super().__init__()
-                self.net = net
-
-            def forward(self, ids):
-                v = ids.value if isinstance(ids, Tensor) else jnp.asarray(
-                    ids
-                )
-                out = _decode_ids(
-                    self.net, v, outer_new, False, 0, 1.0,
-                    outer_eos is not None, jnp.float32(1.0),
-                    jnp.int32(outer_eos if outer_eos is not None else -1),
-                    jax.random.PRNGKey(0),
-                )
-                return Tensor(out)
-
-        self.layer = _Mod()
+        self.layer = _make_greedy_mod()(
+            net, int(max_new_tokens), eos_token_id
+        )
 
     def save(self, path, input_spec):
         from ..jit.api import save as jit_save
 
+        for s in input_spec or []:
+            shape = getattr(s, "shape", None) or []
+            if any(d is None or (isinstance(d, int) and d < 0)
+                   for d in shape):
+                raise ValueError(
+                    "GreedyDecoder.save: decode programs are "
+                    "shape-specialized (the KV cache and scan length "
+                    "derive from the prompt shape) — provide a concrete "
+                    f"[B, S_prompt] InputSpec, got {shape}"
+                )
         jit_save(self.layer, path, input_spec=input_spec)
 
 

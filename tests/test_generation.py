@@ -214,3 +214,25 @@ def test_greedy_decoder_exports_and_serves(net, tmp_path):
     pred.run()
     got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_array_equal(got, want)
+
+
+def test_greedy_decoder_save_preserves_eval_mode(net):
+    from paddle_tpu.models.generation import GreedyDecoder
+    from paddle_tpu.static import InputSpec
+    import tempfile
+
+    net.eval()
+    dec = GreedyDecoder(net, max_new_tokens=2)
+    with tempfile.TemporaryDirectory() as d:
+        dec.save(d + "/m", input_spec=[InputSpec([1, 4], "int32", "ids")])
+    assert net.training is False  # export must not flip the model's mode
+
+
+def test_greedy_decoder_rejects_polymorphic_spec(net):
+    from paddle_tpu.models.generation import GreedyDecoder
+    from paddle_tpu.static import InputSpec
+
+    dec = GreedyDecoder(net, max_new_tokens=2)
+    with pytest.raises(ValueError, match="shape-specialized"):
+        dec.save("/tmp/x", input_spec=[InputSpec([None, 4], "int32",
+                                                 "ids")])
