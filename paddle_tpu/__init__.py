@@ -9,7 +9,7 @@ meshes, and Pallas kernels for the fused hot ops.
 """
 from __future__ import annotations
 
-__version__ = "0.4.0"  # keep in sync with pyproject.toml
+__version__ = "0.5.0"  # keep in sync with pyproject.toml
 
 from . import ops as _ops_ns
 from .core import dtypes as _dtypes

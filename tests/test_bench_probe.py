@@ -1,0 +1,48 @@
+"""bench.py backend-health probe: the wedge classifier must distinguish
+a hung chip claim from a healthy chipless box (review r5)."""
+import subprocess
+import sys
+
+sys.path.insert(0, "/root/repo")
+import bench
+
+
+def test_probe_classifies_tpu(monkeypatch):
+    monkeypatch.setattr(
+        "subprocess.run",
+        lambda *a, **kw: subprocess.CompletedProcess(a, 0, "tpu\n", ""),
+    )
+    assert bench.probe_backend(timeout=1) == "tpu"
+
+
+def test_probe_classifies_cpu(monkeypatch):
+    monkeypatch.setattr(
+        "subprocess.run",
+        lambda *a, **kw: subprocess.CompletedProcess(a, 0, "cpu\n", ""),
+    )
+    assert bench.probe_backend(timeout=1) == "cpu"
+
+
+def test_probe_classifies_wedge_timeout(monkeypatch):
+    def boom(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr("subprocess.run", boom)
+    assert bench.probe_backend(timeout=1) == "wedged"
+
+
+def test_probe_classifies_wedge_crash(monkeypatch):
+    monkeypatch.setattr(
+        "subprocess.run",
+        lambda *a, **kw: subprocess.CompletedProcess(a, 7, "", "boom"),
+    )
+    assert bench.probe_backend(timeout=1) == "wedged"
+
+
+def test_probe_assume_chip_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ASSUME_CHIP", "1")
+    monkeypatch.setattr(
+        "subprocess.run",
+        lambda *a, **kw: (_ for _ in ()).throw(AssertionError("probed")),
+    )
+    assert bench.probe_backend(timeout=1) == "tpu"
