@@ -157,3 +157,52 @@ class LlamaForCausalLMPipe(LlamaFlopsMixin, PipelineLayer):
             recompute_interval=recompute_interval,
             topology=topology,
         )
+
+    # ------------------------------------------------- serving bridge
+    def to_causal_lm(self):
+        """Convert to a :class:`LlamaForCausalLM` carrying these weights
+        — the train-hybrid -> serve path: a pipe-trained checkpoint
+        decodes through ``generate()`` / exports via ``GreedyDecoder``.
+
+        Under GSPMD parameter values are GLOBAL logical arrays (the mesh
+        placement is just layout), so the mapping is pure renaming plus
+        one concat: the pipe keeps gate/up as separate TP columns while
+        the single model fuses them into ``gate_up_proj`` (swiglu splits
+        the fused output in half, so ``concat(gate, up)`` on the out dim
+        is exact).
+        """
+        from .llama import LlamaForCausalLM
+        from ..core.lazy import LazyGuard
+
+        cfg = self.config
+        L = cfg.num_hidden_layers
+        src = {k: p.value for k, p in self.named_parameters()}
+        state = {
+            "model.embed_tokens.weight": src["0.weight"],
+            "model.norm.weight": src[f"{L + 1}.weight"],
+            "lm_head.weight": src[f"{L + 2}.weight"],
+        }
+        for i in range(L):
+            b, t = f"{i + 1}.", f"model.layers.{i}."
+            for name in ("input_layernorm.weight",
+                         "post_attention_layernorm.weight"):
+                state[t + name] = src[b + name]
+            for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                state[t + f"self_attn.{name}.weight"] = src[
+                    b + f"{name}.weight"
+                ]
+            state[t + "mlp.gate_up_proj.weight"] = jnp.concatenate(
+                [src[b + "gate_proj.weight"], src[b + "up_proj.weight"]],
+                axis=1,
+            )
+            state[t + "mlp.down_proj.weight"] = src[b + "down_proj.weight"]
+        with LazyGuard():  # no wasted init: every param is overwritten
+            net = LlamaForCausalLM(cfg)
+        for k, p in net.named_parameters():
+            if k not in state:
+                raise KeyError(
+                    f"pipe->single conversion missing parameter {k!r}"
+                )
+            p.value = state[k]
+        net.eval()
+        return net

@@ -232,3 +232,30 @@ def test_lower_7b_small_asserts_n_params():
     with pytest.raises(AssertionError, match="params"):
         l7.lower_7b(dp=2, pp=2, mp=2, B=4, S=16, micro_batches=2,
                     cfg=_tiny_cfg())
+
+
+def test_pipe_to_causal_lm_logits_and_decode(hcg):
+    """Train-hybrid -> serve: the converted LlamaForCausalLM computes
+    the same logits as running the pipe's stages, and decodes through
+    generate()."""
+    from paddle_tpu.core import tape
+
+    paddle.seed(23)
+    cfg = _tiny_cfg()
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    ids = RNG.randint(0, cfg.vocab_size, (2, 8))
+
+    # pipe forward: run every stage in sequence (eval path)
+    with tape.no_grad():
+        x = Tensor(jnp.asarray(ids))
+        for stage in range(pipe.num_stages):
+            x = pipe.run_stage(x, stage, training=False)
+    want = np.asarray(x.numpy())
+
+    net = pipe.to_causal_lm()
+    with tape.no_grad():
+        got = np.asarray(net(Tensor(jnp.asarray(ids))).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+    out = net.generate(Tensor(jnp.asarray(ids[:, :4])), max_new_tokens=3)
+    assert np.asarray(out.numpy()).shape == (2, 7)
