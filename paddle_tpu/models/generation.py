@@ -45,14 +45,13 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
-def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
-                temperature, eos_id, key):
-    """The traced decode body (prefill + scan); callable from both the
-    generate() jit and the exportable GreedyDecoder layer. ``ids`` is a
-    jnp [B, S_prompt] int array; returns jnp [B, S_prompt + max_new]."""
+def _alloc_and_prefill(net, ids, S_max):
+    """Allocate the per-layer static KV buffers and run the prompt
+    through in one pass (caches filled [0, S_prompt)). Shared by the
+    greedy/sampling and beam decode bodies — ONE place owns the cache
+    layout. Returns (last-position logits [B, V], caches)."""
     cfg = net.config
-    B, S_prompt = ids.shape[0], ids.shape[1]  # no int(): jnp accepts dims
-    S_max = S_prompt + max_new
+    B = ids.shape[0]
     caches = [
         (
             jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
@@ -63,11 +62,21 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
         for _ in range(cfg.num_hidden_layers)
     ]
     with tape.trace_scope(), tape.no_grad():
-        # prefill: the whole prompt in one pass, caches filled [0, S)
         logits, caches = net(
             Tensor(ids), caches=caches, pos=jnp.int32(0)
         )
-    logits = logits.value[:, -1, :]
+    return logits.value[:, -1, :], caches
+
+
+def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
+                temperature, eos_id, key):
+    """The traced decode body (prefill + scan); callable from both the
+    generate() jit and the exportable GreedyDecoder layer. ``ids`` is a
+    jnp [B, S_prompt] int array; returns jnp [B, S_prompt + max_new]."""
+    cfg = net.config
+    B, S_prompt = ids.shape[0], ids.shape[1]  # no int(): jnp accepts dims
+    S_max = S_prompt + max_new
+    logits, caches = _alloc_and_prefill(net, ids, S_max)
     if do_sample:  # greedy never reads the key: keep it out of the
         key, sub = jax.random.split(key)  # program entirely (smaller
     else:  # exported StableHLO, no per-token threefry work)
@@ -116,8 +125,102 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
     )
 
 
+def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id):
+    """Beam search with the beams folded into the batch dim ([B*k] rows
+    share one compiled program with everything else): each step scores
+    [B, k*V], takes the top k continuations, and GATHERS the KV caches
+    by surviving-beam index inside the scan. A finished beam is frozen
+    (EOS emits with logprob 0, everything else -inf) so its score stays
+    comparable. Returns the best beam per batch, [B, S_prompt+max_new].
+    """
+    cfg = net.config
+    B, S_prompt = ids.shape[0], ids.shape[1]
+    k = num_beams
+    S_max = S_prompt + max_new
+    NEG = jnp.float32(-1e30)
+
+    logits, caches = _alloc_and_prefill(net, ids, S_max)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [B,V]
+    V = logp.shape[-1]
+    # first expansion: top-k tokens per batch seed the beams
+    scores, tok0 = jax.lax.top_k(logp, k)  # [B, k]
+    finished = (
+        (tok0 == eos_id) if has_eos else jnp.zeros((B, k), bool)
+    )
+    # beams share the prompt cache: tile to [B*k]
+    flat = [
+        jnp.repeat(a, k, axis=0) for kv in caches for a in kv
+    ]
+    # fixed-size token buffer (scan carries cannot grow): column t holds
+    # generation step t, written via dynamic_update_slice
+    beam_toks = jnp.zeros((B, k, max_new), jnp.int32).at[:, :, 0].set(
+        tok0.astype(jnp.int32)
+    )
+
+    def step(carry, _):
+        scores, beam_toks, flat, finished, pos = carry
+        col = pos - S_prompt  # previous step's column
+        tok = jax.lax.dynamic_slice_in_dim(
+            beam_toks, col, 1, axis=2
+        )[..., 0].reshape(B * k)
+        caches = [
+            (flat[2 * i], flat[2 * i + 1])
+            for i in range(cfg.num_hidden_layers)
+        ]
+        with tape.trace_scope(), tape.no_grad():
+            logits, caches = net(
+                Tensor(tok[:, None]), caches=caches, pos=pos
+            )
+        lp = jax.nn.log_softmax(
+            logits.value[:, -1, :].astype(jnp.float32), axis=-1
+        ).reshape(B, k, V)
+        if has_eos:
+            # frozen beams: only EOS continues, at no cost
+            frozen = jnp.full((V,), NEG).at[eos_id].set(0.0)
+            lp = jnp.where(finished[..., None], frozen[None, None, :], lp)
+        total = scores[..., None] + lp  # [B, k, V]
+        scores2, idx = jax.lax.top_k(total.reshape(B, k * V), k)
+        src_beam = idx // V  # [B, k] which beam each winner extends
+        tok2 = (idx % V).astype(jnp.int32)
+        # reorder everything by surviving beam
+        gather = jnp.take_along_axis
+        beam_toks2 = gather(
+            beam_toks, src_beam[..., None], axis=1
+        )
+        z = jnp.zeros((), col.dtype)
+        beam_toks2 = jax.lax.dynamic_update_slice(
+            beam_toks2, tok2[..., None], (z, z, col + 1)
+        )
+        finished2 = gather(finished, src_beam, axis=1) if has_eos else (
+            finished
+        )
+        if has_eos:
+            finished2 = finished2 | (tok2 == eos_id)
+        # global row index of each surviving beam's cache — gathered
+        # from the POST-write caches (they hold this step's k/v)
+        written = [a for kv in caches for a in kv]
+        rows = (
+            jnp.arange(B)[:, None] * k + src_beam
+        ).reshape(B * k)
+        flat2 = [a[rows] for a in written]
+        return (scores2, beam_toks2, flat2, finished2, pos + 1), None
+
+    if max_new > 1:
+        (scores, beam_toks, _, _, _), _ = jax.lax.scan(
+            step,
+            (scores, beam_toks, flat, finished, jnp.int32(S_prompt)),
+            None, length=max_new - 1,
+        )
+    # lax.top_k keeps beams sorted by score descending at every step,
+    # so beam 0 IS the best beam
+    chosen = beam_toks[:, 0, :]
+    return jnp.concatenate(
+        [ids.astype(jnp.int32), chosen.astype(jnp.int32)], axis=1
+    )
+
+
 def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
-                  top_p, has_eos):
+                  top_p, has_eos, num_beams=1):
     """Whole-generate program for one shape signature. The compiled fn
     is cached ON the net (``net._generate_cache``) so its lifetime is
     the model's — no module-global registry pinning dropped models
@@ -127,6 +230,9 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
     def run(params, buffers, ids, temperature, eos_id, key):
         net.load_functional_state(params, buffers)
         net.eval()
+        if num_beams > 1:
+            return _beam_decode_ids(net, ids, max_new, num_beams,
+                                    has_eos, eos_id)
         return _decode_ids(net, ids, max_new, do_sample, top_k, top_p,
                            has_eos, temperature, eos_id, key)
 
@@ -201,18 +307,31 @@ class GreedyDecoder:
 
 def generate(net, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             seed=0):
-    """Greedy / top-k-sampling decode. Returns Tensor [B, S + new]."""
+             seed=0, num_beams=1):
+    """Greedy / top-k/top-p sampling / beam-search decode.
+    Returns Tensor [B, S + new]."""
     ids = input_ids.value if isinstance(input_ids, Tensor) else jnp.asarray(
         input_ids
     )
     B, S = int(ids.shape[0]), int(ids.shape[1])
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if num_beams > 1 and do_sample:
+        raise ValueError(
+            "num_beams > 1 is deterministic beam search; combine with "
+            "do_sample=False (sampled beam search is not implemented)"
+        )
     cache = net.__dict__.setdefault("_generate_cache", {})
-    sig = (B, S, int(max_new_tokens), bool(do_sample), int(top_k),
-           float(top_p) if top_p is not None else 1.0,
-           eos_token_id is not None)
+    if num_beams > 1:
+        # sampling knobs are ignored by the beam program: normalize them
+        # out of the compile key so irrelevant differences don't force a
+        # recompile of a byte-identical whole-decode program
+        sig = (B, S, int(max_new_tokens), False, 0, 1.0,
+               eos_token_id is not None, int(num_beams))
+    else:
+        sig = (B, S, int(max_new_tokens), bool(do_sample), int(top_k),
+               float(top_p) if top_p is not None else 1.0,
+               eos_token_id is not None, 1)
     fn = cache.get(sig)
     if fn is None:
         fn = cache[sig] = _build_decode(net, *sig)

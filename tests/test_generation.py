@@ -236,3 +236,70 @@ def test_greedy_decoder_rejects_polymorphic_spec(net):
     with pytest.raises(ValueError, match="shape-specialized"):
         dec.save("/tmp/x", input_spec=[InputSpec([None, 4], "int32",
                                                  "ids")])
+
+
+def _naive_beam(net, ids, n, k):
+    """Reference beam search via full re-forward (no cache): same
+    algorithm as the compiled path, independent implementation."""
+    B = ids.shape[0]
+    assert B == 1  # keep the reference simple
+    with tape.no_grad():
+        logits = np.asarray(net(Tensor(jnp.asarray(ids))).numpy())
+    lp = logits[0, -1] - _logsumexp(logits[0, -1])
+    order = np.argsort(-lp)[:k]
+    beams = [(lp[t], [int(t)]) for t in order]
+    for _ in range(n - 1):
+        cand = []
+        for score, toks in beams:
+            seq = np.concatenate([ids, np.asarray(toks)[None]], axis=1)
+            with tape.no_grad():
+                lg = np.asarray(net(Tensor(jnp.asarray(seq))).numpy())
+            lp = lg[0, -1] - _logsumexp(lg[0, -1])
+            for t in np.argsort(-lp)[: k]:
+                cand.append((score + lp[t], toks + [int(t)]))
+        cand.sort(key=lambda c: -c[0])
+        beams = cand[:k]
+    best = max(beams, key=lambda c: c[0])
+    return np.concatenate([ids[0], np.asarray(best[1])])
+
+
+def _logsumexp(x):
+    m = x.max()
+    return m + np.log(np.exp(x - m).sum())
+
+
+def test_beam_search_matches_naive_reference(net):
+    prompt = RNG.randint(0, 64, (1, 5))
+    want = _naive_beam(net, prompt, 5, 3)
+    got = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5,
+        num_beams=3).numpy())[0]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_beam_search_batch_and_eos(net):
+    prompt = RNG.randint(0, 64, (2, 4))
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4,
+        num_beams=2).numpy())
+    assert out.shape == (2, 8)
+    # eos freeze: declaring the winning beam's first token the eos must
+    # PIN the rest of that sequence to eos (frozen-beam continuation)
+    eos = int(out[0, 4])
+    got = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt[:1])), max_new_tokens=4, num_beams=2,
+        eos_token_id=eos).numpy())
+    assert got.shape == (1, 8)
+    # freeze invariant: once the winning beam emits eos, every later
+    # position is eos (a frozen beam can only continue with eos)
+    gen = got[0, 4:]
+    hits = np.where(gen == eos)[0]
+    if hits.size:
+        assert (gen[hits[0]:] == eos).all(), got
+
+
+def test_beam_search_rejects_sampling(net):
+    prompt = RNG.randint(0, 64, (1, 4))
+    with pytest.raises(ValueError, match="beam"):
+        net.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=2,
+                     num_beams=2, do_sample=True)
