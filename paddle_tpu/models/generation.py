@@ -245,11 +245,12 @@ def _make_greedy_mod():
     class _GreedyMod(nn.Layer):
         """forward(ids) -> full decoded ids; see GreedyDecoder."""
 
-        def __init__(self, net, max_new, eos):
+        def __init__(self, net, max_new, eos, num_beams=1):
             super().__init__()
             self.net = net
             self.max_new = max_new
             self.eos = eos
+            self.num_beams = num_beams
             # export must not flip the wrapped model's mode: jit.save
             # restores the OWNER's (this wrapper's) training flag onto
             # the whole tree afterwards, so mirror the net's mode here
@@ -260,12 +261,18 @@ def _make_greedy_mod():
 
         def forward(self, ids):
             v = ids.value if isinstance(ids, Tensor) else jnp.asarray(ids)
-            out = _decode_ids(
-                self.net, v, self.max_new, False, 0, 1.0,
-                self.eos is not None, jnp.float32(1.0),
-                jnp.int32(self.eos if self.eos is not None else -1),
-                jax.random.PRNGKey(0),
-            )
+            eos = jnp.int32(self.eos if self.eos is not None else -1)
+            if self.num_beams > 1:
+                out = _beam_decode_ids(
+                    self.net, v, self.max_new, self.num_beams,
+                    self.eos is not None, eos,
+                )
+            else:
+                out = _decode_ids(
+                    self.net, v, self.max_new, False, 0, 1.0,
+                    self.eos is not None, jnp.float32(1.0), eos,
+                    jax.random.PRNGKey(0),
+                )
             return Tensor(out)
 
     return _GreedyMod
@@ -277,16 +284,18 @@ class GreedyDecoder:
     Wraps a LlamaForCausalLM so the WHOLE decode (prefill + KV-cache
     scan) exports through ``paddle.jit.save`` as one StableHLO program
     and serves through ``inference.create_predictor`` — the deploy
-    chain for generation. Greedy only (deployment-deterministic; no
-    RNG input). Decode programs are shape-specialized: export with a
-    concrete [B, S_prompt] InputSpec.
+    chain for generation. Greedy or deterministic beam search
+    (``num_beams > 1``) — both RNG-free, so artifacts are
+    deployment-deterministic. Decode programs are shape-specialized:
+    export with a concrete [B, S_prompt] InputSpec.
     """
 
-    def __init__(self, net, max_new_tokens, eos_token_id=None):
+    def __init__(self, net, max_new_tokens, eos_token_id=None,
+                 num_beams=1):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.layer = _make_greedy_mod()(
-            net, int(max_new_tokens), eos_token_id
+            net, int(max_new_tokens), eos_token_id, int(num_beams)
         )
 
     def save(self, path, input_spec):

@@ -303,3 +303,25 @@ def test_beam_search_rejects_sampling(net):
     with pytest.raises(ValueError, match="beam"):
         net.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=2,
                      num_beams=2, do_sample=True)
+
+
+def test_beam_decoder_exports_and_serves(net, tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models.generation import GreedyDecoder
+    from paddle_tpu.static import InputSpec
+
+    prompt = RNG.randint(0, 64, (1, 5)).astype(np.int32)
+    want = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4,
+        num_beams=3).numpy())
+
+    dec = GreedyDecoder(net, max_new_tokens=4, num_beams=3)
+    prefix = str(tmp_path / "beamdec")
+    dec.save(prefix, input_spec=[InputSpec([1, 5], "int32", "ids")])
+    pred = create_predictor(
+        Config(prefix + ".stablehlo", prefix + ".pdiparams")
+    )
+    pred.get_input_handle("ids").copy_from_cpu(prompt)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_array_equal(got, want)
