@@ -11,6 +11,7 @@ from __future__ import annotations
 
 __version__ = "0.5.0"  # keep in sync with pyproject.toml
 
+from .core import jax_compat as _jax_compat  # noqa: F401  (shims first)
 from . import ops as _ops_ns
 from .core import dtypes as _dtypes
 from .core import tensor as _tensor_mod
@@ -220,4 +221,5 @@ from .hapi.summary import flops, summary  # noqa: E402
 from . import incubate  # noqa: E402
 from . import inference  # noqa: E402
 from . import models  # noqa: E402
+from . import serving  # noqa: E402
 from . import sparse  # noqa: E402

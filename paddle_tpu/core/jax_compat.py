@@ -1,0 +1,57 @@
+"""Forward-API shims for older jax runtimes.
+
+The framework targets the modern jax surface (``jax.shard_map`` with
+``check_vma``/``axis_names`` — pyproject floors at jax>=0.9), but some
+deployment images pin older jax lines where that spelling does not
+exist yet (0.4.x ships ``jax.experimental.shard_map.shard_map`` with
+``check_rep``/``auto``). This module installs a translating alias ONCE
+at package import when — and only when — the modern name is missing, so
+every internal call site keeps the single modern spelling:
+
+- ``check_vma=`` maps to ``check_rep=`` (same meaning, renamed
+  upstream);
+- ``axis_names={...}`` (manual over a SUBSET of mesh axes) has no safe
+  legacy equivalent: 0.4.x's experimental ``auto=`` miscompiles or
+  hard-aborts the process on the nested-shard_map programs this
+  framework builds (ring attention inside the compiled pipeline), so
+  the alias REFUSES partial-manual requests with a clear
+  NotImplementedError instead — a clean per-test failure on old
+  images, never a crashed interpreter.
+
+On a jax that already has ``jax.shard_map`` this module is a no-op.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map_alias():
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None,
+                  auto=None, **kw):
+        rep = check_rep if check_rep is not None else check_vma
+        kwargs = dict(kw)
+        if auto is None and axis_names is not None:
+            auto = frozenset(
+                getattr(mesh, "axis_names", ())
+            ) - frozenset(axis_names)
+        if auto:
+            raise NotImplementedError(
+                "partial-manual shard_map (axis_names/auto over a "
+                "subset of mesh axes) requires jax >= 0.6; this legacy "
+                "runtime only supports manual-over-all-axes shard_map"
+            )
+        if rep is not None:
+            kwargs["check_rep"] = rep
+        return _legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            **kwargs,
+        )
+
+    jax.shard_map = shard_map
+
+
+if not hasattr(jax, "shard_map"):  # pragma: no branch
+    _install_shard_map_alias()

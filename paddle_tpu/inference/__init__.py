@@ -180,7 +180,9 @@ class Predictor:
         self._config = config
         with open(prefix + ".json") as f:
             meta = json.load(f)
-        n_in = len(meta.get("input_specs", []))
+        # kept whole: into_engine() reads the artifact's [B, S] shape
+        self._input_specs = meta.get("input_specs", [])
+        n_in = len(self._input_specs)
         names = meta.get("input_names")
         self._input_names = list(names) if names else [
             f"input_{i}" for i in range(n_in)
@@ -237,6 +239,19 @@ class Predictor:
 
     def try_shrink_memory(self):
         pass
+
+    # ------------------------------------------------------------ serving
+    def into_engine(self, **kwargs):
+        """Serve this saved decode artifact through the
+        ``paddle_tpu.serving`` request surface: returns a
+        :class:`serving.StaticBatchEngine` that queues requests with
+        backpressure/deadlines/metrics and runs them in batches of the
+        artifact's fixed batch size. (A saved program is one
+        shape-specialized whole-decode computation, so true continuous
+        batching needs the live net — ``serving.ServingEngine``.)"""
+        from ..serving import StaticBatchEngine
+
+        return StaticBatchEngine(self, **kwargs)
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -12,6 +12,7 @@ import os
 import numpy as np
 
 import jax
+import jax.export  # explicit: a submodule, not auto-imported on jax<0.5
 import jax.numpy as jnp
 
 from ..core import random as random_mod

@@ -401,10 +401,14 @@ def while_impl(cond_fn, body_fn, loop_vars, names=None, where="while_loop",
             # unconditionally-executed body: with where, a body op that
             # is NaN on the frozen carry (sqrt/log/division one step past
             # the exit) poisons reverse-mode through 0*NaN; with cond the
-            # stale body does not run. Caveat: under a batching
-            # transform (jax.vmap) cond lowers to select_n and both arms
-            # execute again — vmapping a bounded loop whose body is
-            # NaN past the exit reinstates the hazard.
+            # stale body does not run. Batching note: under jax.vmap,
+            # cond lowers to a select over both arms, but the
+            # transpose routes zero cotangents to the unselected arm
+            # WITHOUT reintroducing 0*NaN — vmapped grads of a bounded
+            # loop stay finite (pinned by test_dy2static::
+            # test_while_loop_masked_scan_vmap_grads_stay_finite; if a
+            # jax upgrade ever breaks that test, this guarantee is the
+            # thing that regressed).
             def scan_body(carry, _):
                 leaves, done = carry
                 cont = jnp.logical_and(cond_wrapped(leaves), ~done)

@@ -118,7 +118,21 @@ def _pallas_ok(q, k, v, causal):
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
-    """q/k/v: [B, S, H, D] -> [B, S, H, D]."""
+    """q/k/v: [B, S, H, D] -> [B, S, H, D].
+
+    q and k/v may arrive in different dtypes (bf16 KV caches from the
+    serving pool / ``generate(cache_dtype=...)``, or fp32 caches under
+    a bf16-activation model): align everything to the PROMOTED dtype —
+    always widening, never rounding a wider cache down — so the Pallas
+    kernel sees uniform operands and the composed path gets exactly the
+    promotion XLA would insert."""
+    ct = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype), v.dtype)
+    if q.dtype != ct:
+        q = q.astype(ct)
+    if k.dtype != ct:
+        k = k.astype(ct)
+    if v.dtype != ct:
+        v = v.astype(ct)
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _pallas_ok(q, k, v, causal):

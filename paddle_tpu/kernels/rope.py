@@ -46,13 +46,25 @@ def _seq_block(s, h, d):
 def _rope_apply(x, cos, sin):
     b, s, h, d = x.shape
     sb = _seq_block(s, h, d)
+    # cos/sin are [1, S, 1, D/2] (one table shared across the batch) or
+    # [B, S, 1, D/2] (per-row position gathers — the serving engine's
+    # continuous-batching decode, where every batch slot sits at its own
+    # position); a shared table always reads batch row 0
+    cb = cos.shape[0]
+    if cb not in (1, b):
+        raise ValueError(
+            f"rope cos/sin batch dim must be 1 or {b}, got {cb}"
+        )
+    tab = (lambda i, k: (i, k, 0, 0)) if cb == b else (
+        lambda i, k: (0, k, 0, 0)
+    )
     out = pl.pallas_call(
         _rope_kernel,
         grid=(b, s // sb),
         in_specs=[
             pl.BlockSpec((1, sb, h, d), lambda i, k: (i, k, 0, 0)),
-            pl.BlockSpec((1, sb, 1, d // 2), lambda i, k: (0, k, 0, 0)),
-            pl.BlockSpec((1, sb, 1, d // 2), lambda i, k: (0, k, 0, 0)),
+            pl.BlockSpec((1, sb, 1, d // 2), tab),
+            pl.BlockSpec((1, sb, 1, d // 2), tab),
         ],
         out_specs=pl.BlockSpec((1, sb, h, d), lambda i, k: (i, k, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, s, h, d), x.dtype),

@@ -27,6 +27,9 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
+        # clamp: top_k >= vocab keeps every token (reference generate
+        # semantics) instead of an out-of-bounds sort index at trace time
+        top_k = min(int(top_k), int(logits.shape[-1]))
         kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)][:, None]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
     if top_p is not None and top_p < 1.0:
@@ -45,38 +48,79 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 
-def _alloc_and_prefill(net, ids, S_max):
-    """Allocate the per-layer static KV buffers and run the prompt
-    through in one pass (caches filled [0, S_prompt)). Shared by the
-    greedy/sampling and beam decode bodies — ONE place owns the cache
-    layout. Returns (last-position logits [B, V], caches)."""
-    cfg = net.config
-    B = ids.shape[0]
-    caches = [
+DEFAULT_CACHE_DTYPE = "bfloat16"
+
+
+def alloc_kv_caches(cfg, B, S_max, cache_dtype=None):
+    """Per-layer static KV buffers [B, S_max, kvH, D] x num_layers.
+
+    ONE place owns the serving cache layout and dtype: the whole-decode
+    programs here, the serving engine's slot slab, and the bucketed
+    ``serving.kv_pool`` blocks all allocate through this (bf16 default —
+    halves decode HBM vs the old unconditional fp32; the attention path
+    upcasts to the compute dtype at the matmul)."""
+    dtype = jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE)
+    return [
         (
-            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
-                      jnp.float32),
-            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim),
-                      jnp.float32),
+            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim), dtype),
+            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim), dtype),
         )
         for _ in range(cfg.num_hidden_layers)
     ]
+
+
+def prefill(net, ids, caches, length=None):
+    """Run the prompt through the cache path in one pass (caches filled
+    [0, S)). ``ids`` may be right-padded to a bucket length: pass
+    ``length`` (scalar, traceable) and the returned logits row is taken
+    at position ``length - 1`` instead of the last column — pad tokens
+    only ever write cache slots that decode overwrites before reading
+    (causal masking), so bucketed prefill is numerically exact.
+    Returns (next-token logits [B, V], caches)."""
     with tape.trace_scope(), tape.no_grad():
         logits, caches = net(
             Tensor(ids), caches=caches, pos=jnp.int32(0)
         )
+    lv = logits.value
+    if length is None:
+        return lv[:, -1, :], caches
+    row = jax.lax.dynamic_index_in_dim(
+        lv, jnp.asarray(length, jnp.int32) - 1, axis=1, keepdims=False
+    )
+    return row, caches
+
+
+def decode_step(net, tok, caches, pos):
+    """One KV-cache decode step — the reusable hot-loop body shared by
+    the whole-decode scan below and ``serving.ServingEngine``'s compiled
+    step program. ``tok`` [B, 1] int32; ``pos`` is a scalar (whole-batch
+    decode) or an int32 [B] vector (continuous batching: every row sits
+    at its own depth). Cache-dtype-aware: writes cast to the cache's
+    dtype, reads upcast at the matmul. Returns (logits [B, V], caches).
+    """
+    with tape.trace_scope(), tape.no_grad():
+        logits, caches = net(Tensor(tok), caches=caches, pos=pos)
     return logits.value[:, -1, :], caches
 
 
+def _alloc_and_prefill(net, ids, S_max, cache_dtype=None):
+    """Allocate the per-layer static KV buffers and run the prompt
+    through in one pass (caches filled [0, S_prompt)). Shared by the
+    greedy/sampling and beam decode bodies — ONE place owns the cache
+    layout. Returns (last-position logits [B, V], caches)."""
+    caches = alloc_kv_caches(net.config, ids.shape[0], S_max, cache_dtype)
+    return prefill(net, ids, caches)
+
+
 def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
-                temperature, eos_id, key):
+                temperature, eos_id, key, cache_dtype=None):
     """The traced decode body (prefill + scan); callable from both the
     generate() jit and the exportable GreedyDecoder layer. ``ids`` is a
     jnp [B, S_prompt] int array; returns jnp [B, S_prompt + max_new]."""
     cfg = net.config
     B, S_prompt = ids.shape[0], ids.shape[1]  # no int(): jnp accepts dims
     S_max = S_prompt + max_new
-    logits, caches = _alloc_and_prefill(net, ids, S_max)
+    logits, caches = _alloc_and_prefill(net, ids, S_max, cache_dtype)
     if do_sample:  # greedy never reads the key: keep it out of the
         key, sub = jax.random.split(key)  # program entirely (smaller
     else:  # exported StableHLO, no per-token threefry work)
@@ -95,11 +139,7 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
             (flat[2 * i], flat[2 * i + 1])
             for i in range(cfg.num_hidden_layers)
         ]
-        with tape.trace_scope(), tape.no_grad():
-            logits, caches = net(
-                Tensor(tok[:, None]), caches=caches, pos=pos
-            )
-        logits = logits.value[:, -1, :]
+        logits, caches = decode_step(net, tok[:, None], caches, pos)
         if do_sample:
             key, sub = jax.random.split(key)
         else:
@@ -125,7 +165,8 @@ def _decode_ids(net, ids, max_new, do_sample, top_k, top_p, has_eos,
     )
 
 
-def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id):
+def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id,
+                     cache_dtype=None):
     """Beam search with the beams folded into the batch dim ([B*k] rows
     share one compiled program with everything else): each step scores
     [B, k*V], takes the top k continuations, and GATHERS the KV caches
@@ -139,7 +180,7 @@ def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id):
     S_max = S_prompt + max_new
     NEG = jnp.float32(-1e30)
 
-    logits, caches = _alloc_and_prefill(net, ids, S_max)
+    logits, caches = _alloc_and_prefill(net, ids, S_max, cache_dtype)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [B,V]
     V = logp.shape[-1]
     # first expansion: top-k tokens per batch seed the beams
@@ -167,12 +208,9 @@ def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id):
             (flat[2 * i], flat[2 * i + 1])
             for i in range(cfg.num_hidden_layers)
         ]
-        with tape.trace_scope(), tape.no_grad():
-            logits, caches = net(
-                Tensor(tok[:, None]), caches=caches, pos=pos
-            )
+        logits, caches = decode_step(net, tok[:, None], caches, pos)
         lp = jax.nn.log_softmax(
-            logits.value[:, -1, :].astype(jnp.float32), axis=-1
+            logits.astype(jnp.float32), axis=-1
         ).reshape(B, k, V)
         if has_eos:
             # frozen beams: only EOS continues, at no cost
@@ -220,7 +258,8 @@ def _beam_decode_ids(net, ids, max_new, num_beams, has_eos, eos_id):
 
 
 def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
-                  top_p, has_eos, num_beams=1):
+                  top_p, has_eos, num_beams=1,
+                  cache_dtype=DEFAULT_CACHE_DTYPE):
     """Whole-generate program for one shape signature. The compiled fn
     is cached ON the net (``net._generate_cache``) so its lifetime is
     the model's — no module-global registry pinning dropped models
@@ -232,9 +271,11 @@ def _build_decode(net, B, S_prompt, max_new, do_sample, top_k,
         net.eval()
         if num_beams > 1:
             return _beam_decode_ids(net, ids, max_new, num_beams,
-                                    has_eos, eos_id)
+                                    has_eos, eos_id,
+                                    cache_dtype=cache_dtype)
         return _decode_ids(net, ids, max_new, do_sample, top_k, top_p,
-                           has_eos, temperature, eos_id, key)
+                           has_eos, temperature, eos_id, key,
+                           cache_dtype=cache_dtype)
 
     return jax.jit(run)
 
@@ -245,12 +286,14 @@ def _make_greedy_mod():
     class _GreedyMod(nn.Layer):
         """forward(ids) -> full decoded ids; see GreedyDecoder."""
 
-        def __init__(self, net, max_new, eos, num_beams=1):
+        def __init__(self, net, max_new, eos, num_beams=1,
+                     cache_dtype=DEFAULT_CACHE_DTYPE):
             super().__init__()
             self.net = net
             self.max_new = max_new
             self.eos = eos
             self.num_beams = num_beams
+            self.cache_dtype = cache_dtype
             # export must not flip the wrapped model's mode: jit.save
             # restores the OWNER's (this wrapper's) training flag onto
             # the whole tree afterwards, so mirror the net's mode here
@@ -266,12 +309,14 @@ def _make_greedy_mod():
                 out = _beam_decode_ids(
                     self.net, v, self.max_new, self.num_beams,
                     self.eos is not None, eos,
+                    cache_dtype=self.cache_dtype,
                 )
             else:
                 out = _decode_ids(
                     self.net, v, self.max_new, False, 0, 1.0,
                     self.eos is not None, jnp.float32(1.0), eos,
                     jax.random.PRNGKey(0),
+                    cache_dtype=self.cache_dtype,
                 )
             return Tensor(out)
 
@@ -291,11 +336,12 @@ class GreedyDecoder:
     """
 
     def __init__(self, net, max_new_tokens, eos_token_id=None,
-                 num_beams=1):
+                 num_beams=1, cache_dtype=DEFAULT_CACHE_DTYPE):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.layer = _make_greedy_mod()(
-            net, int(max_new_tokens), eos_token_id, int(num_beams)
+            net, int(max_new_tokens), eos_token_id, int(num_beams),
+            str(jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE)),
         )
 
     def save(self, path, input_spec):
@@ -316,9 +362,13 @@ class GreedyDecoder:
 
 def generate(net, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             seed=0, num_beams=1):
+             seed=0, num_beams=1, cache_dtype=DEFAULT_CACHE_DTYPE):
     """Greedy / top-k/top-p sampling / beam-search decode.
-    Returns Tensor [B, S + new]."""
+    Returns Tensor [B, S + new].
+
+    ``cache_dtype``: KV-cache storage dtype (default bf16 — half the
+    decode HBM of fp32; attention upcasts at the matmul). Pass
+    ``"float32"`` for bit-exact parity with the cacheless forward."""
     ids = input_ids.value if isinstance(input_ids, Tensor) else jnp.asarray(
         input_ids
     )
@@ -330,17 +380,18 @@ def generate(net, input_ids, max_new_tokens=32, do_sample=False,
             "num_beams > 1 is deterministic beam search; combine with "
             "do_sample=False (sampled beam search is not implemented)"
         )
+    cache_dtype = str(jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE))
     cache = net.__dict__.setdefault("_generate_cache", {})
     if num_beams > 1:
         # sampling knobs are ignored by the beam program: normalize them
         # out of the compile key so irrelevant differences don't force a
         # recompile of a byte-identical whole-decode program
         sig = (B, S, int(max_new_tokens), False, 0, 1.0,
-               eos_token_id is not None, int(num_beams))
+               eos_token_id is not None, int(num_beams), cache_dtype)
     else:
         sig = (B, S, int(max_new_tokens), bool(do_sample), int(top_k),
                float(top_p) if top_p is not None else 1.0,
-               eos_token_id is not None, 1)
+               eos_token_id is not None, 1, cache_dtype)
     fn = cache.get(sig)
     if fn is None:
         fn = cache[sig] = _build_decode(net, *sig)

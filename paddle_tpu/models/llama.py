@@ -18,6 +18,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -92,35 +93,53 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
         k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
         v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        pos_ids = None
+        if cache is not None:
+            p0 = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
+            if p0.ndim:  # per-row decode depths: gather rope rows by id
+                pos_ids = p0[:, None] + jnp.arange(S)[None, :]
         q, k, _ = IF.fused_rotary_position_embedding(
             q, k, None, sin=rope_sin, cos=rope_cos,
-            rotary_emb_base=cfg.rope_theta,
+            position_ids=pos_ids, rotary_emb_base=cfg.rope_theta,
         )
         if cache is not None:
-            import jax
-            import jax.numpy as jnp
-
             k_cache, v_cache = cache
             S_max = k_cache.shape[1]
             p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
-            z = jnp.zeros((), p.dtype)  # index dtypes must all match p's
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.value.astype(k_cache.dtype), (z, p, z, z)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.value.astype(v_cache.dtype), (z, p, z, z)
-            )
+            if p.ndim == 0:
+                # whole-batch position (generate's prefill + scan)
+                z = jnp.zeros((), p.dtype)  # index dtypes must match p's
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k.value.astype(k_cache.dtype), (z, p, z, z)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v.value.astype(v_cache.dtype), (z, p, z, z)
+                )
+                # mask[t, s]: token (p+t) may read cache slot s iff s <= p+t
+                valid = (
+                    jnp.arange(S_max)[None, :]
+                    <= (p + jnp.arange(S))[:, None]
+                )
+                mask = jnp.where(valid, 0.0, -jnp.inf)[None, None, :, :]
+            else:
+                # per-row positions [B] (continuous batching: each batch
+                # slot sits at its own decode depth) — scatter the new
+                # k/v at every row's own offset
+                rows = jnp.arange(B)[:, None]
+                cols = p[:, None] + jnp.arange(S)[None, :]  # [B, S]
+                k_cache = k_cache.at[rows, cols].set(
+                    k.value.astype(k_cache.dtype)
+                )
+                v_cache = v_cache.at[rows, cols].set(
+                    v.value.astype(v_cache.dtype)
+                )
+                valid = jnp.arange(S_max)[None, None, :] <= cols[:, :, None]
+                mask = jnp.where(valid, 0.0, -jnp.inf)[:, None, :, :]
             kk, vv = Tensor(k_cache), Tensor(v_cache)
             if cfg.kv_heads != cfg.num_attention_heads:
                 rep = cfg.num_attention_heads // cfg.kv_heads
                 kk = kk.repeat_interleave(rep, axis=2)
                 vv = vv.repeat_interleave(rep, axis=2)
-            # mask[t, s]: token (p + t) may read cache slot s iff s <= p+t
-            valid = (
-                jnp.arange(S_max)[None, :]
-                <= (p + jnp.arange(S))[:, None]
-            )
-            mask = jnp.where(valid, 0.0, -jnp.inf)[None, None, :, :]
             if attn_mask is not None:
                 # combine with a user mask (e.g. left-padded prompts);
                 # must broadcast over [B, H, S, S_max] in cache mode
@@ -205,17 +224,17 @@ class LlamaModel(nn.Layer):
         from ..kernels.rope import build_rope_cache
 
         if caches is not None:
-            import jax
-            import jax.numpy as jnp
-
             S_max = caches[0][0].shape[1]
             cos, sin = build_rope_cache(
                 S_max, cfg.head_dim, base=cfg.rope_theta
             )
             p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
-            # rope rows for the tokens being fed: [p, p+S)
-            cos = jax.lax.dynamic_slice_in_dim(cos, p, S, axis=1)
-            sin = jax.lax.dynamic_slice_in_dim(sin, p, S, axis=1)
+            if p.ndim == 0:
+                # rope rows for the tokens being fed: [p, p+S)
+                cos = jax.lax.dynamic_slice_in_dim(cos, p, S, axis=1)
+                sin = jax.lax.dynamic_slice_in_dim(sin, p, S, axis=1)
+            # else: per-row positions — pass the full tables; attention
+            # gathers each row's slice via rope position_ids
             cos_t, sin_t = Tensor(cos), Tensor(sin)
             h = self.embed_tokens(input_ids)
             new_caches = []
@@ -278,7 +297,8 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=0, num_beams=1):
+                 seed=0, num_beams=1, cache_dtype=None):
+        from .generation import DEFAULT_CACHE_DTYPE
         from .generation import generate as _generate
 
         return _generate(
@@ -286,5 +306,6 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
             do_sample=do_sample, temperature=temperature, top_k=top_k,
             top_p=top_p, num_beams=num_beams,
             eos_token_id=eos_token_id, seed=seed,
+            cache_dtype=cache_dtype or DEFAULT_CACHE_DTYPE,
         )
 

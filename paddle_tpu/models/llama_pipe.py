@@ -175,6 +175,20 @@ class LlamaForCausalLMPipe(LlamaFlopsMixin, PipelineLayer):
         from ..core.lazy import LazyGuard
 
         cfg = self.config
+        if cfg.tie_word_embeddings:
+            # the pipe ALWAYS trains a separate head (its suffix
+            # ColumnParallelLinear); a tied LlamaForCausalLM has
+            # lm_head=None and serves embed_tokens.T — the trained head
+            # would be silently dropped and every logit wrong
+            raise ValueError(
+                "to_causal_lm: config.tie_word_embeddings=True cannot "
+                "be converted — LlamaForCausalLMPipe trains an untied "
+                "LM head (pipeline suffix), but the tied "
+                "LlamaForCausalLM would discard it and serve "
+                "embed_tokens.T logits. Train the pipe with an untied "
+                "config, or copy the weights into a model whose head "
+                "layout matches."
+            )
         L = cfg.num_hidden_layers
         src = {k: p.value for k, p in self.named_parameters()}
         state = {

@@ -34,7 +34,17 @@ def _compile():
         if not cc:
             continue
         try:
+            # -lrt: shm_open/shm_unlink live in librt on glibc < 2.34;
+            # linking it makes the .so self-contained (without it, CDLL
+            # resolution depends on whether some earlier import happened
+            # to pull librt into the global scope — nondeterministic)
             r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC, "-lrt"],
+                capture_output=True, text=True, timeout=120,
+            )
+            if r.returncode == 0:
+                return True
+            r = subprocess.run(  # toolchains without librt (musl etc.)
                 [cc, "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
                 capture_output=True, text=True, timeout=120,
             )

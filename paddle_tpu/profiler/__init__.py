@@ -69,6 +69,25 @@ def reset_profiler_data():
         _EVENTS.clear()
 
 
+def record_span(name, dur, kind="user"):
+    """Inject an externally-timed span into the current RECORD window.
+
+    The hook ``paddle_tpu.serving.metrics`` exports through: every
+    serving histogram sample (TTFT, inter-token latency, ...) lands in
+    the same tables as RecordEvent spans, so ``Profiler.summary()`` and
+    the chrome trace show serving latencies alongside op timings. A
+    no-op (returns False) outside a RECORD window — serving keeps its
+    own counters regardless, so nothing accumulates unbounded here."""
+    if not _RECORDING.is_set():
+        return False
+    with _LOCK:
+        _HOST_TIMES[name].append(dur)
+        _EVENTS.append(
+            (name, kind, time.perf_counter() - _EPOCH - dur, dur)
+        )
+    return True
+
+
 class RecordEvent:
     """Context manager/decorator span (paddle.profiler.RecordEvent parity)."""
 

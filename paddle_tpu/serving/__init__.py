@@ -1,0 +1,34 @@
+"""paddle_tpu.serving — continuous-batching LLM inference.
+
+The layer that turns "can run a model" into "can serve a model": a
+host-side request loop over compiled fixed-shape prefill/decode-step
+programs (``engine.ServingEngine``), a bucketed KV-cache pool with bf16
+default and occupancy accounting (``kv_pool.KVCachePool``), bounded
+priority+FIFO admission with backpressure and deadlines
+(``scheduler.Scheduler``), and serving metrics exported through
+``paddle_tpu.profiler`` (``metrics.ServingMetrics``). Saved
+``jit.save`` decode artifacts serve through the same request surface
+via ``inference.Predictor.into_engine()``. Everything is pure
+Python + JAX and CPU-testable; ``tools/serve_bench.py`` replays a
+synthetic Poisson trace offline and reports throughput/latency
+percentiles.
+"""
+from .engine import ServingEngine, StaticBatchEngine  # noqa: F401
+from .kv_pool import (  # noqa: F401
+    KVBlock,
+    KVCachePool,
+    PoolExhausted,
+    bucket_for,
+)
+from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
+from .scheduler import (  # noqa: F401
+    REASON_ENGINE_CLOSED,
+    REASON_QUEUE_FULL,
+    REASON_SHAPE_MISMATCH,
+    REASON_TIMEOUT,
+    REASON_TOO_LONG,
+    RejectedError,
+    Request,
+    RequestHandle,
+    Scheduler,
+)

@@ -1,0 +1,565 @@
+"""Continuous-batching LLM serving engine.
+
+The TPU-first serving shape (cf. the kernel-fusion serving stacks in
+PAPERS.md): keep the device running ONE compiled fixed-shape decode-step
+program over a resident KV slab, and do all request lifecycle work —
+admission, retirement, deadlines, metrics — in a host-side loop between
+steps. Three compiled programs total:
+
+- **prefill** (one per power-of-two prompt bucket): runs a right-padded
+  prompt through the cache path and emits the first token. Bucketing
+  bounds compile count at O(log S_max); padding is numerically exact
+  because pad positions only ever write cache slots that decode
+  overwrites before the mask exposes them.
+- **adopt** (one per bucket): copies a prefill block into a free row of
+  the decode slab (``dynamic_update_slice`` at a traced slot index — no
+  per-slot recompiles).
+- **decode step** (exactly one): ``[max_batch]`` tokens at per-row
+  positions -> next tokens. Every row sits at its own depth — this is
+  what the vector-``pos`` cache path in ``models.llama`` exists for.
+  Free rows ride along as masked garbage (their writes land on slots
+  the next adoption overwrites), so admission and retirement NEVER
+  trigger a recompile or stall in-flight sequences.
+
+Token streams are exact-equal to ``net.generate`` (same cache dtype):
+the per-row program computes the same attention over the same masked
+cache, so continuous batching is a scheduling optimization, not an
+accuracy trade. The tier-1 serving test pins this token-for-token.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import profiler
+from ..models.generation import (
+    DEFAULT_CACHE_DTYPE,
+    _select_next,
+    alloc_kv_caches,
+    decode_step,
+    prefill,
+)
+from .kv_pool import KVCachePool
+from .metrics import ServingMetrics
+from .scheduler import (
+    CANCELLED,
+    DONE,
+    REASON_ENGINE_CLOSED,
+    REASON_SHAPE_MISMATCH,
+    REASON_TIMEOUT,
+    REASON_TOO_LONG,
+    REJECTED,
+    RUNNING,
+    TIMEOUT,
+    RejectedError,
+    Request,
+    RequestHandle,
+    Scheduler,
+)
+
+
+def _flatten(caches):
+    return [a for kv in caches for a in kv]
+
+
+def _unflatten(flat):
+    return [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
+
+
+class _Seq:
+    """Host-side state of one running sequence (one slab row)."""
+
+    __slots__ = ("handle", "last_tok", "emitted")
+
+    def __init__(self, handle, first_tok):
+        self.handle = handle
+        self.last_tok = first_tok
+        self.emitted = 0  # _append counts (prefill's first token too)
+
+    @property
+    def pos(self):
+        # cache position of the token being fed next step: the last
+        # emitted token sits at prompt_len + emitted - 1
+        return self.handle.request.prompt_len + self.emitted - 1
+
+
+class ServingEngine:
+    """Continuous-batching serving over a Llama-family causal LM.
+
+    ``max_batch_size`` is the decode slab's row count (in-flight cap);
+    ``max_seq_len`` the per-row cache capacity (prompt + generated).
+    Weights are snapshotted at construction — serving a training net
+    does not race updates. Greedy by default; ``do_sample=True`` with
+    temperature/top_k/top_p reuses ``generate()``'s sampling head with
+    a per-step PRNG fold so streams stay reproducible per ``seed``.
+    """
+
+    def __init__(self, net, *, max_batch_size=8, max_seq_len=256,
+                 cache_dtype=None, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=1.0, seed=0, min_bucket=16,
+                 max_queue_size=64, max_tokens_in_flight=None,
+                 scheduler=None, metrics=None, pool=None,
+                 clock=time.monotonic):
+        cfg = net.config
+        self.net = net
+        self.config = cfg
+        self.max_batch_size = int(max_batch_size)
+        self.max_seq_len = int(max_seq_len)
+        self.clock = clock
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p) if top_p is not None else 1.0
+        self.max_tokens_in_flight = max_tokens_in_flight
+        self.pool = pool or KVCachePool(
+            cfg, dtype=cache_dtype or DEFAULT_CACHE_DTYPE,
+            min_bucket=min_bucket, max_seq_len=self.max_seq_len,
+        )
+        self.cache_dtype = self.pool.dtype
+        self.scheduler = scheduler or Scheduler(
+            max_queue_size=max_queue_size, clock=clock
+        )
+        self.metrics = metrics or ServingMetrics()
+        # weight snapshot: serving uses these, not live layer attrs
+        self._params = {k: p.value for k, p in net.named_parameters()}
+        self._buffers = {k: b.value for k, b in net.named_buffers()}
+        self._was_training = net.training
+        # resident decode slab ([N, S_max] rows claimed per request)
+        self._flat = _flatten(
+            self.pool.alloc_slab_arrays(self.max_batch_size,
+                                        self.max_seq_len)
+        )
+        self._slab = self.pool.register_slab(self.max_batch_size,
+                                             self.max_seq_len)
+        self._seqs = [None] * self.max_batch_size
+        self._key = jax.random.PRNGKey(seed)
+        self.step_count = 0
+        # donation only helps (and only works) on accelerators; on the
+        # CPU CI it would just emit unusable-donation warnings
+        accel = any(d.platform != "cpu" for d in jax.devices())
+        self._prefill_fns = {}   # bucket -> jitted fn
+        self._adopt_fns = {}     # bucket -> jitted fn
+        self._decode_fn = jax.jit(
+            self._decode_body, donate_argnums=(3,) if accel else ()
+        )
+        self._donate = accel
+        self._traced = set()
+        self._closed = False
+
+    # ------------------------------------------------- compiled programs
+    def _decode_body(self, params, buffers, tok, flat, pos, temperature,
+                     key):
+        self.net.load_functional_state(params, buffers)
+        self.net.eval()
+        logits, caches = decode_step(
+            self.net, tok[:, None], _unflatten(flat), pos
+        )
+        nxt = _select_next(logits, self.do_sample, temperature,
+                           self.top_k, self.top_p, key)
+        return nxt, _flatten(caches)
+
+    def _prefill_fn(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def body(params, buffers, ids, length, flat_block, temperature,
+                 key):
+            self.net.load_functional_state(params, buffers)
+            self.net.eval()
+            logits, caches = prefill(
+                self.net, ids, _unflatten(flat_block), length=length
+            )
+            nxt = _select_next(logits, self.do_sample, temperature,
+                               self.top_k, self.top_p, key)
+            return nxt, _flatten(caches)
+
+        fn = jax.jit(
+            body, donate_argnums=(4,) if self._donate else ()
+        )
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _adopt_fn(self, bucket):
+        fn = self._adopt_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def body(flat_decode, flat_block, slot):
+            z = jnp.zeros((), slot.dtype)
+            return [
+                jax.lax.dynamic_update_slice(
+                    d, b.astype(d.dtype), (slot, z, z, z)
+                )
+                for d, b in zip(flat_decode, flat_block)
+            ]
+
+        fn = jax.jit(
+            body, donate_argnums=(0,) if self._donate else ()
+        )
+        self._adopt_fns[bucket] = fn
+        return fn
+
+    def _run(self, trace_key, fn, *args):
+        """Invoke a jitted program; after its FIRST trace, restore the
+        net's concrete weights/mode (tracing swaps tracers into the
+        imperative Layer objects — generate()'s write-back pattern)."""
+        out = fn(*args)
+        if trace_key not in self._traced:
+            self._traced.add(trace_key)
+            self.net.load_functional_state(self._params, self._buffers)
+            if self._was_training:
+                self.net.train()
+            else:
+                self.net.eval()
+        return out
+
+    def _next_key(self):
+        if not self.do_sample:
+            return self._key
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # ---------------------------------------------------------- requests
+    def submit(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
+               priority=0, deadline_s=None):
+        """Enqueue one request; always returns a RequestHandle (status
+        REJECTED with ``.reason`` set on backpressure — submit never
+        blocks and never throws for load reasons)."""
+        req = Request(
+            input_ids, max_new_tokens, eos_token_id=eos_token_id,
+            priority=priority, deadline_s=deadline_s,
+        )
+        self.metrics.submitted.inc()
+        if self._closed:
+            h = RequestHandle(req)
+            h.submit_time = h.finish_time = self.clock()
+            h.status = REJECTED
+            h.reason = REASON_ENGINE_CLOSED
+            self.metrics.rejected.inc(label=REASON_ENGINE_CLOSED)
+            return h
+        if req.total_tokens > self.max_seq_len or (
+            self.max_tokens_in_flight is not None
+            and req.total_tokens > self.max_tokens_in_flight
+        ):
+            h = RequestHandle(req)
+            h.submit_time = h.finish_time = self.clock()
+            h.status = REJECTED
+            h.reason = REASON_TOO_LONG
+            self.metrics.rejected.inc(label=REASON_TOO_LONG)
+            return h
+        try:
+            return self.scheduler.submit(req)
+        except RejectedError as e:
+            self.metrics.rejected.inc(label=e.reason)
+            return e.handle
+
+    # --------------------------------------------------------- the loop
+    @property
+    def active_slots(self):
+        return sum(1 for s in self._seqs if s is not None)
+
+    def _tokens_in_flight(self):
+        return sum(
+            s.handle.request.total_tokens
+            for s in self._seqs if s is not None
+        )
+
+    def _finish(self, slot, status, reason=None):
+        seq = self._seqs[slot]
+        h = seq.handle
+        now = self.clock()
+        h.status = status
+        h.reason = reason
+        h.finish_time = now
+        h.finished_step = self.step_count
+        if status == DONE:
+            self.metrics.completed.inc()
+        elif status == TIMEOUT:
+            self.metrics.timeouts.inc()
+        self.metrics.e2e.observe(now - h.submit_time)
+        self._seqs[slot] = None
+        self._slab.release(slot)
+
+    def _append(self, slot, tok):
+        seq = self._seqs[slot]
+        h = seq.handle
+        h.tokens.append(int(tok))
+        seq.last_tok = int(tok)
+        seq.emitted += 1
+        self.metrics.tokens_out.inc()
+        req = h.request
+        if req.eos_token_id is not None and int(tok) == req.eos_token_id:
+            self._finish(slot, DONE)
+        elif seq.emitted >= req.max_new_tokens:
+            self._finish(slot, DONE)
+
+    def _admit_one(self, handle):
+        req = handle.request
+        now = self.clock()
+        bucket = self.pool.bucket_for(req.prompt_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, : req.prompt_len] = req.input_ids
+        blk = self.pool.alloc(req.prompt_len)
+        # claim the slot LAST, with a release guard: an exception out of
+        # admission must never strand a claimed slot (a 1-slot engine
+        # would wedge forever)
+        slot = self._slab.claim()
+        assert slot is not None  # caller checked free_slots
+        try:
+            with profiler.RecordEvent(f"serving::prefill_b{bucket}"):
+                nxt, new_flat = self._run(
+                    ("prefill", bucket), self._prefill_fn(bucket),
+                    self._params, self._buffers, jnp.asarray(ids),
+                    jnp.int32(req.prompt_len), _flatten(blk.caches),
+                    jnp.float32(self.temperature), self._next_key(),
+                )
+                blk.caches = _unflatten(new_flat)
+                self._flat = self._run(
+                    ("adopt", bucket), self._adopt_fn(bucket),
+                    self._flat, new_flat, jnp.int32(slot),
+                )
+                t0 = int(np.asarray(nxt)[0])
+        except BaseException:
+            self._slab.release(slot)
+            # under donation the failed call may already have consumed
+            # the block's buffers — recycling them would poison the
+            # bucket's freelist; drop the block instead
+            if self._donate:
+                self.pool.discard(blk)
+            else:
+                self.pool.free(blk)
+            raise
+        self.pool.free(blk)
+        handle.status = RUNNING
+        handle.admit_time = now
+        handle.admitted_step = self.step_count
+        handle.first_token_time = self.clock()
+        self.metrics.admitted.inc()
+        self.metrics.prefill_tokens.inc(req.prompt_len)
+        self.metrics.queue_wait.observe(now - handle.submit_time)
+        self.metrics.ttft.observe(handle.first_token_time
+                                  - handle.submit_time)
+        self._seqs[slot] = _Seq(handle, t0)
+        self._append(slot, t0)
+
+    def step(self):
+        """One engine iteration: retire expired, admit into free slots,
+        run one decode step over the whole slab."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed")
+        now = self.clock()
+        # running sequences past their deadline free their slot NOW
+        for i, seq in enumerate(self._seqs):
+            if seq is None:
+                continue
+            dl = self.scheduler.deadline_of(seq.handle)
+            if dl is not None and now > dl:
+                self._finish(i, TIMEOUT, reason=REASON_TIMEOUT)
+        # queued requests whose deadline passed never run at all
+        self.scheduler.sweep_expired()
+        # admission: fill free slots in priority-FIFO order under the
+        # in-flight token cap
+        while self._slab.free_slots > 0:
+            budget = None
+            if self.max_tokens_in_flight is not None:
+                budget = (self.max_tokens_in_flight
+                          - self._tokens_in_flight())
+            handle = self.scheduler.pop_next(budget)
+            if handle is None:
+                break
+            try:
+                self._admit_one(handle)
+            except BaseException as e:
+                # the handle was already popped — resolve it before
+                # propagating, or a caller polling h.finished waits
+                # forever on a request no queue holds anymore
+                handle.status = REJECTED
+                handle.reason = f"admission_error:{type(e).__name__}"
+                handle.finish_time = self.clock()
+                self.metrics.rejected.inc(label="admission_error")
+                raise
+        # single metrics channel for queued-expiry, whether the sweep or
+        # a lazy pop_next expired the request (a deadline can pass
+        # mid-step while a prefill compiles)
+        for _ in self.scheduler.drain_timed_out():
+            self.metrics.timeouts.inc()
+        # one fused decode step over every row (free rows are masked
+        # garbage; their writes land on slots adoption overwrites)
+        active = [i for i, s in enumerate(self._seqs) if s is not None]
+        if active:
+            tok = np.zeros((self.max_batch_size,), np.int32)
+            pos = np.zeros((self.max_batch_size,), np.int32)
+            for i in active:
+                tok[i] = self._seqs[i].last_tok
+                pos[i] = self._seqs[i].pos
+            t0 = self.clock()
+            with profiler.RecordEvent("serving::decode_step"):
+                nxt, self._flat = self._run(
+                    ("decode",), self._decode_fn,
+                    self._params, self._buffers, jnp.asarray(tok),
+                    self._flat, jnp.asarray(pos),
+                    jnp.float32(self.temperature), self._next_key(),
+                )
+                nxt = np.asarray(nxt)
+            dt = self.clock() - t0
+            for i in active:
+                if self._seqs[i] is None:
+                    continue  # finished by an earlier row this step
+                self.metrics.itl.observe(dt)
+                self._append(i, nxt[i])
+        self.step_count += 1
+        self.metrics.observe_step(self.scheduler.depth, self.active_slots)
+
+    def run_until_idle(self, max_steps=100_000):
+        """Drive ``step()`` until queue and slab are empty."""
+        steps = 0
+        while self.scheduler.depth or self.active_slots:
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"run_until_idle: not drained after {max_steps} steps"
+                    f" (queue={self.scheduler.depth},"
+                    f" active={self.active_slots})"
+                )
+            self.step()
+            steps += 1
+        return steps
+
+    def generate(self, prompts, max_new_tokens=32, **submit_kwargs):
+        """Batch convenience: submit every prompt, drain, and return
+        the handles in submit order."""
+        handles = [
+            self.submit(p, max_new_tokens, **submit_kwargs)
+            for p in prompts
+        ]
+        self.run_until_idle()
+        return handles
+
+    def close(self):
+        """Shut the engine down: cancel queued AND in-flight requests
+        (their handles finish with status CANCELLED, partial tokens
+        kept), release every slab slot so pool occupancy returns to 0,
+        and drop all compiled programs."""
+        self._closed = True
+        while True:
+            h = self.scheduler.pop_next()
+            if h is None:
+                break
+            h.status = CANCELLED
+            h.reason = REASON_ENGINE_CLOSED
+            h.finish_time = self.clock()
+        for _ in self.scheduler.drain_timed_out():
+            self.metrics.timeouts.inc()
+        for i, seq in enumerate(self._seqs):
+            if seq is None:
+                continue
+            h = seq.handle
+            h.status = CANCELLED
+            h.reason = REASON_ENGINE_CLOSED
+            h.finish_time = self.clock()
+            h.finished_step = self.step_count
+            self._seqs[i] = None
+            self._slab.release(i)
+        self._flat = None
+        self._decode_fn = None
+        self._prefill_fns.clear()
+        self._adopt_fns.clear()
+
+
+class StaticBatchEngine:
+    """Serving adapter for SAVED decode artifacts (``jit.save`` ->
+    ``inference.create_predictor``). A saved program is one fixed
+    [B, S_prompt] whole-decode computation, so continuous batching is
+    impossible — but the request/scheduler/metrics surface still
+    applies: requests queue with backpressure, run in batches of B
+    (short batches padded by repeating the first row), and report the
+    same metrics. Built by ``Predictor.into_engine()``."""
+
+    def __init__(self, predictor, *, max_queue_size=64, scheduler=None,
+                 metrics=None, clock=time.monotonic):
+        specs = getattr(predictor, "_input_specs", None)
+        if not specs:
+            raise ValueError(
+                "predictor carries no input specs; into_engine() needs "
+                "an artifact saved by paddle_tpu.jit.save"
+            )
+        shape = specs[0].get("shape") or []
+        if len(shape) != 2:
+            raise ValueError(
+                f"expected a [B, S_prompt] decode artifact, got input "
+                f"shape {shape}"
+            )
+        self.predictor = predictor
+        self.batch_size, self.prompt_len = int(shape[0]), int(shape[1])
+        self.clock = clock
+        self.scheduler = scheduler or Scheduler(
+            max_queue_size=max_queue_size, clock=clock
+        )
+        self.metrics = metrics or ServingMetrics()
+
+    def submit(self, input_ids, *, priority=0, deadline_s=None):
+        req = Request(input_ids, 1, priority=priority,
+                      deadline_s=deadline_s)
+        self.metrics.submitted.inc()
+        if req.prompt_len != self.prompt_len:
+            h = RequestHandle(req)
+            h.submit_time = h.finish_time = self.clock()
+            h.status = REJECTED
+            h.reason = REASON_SHAPE_MISMATCH
+            self.metrics.rejected.inc(label=REASON_SHAPE_MISMATCH)
+            return h
+        try:
+            return self.scheduler.submit(req)
+        except RejectedError as e:
+            self.metrics.rejected.inc(label=e.reason)
+            return e.handle
+
+    def run_until_idle(self):
+        name = self.predictor.get_input_names()[0]
+        while self.scheduler.depth:
+            self.scheduler.sweep_expired()
+            for _ in self.scheduler.drain_timed_out():
+                self.metrics.timeouts.inc()
+            batch = []
+            while len(batch) < self.batch_size:
+                h = self.scheduler.pop_next()
+                if h is None:
+                    break
+                batch.append(h)
+            if not batch:
+                continue
+            ids = np.stack(
+                [batch[i % len(batch)].request.input_ids
+                 for i in range(self.batch_size)]
+            ).astype(np.int32)
+            t0 = self.clock()
+            self.predictor.get_input_handle(name).copy_from_cpu(ids)
+            self.predictor.run()
+            out = self.predictor.get_output_handle(
+                self.predictor.get_output_names()[0]
+            ).copy_to_cpu()
+            dt = self.clock() - t0
+            now = self.clock()
+            new = out.shape[1] - self.prompt_len
+            for i, h in enumerate(batch):
+                h.tokens = [int(t) for t in out[i, self.prompt_len:]]
+                h.status = DONE
+                h.admit_time = t0
+                h.first_token_time = now
+                h.finish_time = now
+                self.metrics.admitted.inc()
+                self.metrics.completed.inc()
+                self.metrics.tokens_out.inc(new)
+                self.metrics.prefill_tokens.inc(self.prompt_len)
+                self.metrics.queue_wait.observe(t0 - h.submit_time)
+                self.metrics.ttft.observe(now - h.submit_time)
+                if new > 1:
+                    self.metrics.itl.observe(dt / new)
+                self.metrics.e2e.observe(now - h.submit_time)
+            self.metrics.observe_step(self.scheduler.depth, len(batch))
+        for _ in self.scheduler.drain_timed_out():
+            self.metrics.timeouts.inc()

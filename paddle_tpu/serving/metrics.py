@@ -1,0 +1,184 @@
+"""Serving metrics: counters + histograms with profiler export.
+
+The serving quantities users actually page on — queue depth,
+time-to-first-token, inter-token latency, slot occupancy, rejection and
+timeout counts — live here as plain host-side counters/histograms (no
+device work; observing a sample is a list append). Every histogram
+sample is ALSO forwarded to ``paddle_tpu.profiler.record_span`` under a
+``serving::`` prefix, so when a ``profiler.Profiler`` RECORD window is
+open the serving latencies appear in ``Profiler.summary()`` and the
+chrome trace next to the op/user spans — one observability surface, not
+two.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    """Monotonic counter (optionally labeled by a reason string)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._by_label = {}
+        self._lock = threading.Lock()
+
+    def inc(self, n=1, label=None):
+        with self._lock:
+            self._value += n
+            if label is not None:
+                self._by_label[label] = self._by_label.get(label, 0) + n
+
+    @property
+    def value(self):
+        return self._value
+
+    def by_label(self):
+        with self._lock:
+            return dict(self._by_label)
+
+
+class Histogram:
+    """Sample store with percentile readout.
+
+    Memory-bounded for long-running servers: the window keeps the most
+    recent ``maxlen`` samples (sliding-window percentiles — what a
+    latency dashboard wants anyway), while ``count``/``sum`` stay exact
+    running totals over ALL observations."""
+
+    def __init__(self, name, unit="s", export=True, maxlen=65536):
+        import collections
+
+        self.name = name
+        self.unit = unit
+        self._samples = collections.deque(maxlen=int(maxlen))
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+        self._export = export
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._samples.append(v)
+            self._count += 1
+            self._sum += v
+        if self._export:
+            from .. import profiler
+
+            profiler.record_span(f"serving::{self.name}", v)
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def percentile(self, p):
+        """p in [0, 100]; nearest-rank. None when empty."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[k]
+
+    def snapshot(self):
+        # copy under the lock: a shared ServingMetrics may be observed
+        # from an engine thread while another thread reports
+        with self._lock:
+            if not self._samples:
+                return {"count": 0}
+            window = sorted(self._samples)
+            count, total = self._count, self._sum
+
+        def pct(p):
+            k = max(0, min(len(window) - 1,
+                           int(round(p / 100.0 * (len(window) - 1)))))
+            return window[k]
+
+        return {
+            "count": count,
+            "sum": total,
+            "mean": total / count,
+            "p50": pct(50),
+            "p90": pct(90),
+            "p99": pct(99),
+            "max": window[-1],
+            "min": window[0],
+            "unit": self.unit,
+        }
+
+
+class ServingMetrics:
+    """The engine's metric set. One instance per engine (or share one
+    across engines to aggregate a process)."""
+
+    def __init__(self):
+        self.submitted = Counter("submitted")
+        self.admitted = Counter("admitted")
+        self.completed = Counter("completed")
+        self.rejected = Counter("rejected")      # labeled by reason
+        self.timeouts = Counter("timeouts")
+        self.tokens_out = Counter("tokens_out")
+        self.prefill_tokens = Counter("prefill_tokens")
+        self.ttft = Histogram("ttft")            # submit -> first token
+        self.itl = Histogram("itl")              # inter-token latency
+        self.e2e = Histogram("e2e")              # submit -> finished
+        self.queue_wait = Histogram("queue_wait")  # submit -> admitted
+        self.queue_depth = Histogram("queue_depth", unit="reqs",
+                                     export=False)
+        self.slot_occupancy = Histogram("slot_occupancy", unit="slots",
+                                        export=False)
+
+    def observe_step(self, queue_depth, active_slots):
+        self.queue_depth.observe(queue_depth)
+        self.slot_occupancy.observe(active_slots)
+
+    def report(self):
+        """Plain-dict snapshot (what serve_bench prints as JSON)."""
+        return {
+            "counters": {
+                "submitted": self.submitted.value,
+                "admitted": self.admitted.value,
+                "completed": self.completed.value,
+                "rejected": self.rejected.value,
+                "rejected_by_reason": self.rejected.by_label(),
+                "timeouts": self.timeouts.value,
+                "tokens_out": self.tokens_out.value,
+                "prefill_tokens": self.prefill_tokens.value,
+            },
+            "ttft": self.ttft.snapshot(),
+            "itl": self.itl.snapshot(),
+            "e2e": self.e2e.snapshot(),
+            "queue_wait": self.queue_wait.snapshot(),
+            "queue_depth": self.queue_depth.snapshot(),
+            "slot_occupancy": self.slot_occupancy.snapshot(),
+        }
+
+    def render(self):
+        """Human-readable table of the report."""
+        r = self.report()
+        lines = ["serving metrics", "-" * 15]
+        for k, v in r["counters"].items():
+            lines.append(f"{k:>20}: {v}")
+        for name in ("ttft", "itl", "e2e", "queue_wait",
+                     "queue_depth", "slot_occupancy"):
+            s = r[name]
+            if not s.get("count"):
+                lines.append(f"{name:>20}: (no samples)")
+                continue
+            unit = s.get("unit", "s")
+            scale = 1e3 if unit == "s" else 1.0
+            u = "ms" if unit == "s" else unit
+            lines.append(
+                f"{name:>20}: n={s['count']} "
+                f"p50={s['p50'] * scale:.3f}{u} "
+                f"p90={s['p90'] * scale:.3f}{u} "
+                f"p99={s['p99'] * scale:.3f}{u} "
+                f"max={s['max'] * scale:.3f}{u}"
+            )
+        return "\n".join(lines)

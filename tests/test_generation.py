@@ -42,8 +42,11 @@ def _naive_greedy(net, ids, n):
 def test_greedy_cache_matches_naive(net):
     prompt = RNG.randint(0, 64, (1, 6))
     want = _naive_greedy(net, prompt, 8)
+    # fp32 cache: bit-exact vs the cacheless fp32 re-forward oracle
+    # (the bf16 default trades cache HBM for rounding at the kv write)
     got = np.asarray(
-        net.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=8).numpy()
+        net.generate(Tensor(jnp.asarray(prompt)), max_new_tokens=8,
+                     cache_dtype="float32").numpy()
     )
     np.testing.assert_array_equal(got, want)
 
@@ -90,7 +93,8 @@ def test_generate_eos_padding(net):
 def test_generate_single_token(net):
     prompt = RNG.randint(0, 64, (1, 4))
     out = np.asarray(net.generate(
-        Tensor(jnp.asarray(prompt)), max_new_tokens=1).numpy())
+        Tensor(jnp.asarray(prompt)), max_new_tokens=1,
+        cache_dtype="float32").numpy())
     assert out.shape == (1, 5)
     want = _naive_greedy(net, prompt, 1)
     np.testing.assert_array_equal(out, want)
@@ -151,6 +155,53 @@ def test_generate_top_p_zero_collapses_to_greedy(net):
         Tensor(jnp.asarray(prompt)), max_new_tokens=4, do_sample=True,
         top_p=0.0, seed=2).numpy())
     np.testing.assert_array_equal(g, z)
+
+
+def test_cache_dtype_default_bf16_and_knob(net):
+    """The KV-cache dtype knob (serving HBM: bf16 default halves cache
+    bytes vs the old unconditional fp32)."""
+    from paddle_tpu.models.generation import (
+        DEFAULT_CACHE_DTYPE,
+        alloc_kv_caches,
+    )
+
+    assert DEFAULT_CACHE_DTYPE == "bfloat16"
+    caches = alloc_kv_caches(net.config, 2, 16)
+    assert caches[0][0].dtype == jnp.bfloat16
+    assert caches[0][1].dtype == jnp.bfloat16
+    assert len(caches) == net.config.num_hidden_layers
+    assert alloc_kv_caches(net.config, 1, 8, "float32")[0][0].dtype == (
+        jnp.float32
+    )
+
+    # both dtypes decode deterministically; distinct compile-cache keys
+    prompt = RNG.randint(0, 64, (1, 5))
+    bf = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=6).numpy())
+    bf2 = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=6,
+        cache_dtype="bfloat16").numpy())
+    np.testing.assert_array_equal(bf, bf2)  # bf16 IS the default
+    f32 = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=6,
+        cache_dtype="float32").numpy())
+    assert f32.shape == bf.shape
+    sigs = {s for s in net._generate_cache if s[0] == 1 and s[1] == 5}
+    assert {s[-1] for s in sigs} >= {"bfloat16", "float32"}
+
+
+def test_generate_top_k_ge_vocab_clamps(net):
+    """top_k >= vocab_size must behave as plain sampling, not raise an
+    opaque trace-time IndexError (ADVICE r5)."""
+    prompt = RNG.randint(0, 64, (1, 4))
+    big = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4, do_sample=True,
+        top_k=10_000, seed=9).numpy())
+    assert big.shape == (1, 8)
+    exact = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=4, do_sample=True,
+        top_k=64, seed=9).numpy())
+    np.testing.assert_array_equal(big, exact)  # clamp == keep-everything
 
 
 def test_generate_with_mesh_sharded_weights(net):
@@ -272,8 +323,8 @@ def test_beam_search_matches_naive_reference(net):
     prompt = RNG.randint(0, 64, (1, 5))
     want = _naive_beam(net, prompt, 5, 3)
     got = np.asarray(net.generate(
-        Tensor(jnp.asarray(prompt)), max_new_tokens=5,
-        num_beams=3).numpy())[0]
+        Tensor(jnp.asarray(prompt)), max_new_tokens=5, num_beams=3,
+        cache_dtype="float32").numpy())[0]
     np.testing.assert_array_equal(got, want)
 
 

@@ -259,3 +259,15 @@ def test_pipe_to_causal_lm_logits_and_decode(hcg):
 
     out = net.generate(Tensor(jnp.asarray(ids[:, :4])), max_new_tokens=3)
     assert np.asarray(out.numpy()).shape == (2, 7)
+
+
+def test_pipe_to_causal_lm_rejects_tied_embeddings(hcg):
+    """The pipe always trains a SEPARATE lm head (its suffix stage);
+    converting to a tied LlamaForCausalLM would silently drop it and
+    serve embed_tokens.T logits — must refuse loudly (ADVICE r5)."""
+    paddle.seed(24)
+    cfg = _tiny_cfg()
+    cfg.tie_word_embeddings = True
+    pipe = LlamaForCausalLMPipe(cfg, num_stages=2)
+    with pytest.raises(ValueError, match="tie_word_embeddings"):
+        pipe.to_causal_lm()

@@ -15,13 +15,34 @@ import os
 import re
 import subprocess
 import sys
+import threading
+
+
+def _pump(pipe, sink, chunks):
+    """Forward a child pipe line-by-line: echo to ``sink`` immediately
+    (flushed — this is what makes phase-OK lines survive a driver
+    timeout) while accumulating for the returned CompletedProcess."""
+    for line in iter(pipe.readline, ""):
+        chunks.append(line)
+        if sink is not None:
+            sink.write(line)
+            sink.flush()
+    pipe.close()
 
 
 def run_in_virtual_cpu_mesh(n_devices: int, payload: str, cwd: str,
-                            timeout: int = 1800):
+                            timeout: int = 1800, stream: bool = False):
     """Execute ``payload`` (python source) in a subprocess that sees
     ``n_devices`` CPU devices. The payload runs AFTER the cpu-platform
-    bootstrap. Returns the CompletedProcess (output captured)."""
+    bootstrap. Returns a CompletedProcess (output captured either way).
+
+    ``stream=True`` additionally forwards the child's stdout/stderr to
+    this process line-by-line AS IT IS PRODUCED (child runs python -u,
+    parent flushes per line). The multichip dryrun uses this so every
+    completed phase's OK line is already on the driver's stdout if a
+    wall-clock limit kills the run mid-phase — with the old
+    capture-then-echo shape, a timeout recorded ZERO phases even when
+    three had finished (round-5 postmortem)."""
     env = dict(os.environ)
     flags = re.sub(
         r"--xla_force_host_platform_device_count=\d+", "",
@@ -37,7 +58,43 @@ def run_in_virtual_cpu_mesh(n_devices: int, payload: str, cwd: str,
         "import jax; jax.config.update('jax_platforms', 'cpu'); "
         + payload
     )
-    return subprocess.run(
-        [sys.executable, "-c", code], cwd=cwd, env=env,
-        capture_output=True, text=True, timeout=timeout,
+    argv = [sys.executable, "-u", "-c", code]  # -u: no block buffering
+    if not stream:
+        return subprocess.run(
+            argv, cwd=cwd, env=env,
+            capture_output=True, text=True, timeout=timeout,
+        )
+    proc = subprocess.Popen(
+        argv, cwd=cwd, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    out_chunks, err_chunks = [], []
+    threads = [
+        threading.Thread(
+            target=_pump, args=(proc.stdout, sys.stdout, out_chunks),
+            daemon=True,
+        ),
+        threading.Thread(
+            target=_pump, args=(proc.stderr, sys.stderr, err_chunks),
+            daemon=True,
+        ),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        for t in threads:
+            t.join(timeout=5)
+        raise subprocess.TimeoutExpired(
+            argv, timeout, output="".join(out_chunks),
+            stderr="".join(err_chunks),
+        ) from None
+    for t in threads:
+        t.join(timeout=5)
+    return subprocess.CompletedProcess(
+        argv, rc, stdout="".join(out_chunks),
+        stderr="".join(err_chunks),
     )
