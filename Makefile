@@ -1,0 +1,30 @@
+# Developer/CI targets. Everything runs on host CPU (JAX_PLATFORMS=cpu);
+# the same code paths serve real chips on a different backend.
+
+PY ?= python
+ENV = JAX_PLATFORMS=cpu
+
+.PHONY: lint lint-fast lint-update test tier1
+
+# The pre-commit gate: graph lint (llama fwd / train step / serving
+# decode / optimizer step) + AST lint + API-surface audit, diffed
+# against the checked-in baseline. Exit nonzero on any new finding.
+lint:
+	$(ENV) $(PY) tools/tpu_lint.py --audit-api
+
+# Source-only lint (seconds): for tight edit loops.
+lint-fast:
+	$(ENV) $(PY) tools/tpu_lint.py --ast-only
+
+# Accept the current findings (each new entry needs a documented `why`
+# before review).
+lint-update:
+	$(ENV) $(PY) tools/tpu_lint.py --update-baseline
+
+# Tier-1: the suite the driver gates on (kept `not slow`).
+tier1:
+	$(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+test:
+	$(ENV) $(PY) -m pytest tests/ -q

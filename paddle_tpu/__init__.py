@@ -223,3 +223,4 @@ from . import inference  # noqa: E402
 from . import models  # noqa: E402
 from . import serving  # noqa: E402
 from . import sparse  # noqa: E402
+from . import analysis  # noqa: E402

@@ -1,0 +1,59 @@
+"""paddle_tpu.analysis — TPU-graph linter + recompilation guard.
+
+Static analysis turned inward: every hazard class this repo shipped —
+trace-time crashes, silent dtype promotion on hot paths, cache-parity
+splits, shape-bucket recompile storms — was only discoverable by
+*running* the graph on (or near) the chip. This package catches them
+offline, the way upstream gates kernels through compile-time checks:
+
+- :mod:`jaxpr_lint` — walks closed jaxprs of any jitted function and
+  reports findings with severity + source provenance (fp64 leaks,
+  convert churn, host transfers, donation misses, collective/mesh
+  mismatches, broadcast blowups).
+- :mod:`trace_guard` — runtime guard counting compile-cache entries per
+  function; flags recompilation storms (same fn, drifting shapes) and
+  detects leaked tracers.
+- :mod:`ast_lint` — source-level pass for tensor-dependent Python
+  control flow, host syncs inside ``@jit`` regions, and missing
+  ``static_argnums``.
+- :mod:`baseline` — the ratchet: CI fails only on findings not in the
+  checked-in baseline (``tools/tpu_lint_baseline.json``).
+
+CLI: ``python tools/tpu_lint.py`` runs all passes over the repo's own
+llama forward/backward, serving decode-step, and optimizer-step graphs.
+Suppress an AST finding inline with ``# tpu-lint: disable=<rule>``.
+"""
+from __future__ import annotations
+
+from .ast_lint import lint_file, lint_path, lint_source
+from .baseline import (
+    assert_no_new_findings,
+    diff_against_baseline,
+    load_baseline,
+    save_baseline,
+)
+from .findings import Finding, Report, Severity
+from .jaxpr_lint import (
+    LintConfig,
+    lint_closed_jaxpr,
+    lint_fn,
+    lint_jitted,
+)
+from .trace_guard import (
+    TraceGuard,
+    find_leaked_tracers,
+    get_guard,
+    lint_leaked_tracers,
+    record_compile,
+    use_guard,
+)
+
+__all__ = [
+    "Finding", "Report", "Severity", "LintConfig",
+    "lint_closed_jaxpr", "lint_fn", "lint_jitted",
+    "lint_source", "lint_file", "lint_path",
+    "TraceGuard", "get_guard", "use_guard", "record_compile",
+    "find_leaked_tracers", "lint_leaked_tracers",
+    "load_baseline", "save_baseline", "diff_against_baseline",
+    "assert_no_new_findings",
+]

@@ -1,0 +1,319 @@
+"""AST lint: Python-level hazards that never make it into a jaxpr.
+
+The jaxpr rules see what *traced*; these rules see what would make the
+trace wrong or impossible in the first place, by walking the source of
+functions compiled with ``jax.jit`` (decorator form,
+``functools.partial(jax.jit, ...)`` form, or module-level
+``name = jax.jit(fn)`` assignment):
+
+- ``traced-branch``  Python ``if``/``while`` on a traced parameter —
+  inside jit this either crashes (ConcretizationTypeError) or silently
+  bakes one branch in at trace time. Shape/dtype/None/isinstance tests
+  are recognized as static and allowed. (``to_static`` functions are
+  exempt: the dy2static pass converts their branches.)
+- ``host-sync-in-jit``  ``.numpy()`` / ``.item()`` / ``.tolist()`` /
+  ``float(param)``-style host pulls inside a jit region: a forced
+  device sync per call, or a trace-time crash.
+- ``missing-static-argnums``  a parameter used where Python needs a
+  concrete value (``range(param)``, shape arguments to
+  ``zeros/ones/full/arange``) without being listed in
+  ``static_argnums``/``static_argnames``.
+
+Suppress a finding inline with ``# tpu-lint: disable=<rule>`` (or
+``disable=all``) on the offending line or the line above.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .findings import Finding, Report, Severity
+
+_BENIGN_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+_HOST_SYNC_METHODS = {"numpy", "item", "tolist", "copy_to_cpu"}
+_SHAPE_BUILDERS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+                   "eye"}
+
+
+def _dotted(node):
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_elts(node):
+    out = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        elts = node.elts
+    else:
+        elts = [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.append(e.value)
+    return out
+
+
+def _str_elts(node):
+    out = []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        elts = node.elts
+    else:
+        elts = [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append(e.value)
+    return out
+
+
+def _jit_call_info(call):
+    """If ``call`` is a jax.jit(...) invocation, return its static
+    argnums/argnames, else None."""
+    name = _dotted(call.func)
+    if name not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return None
+    static_nums, static_names = [], []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            static_nums = _int_elts(kw.value)
+        elif kw.arg == "static_argnames":
+            static_names = _str_elts(kw.value)
+    return static_nums, static_names
+
+
+def _decorator_jit_info(fn):
+    """(static_argnums, static_argnames) if ``fn`` is jit-decorated."""
+    for dec in fn.decorator_list:
+        name = _dotted(dec)
+        if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return [], []
+        if isinstance(dec, ast.Call):
+            info = _jit_call_info(dec)
+            if info is not None:
+                return info
+            # functools.partial(jax.jit, static_argnums=...)
+            if _dotted(dec.func) in ("functools.partial", "partial") and \
+                    dec.args and _dotted(dec.args[0]) in (
+                        "jax.jit", "jit"):
+                nums, names = [], []
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnums":
+                        nums = _int_elts(kw.value)
+                    elif kw.arg == "static_argnames":
+                        names = _str_elts(kw.value)
+                return nums, names
+    return None
+
+
+def _module_jit_assignments(tree):
+    """{func_name: (static_argnums, static_argnames)} for module-level
+    ``jitted = jax.jit(fn, ...)`` assignments."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            info = _jit_call_info(node.value)
+            if info is not None and node.value.args and isinstance(
+                node.value.args[0], ast.Name
+            ):
+                out[node.value.args[0].id] = info
+    return out
+
+
+class _FnLinter(ast.NodeVisitor):
+    """Lint one jit-compiled function body."""
+
+    def __init__(self, fn, static_nums, static_names, rel, rep, lines):
+        args = fn.args
+        # static_argnums index the full positional signature (jax.jit on
+        # an unbound method counts `self` as arg 0), so resolve indices
+        # BEFORE dropping self/cls from the tracked set
+        names = [a.arg for a in args.posonlyargs + args.args]
+        static = {names[i] for i in static_nums if 0 <= i < len(names)}
+        static |= set(static_names)
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        self.params = set(names + [a.arg for a in args.kwonlyargs])
+        self.traced = self.params - static
+        self.fn = fn
+        self.rel = rel
+        self.rep = rep
+        self.lines = lines
+
+    # ------------------------------------------------------------- helpers
+    def _suppressed(self, lineno, rule):
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.lines):
+                text = self.lines[ln - 1]
+                if "tpu-lint:" in text and "disable=" in text:
+                    tail = text.split("disable=", 1)[1]
+                    rules = tail.split()[0].split(",")
+                    if rule in rules or "all" in rules:
+                        return True
+        return False
+
+    def _add(self, rule, severity, message, node, detail):
+        if self._suppressed(node.lineno, rule):
+            return
+        self.rep.add(Finding(
+            rule=rule, severity=severity, message=message,
+            graph=self.rel, where=f"{self.rel}:{node.lineno}",
+            detail=f"{self.fn.name}:{detail}",
+        ))
+
+    def _traced_uses(self, node, benign=False):
+        """Names of traced params used in value (non-static) position."""
+        hits = []
+        if isinstance(node, ast.Name):
+            if not benign and node.id in self.traced:
+                hits.append(node.id)
+            return hits
+        if isinstance(node, ast.Attribute):
+            sub_benign = benign or node.attr in _BENIGN_ATTRS
+            return self._traced_uses(node.value, sub_benign)
+        if isinstance(node, ast.Call):
+            fname = _dotted(node.func)
+            if fname in ("isinstance", "len", "getattr", "hasattr",
+                         "callable", "type"):
+                benign = True
+            for child in list(node.args) + [kw.value for kw in
+                                            node.keywords]:
+                hits += self._traced_uses(child, benign)
+            if isinstance(node.func, ast.Attribute):
+                hits += self._traced_uses(node.func.value, benign)
+            return hits
+        if isinstance(node, ast.Compare):
+            all_ident = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            for child in [node.left] + node.comparators:
+                hits += self._traced_uses(child, benign or all_ident)
+            return hits
+        for child in ast.iter_child_nodes(node):
+            hits += self._traced_uses(child, benign)
+        return hits
+
+    # -------------------------------------------------------------- visits
+    def _check_branch(self, node, kind):
+        for name in sorted(set(self._traced_uses(node.test))):
+            self._add(
+                "traced-branch", Severity.ERROR,
+                f"Python `{kind}` on traced parameter {name!r} inside a "
+                f"jit function — use lax.cond/lax.while_loop, or mark "
+                f"{name!r} static (static_argnums)",
+                node, f"{kind}:{name}",
+            )
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+
+    def visit_Call(self, node):
+        fname = _dotted(node.func)
+        # .numpy()/.item()/.tolist() on anything inside a jit region
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_SYNC_METHODS:
+            self._add(
+                "host-sync-in-jit", Severity.ERROR,
+                f"`.{node.func.attr}()` inside a jit function forces a "
+                f"host sync (or fails to trace)",
+                node, f"sync:{node.func.attr}",
+            )
+        # float(x)/int(x)/bool(x)/np.asarray(x) pulling a traced param
+        if fname in ("float", "int", "bool", "np.asarray",
+                     "numpy.asarray", "np.array", "numpy.array"):
+            for name in sorted(set(
+                h for a in node.args for h in self._traced_uses(a)
+            )):
+                self._add(
+                    "host-sync-in-jit", Severity.ERROR,
+                    f"`{fname}({name})` concretizes a traced value "
+                    f"inside a jit function",
+                    node, f"concretize:{fname}:{name}",
+                )
+        # range(param) / shape-builder(param): needs a static value
+        needs_static = fname == "range" or (
+            fname is not None
+            and fname.rsplit(".", 1)[-1] in _SHAPE_BUILDERS
+            and fname.rsplit(".", 1)[0] in ("jnp", "jax.numpy", "np",
+                                            "numpy")
+        )
+        if needs_static:
+            check_args = node.args if fname == "range" else node.args[:1]
+            for name in sorted(set(
+                h for a in check_args for h in self._traced_uses(a)
+            )):
+                self._add(
+                    "missing-static-argnums", Severity.ERROR,
+                    f"parameter {name!r} feeds `{fname}(...)` which needs "
+                    f"a concrete value — add it to static_argnums",
+                    node, f"static:{fname}:{name}",
+                )
+        self.generic_visit(node)
+
+
+def lint_source(source, rel="<string>"):
+    """Lint one Python source string. Returns a Report."""
+    rep = Report()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        rep.add(Finding(
+            rule="parse-error", severity=Severity.INFO,
+            message=f"could not parse: {e}", graph=rel, where=rel,
+            detail="parse",
+        ))
+        return rep
+    lines = source.splitlines()
+    assigned = _module_jit_assignments(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _decorator_jit_info(node)
+        if info is None:
+            info = assigned.get(node.name)
+        if info is None:
+            continue
+        nums, names = info
+        _FnLinter(node, nums, names, rel, rep, lines).visit(node)
+    return rep
+
+
+def lint_file(path, root=None):
+    rel = os.path.relpath(path, root) if root else path
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        rep = Report()
+        rep.add(Finding(
+            rule="parse-error", severity=Severity.INFO,
+            message=f"could not read: {e}", graph=rel, where=rel,
+            detail="read",
+        ))
+        return rep
+    return lint_source(src, rel)
+
+
+def lint_path(path, root=None, skip_dirs=("__pycache__", ".git",
+                                          "build", "dist")):
+    """Recursively lint every .py file under ``path``."""
+    root = root or path
+    rep = Report()
+    if os.path.isfile(path):
+        rep.extend(lint_file(path, root=os.path.dirname(path)))
+        return rep
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d not in skip_dirs and not d.startswith(".")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rep.extend(lint_file(os.path.join(dirpath, fn), root=root))
+    return rep

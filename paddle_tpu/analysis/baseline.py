@@ -1,0 +1,95 @@
+"""Baseline: the checked-in set of accepted findings.
+
+The lint gate is *ratchet-shaped*: the repo's current graphs produce a
+known finding set (each entry carries a ``why`` documenting the
+decision to accept it — or the fix that removed it); CI fails only on
+findings NOT in the baseline, so new hazards can't land while accepted
+ones don't nag. Regenerate after an intentional change with
+``python tools/tpu_lint.py --update-baseline``.
+
+Matching is by :meth:`Finding.key` (rule|graph|detail) — deliberately
+free of line numbers and message text, so refactors that move code or
+reword messages don't invalidate the baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .findings import Report
+
+
+def load_baseline(path):
+    """-> (set of accepted keys, full entry list). Missing file = empty."""
+    if not os.path.exists(path):
+        return set(), []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("findings", [])
+    # "fixed|..." keys are documentation of hazards already fixed — they
+    # can never match a live finding and must not count as stale
+    keys = {e["key"] for e in entries
+            if "key" in e and not e["key"].startswith("fixed|")}
+    return keys, entries
+
+
+def save_baseline(path, report, notes=None, extra_entries=None):
+    """Write the baseline for ``report``. ``notes`` maps finding key ->
+    'why accepted' text; unnoted entries get a placeholder so review
+    can spot them. ``extra_entries`` are preserved verbatim (e.g.
+    documented fixed-findings history)."""
+    notes = notes or {}
+    seen = set()
+    entries = []
+    for f in report.sorted():
+        k = f.key()
+        if k in seen:
+            continue
+        seen.add(k)
+        entries.append({
+            "key": k,
+            "rule": f.rule,
+            "severity": f.severity,
+            "graph": f.graph,
+            "message": f.message,
+            "why": notes.get(k, "accepted at baseline generation; "
+                                "document or fix"),
+        })
+    for e in extra_entries or []:
+        if e.get("key") not in seen:
+            entries.append(e)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"format": "tpu_lint.baseline.v1", "findings": entries},
+                  f, indent=1, sort_keys=False)
+        f.write("\n")
+    return entries
+
+
+def diff_against_baseline(report, baseline_keys):
+    """-> (new Report of unaccepted findings, stale keys no longer
+    produced). Stale keys are informational — they mean a documented
+    hazard got fixed and the baseline can be regenerated smaller."""
+    new = Report()
+    produced = set()
+    for f in report:
+        k = f.key()
+        produced.add(k)
+        if k not in baseline_keys:
+            new.add(f)
+    stale = sorted(baseline_keys - produced)
+    return new, stale
+
+
+def assert_no_new_findings(report, baseline_path):
+    """Raise AssertionError listing any finding not in the baseline —
+    the pytest-facing entry point."""
+    keys, _ = load_baseline(baseline_path)
+    new, _stale = diff_against_baseline(report, keys)
+    if len(new):
+        lines = "\n".join(f"  {f}" for f in new.sorted())
+        raise AssertionError(
+            f"{len(new)} lint finding(s) not in baseline "
+            f"{baseline_path}:\n{lines}\n"
+            f"Fix them, suppress inline (# tpu-lint: disable=<rule>), or "
+            f"regenerate: python tools/tpu_lint.py --update-baseline"
+        )

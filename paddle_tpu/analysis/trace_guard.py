@@ -1,0 +1,238 @@
+"""Recompilation guard + leaked-tracer detection (runtime side of the
+linter).
+
+A TPU program that recompiles under drifting shapes/dtypes spends
+seconds of wall clock per signature while the chip idles — the exact
+failure mode ``serving``'s shape bucketing exists to prevent. The guard
+watches compile-cache growth two ways:
+
+- **explicit**: compile-cache owners (``jit.api.StaticFunction``,
+  ``models.generation``'s per-net cache, the serving engine's bucket
+  maps) call :func:`record_compile` with their cache key + the new
+  signature on every miss.
+- **polling**: any ``jax.jit``-wrapped callable can be registered with
+  :func:`watch`; :func:`check` diffs its ``_cache_size()`` against the
+  last observation, so recompiles that happen *inside* jax's own cache
+  (shape drift invisible to the wrapper) are still counted.
+
+When one function crosses ``max_compiles`` distinct signatures the
+guard emits a ``recompile-storm`` Finding, forwards it to every
+subscribed callback (the serving engine turns it into a
+``profiler.record_span`` so storms land in chrome traces), and bumps
+the profiler's lint-event counters so ``Profiler.summary()`` shows it.
+
+Leaked-tracer detection (:func:`find_leaked_tracers`) walks any
+pytree/Layer for ``jax.core.Tracer`` instances — the signature of a
+trace that escaped its ``jit`` (the write-back pattern in
+``generation.generate`` exists to prevent exactly this).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .findings import Finding, Severity
+
+DEFAULT_MAX_COMPILES = 8
+
+
+class TraceGuard:
+    """Counts distinct compile signatures per function key."""
+
+    def __init__(self, max_compiles=DEFAULT_MAX_COMPILES):
+        self.max_compiles = int(max_compiles)
+        self._sigs = {}      # key -> list of signatures, insertion order
+        self._watched = {}   # name -> (jitted fn, last seen cache size)
+        self._fired = set()  # keys that already produced a storm finding
+        self.findings = []
+        self._callbacks = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ explicit
+    def record_compile(self, key, signature, origin=""):
+        """Report a compile-cache MISS for ``key`` with ``signature``.
+        Returns the storm Finding the miss triggered, else None."""
+        with self._lock:
+            sigs = self._sigs.setdefault(key, [])
+            if signature in sigs:
+                return None
+            sigs.append(signature)
+            n = len(sigs)
+            if n <= self.max_compiles or key in self._fired:
+                return None
+            self._fired.add(key)
+            recent = sigs[-3:]
+        return self._fire(key, n, recent, origin)
+
+    # ------------------------------------------------------------- polling
+    def watch(self, name, jitted):
+        """Track a jax.jit-wrapped callable's internal compile cache.
+        The size at watch time is the baseline: only growth beyond it
+        counts toward a storm."""
+        with self._lock:
+            self._watched[name] = [jitted, self._cache_size(jitted)]
+
+    def unwatch(self, name):
+        with self._lock:
+            self._watched.pop(name, None)
+
+    @staticmethod
+    def _cache_size(jitted):
+        try:
+            return int(jitted._cache_size())
+        except Exception:
+            return 0
+
+    def check(self):
+        """Poll watched functions; returns new storm findings. Growth
+        is measured against the baseline cache size recorded by
+        ``watch()``/``reset()`` — entries compiled before watching are
+        not this guard's storms."""
+        fired = []
+        with self._lock:
+            items = list(self._watched.items())
+        for name, slot in items:
+            size = self._cache_size(slot[0])
+            with self._lock:
+                grown = size - slot[1]  # slot[1]: baseline at watch/reset
+                if grown <= self.max_compiles or name in self._fired:
+                    continue
+                self._fired.add(name)
+            fired.append(self._fire(name, grown, [], "jit-cache-poll"))
+        return [f for f in fired if f is not None]
+
+    # ------------------------------------------------------------- plumbing
+    def on_fire(self, callback):
+        """Subscribe ``callback(finding)`` to storm events."""
+        self._callbacks.append(callback)
+        return callback
+
+    def _fire(self, key, n, recent, origin):
+        detail = f"{key}:{n}"
+        f = Finding(
+            rule="recompile-storm", severity=Severity.WARNING,
+            message=(
+                f"{key!r} compiled {n} distinct signatures "
+                f"(max {self.max_compiles}) — drifting shapes/dtypes; "
+                f"bucket the inputs or mark them static"
+                + (f"; recent: {recent}" if recent else "")
+            ),
+            graph=str(key), where=origin, detail=detail,
+        )
+        self.findings.append(f)
+        from .. import profiler
+
+        profiler.record_lint_event(f"lint::recompile-storm::{key}")
+        for cb in list(self._callbacks):
+            try:
+                cb(f)
+            except Exception:
+                pass
+        return f
+
+    def compile_counts(self):
+        with self._lock:
+            counts = {k: len(v) for k, v in self._sigs.items()}
+            for name, slot in self._watched.items():
+                counts[name] = max(
+                    counts.get(name, 0),
+                    self._cache_size(slot[0]) - slot[1],
+                )
+        return counts
+
+    def reset(self):
+        with self._lock:
+            self._sigs.clear()
+            self._fired.clear()
+            self.findings.clear()
+            for slot in self._watched.values():
+                slot[1] = self._cache_size(slot[0])  # re-baseline
+
+
+# One process-wide guard: compile storms are a process property. Swap a
+# fresh guard in for tests via ``use_guard``.
+_GUARD = TraceGuard()
+
+
+def get_guard() -> TraceGuard:
+    return _GUARD
+
+
+def record_compile(key, signature, origin=""):
+    return _GUARD.record_compile(key, signature, origin)
+
+
+class use_guard:
+    """Context manager installing a replacement guard (tests)."""
+
+    def __init__(self, guard):
+        self.guard = guard
+        self._prev = None
+
+    def __enter__(self):
+        global _GUARD
+        self._prev, _GUARD = _GUARD, self.guard
+        return self.guard
+
+    def __exit__(self, *exc):
+        global _GUARD
+        _GUARD = self._prev
+        return False
+
+
+# ---------------------------------------------------------------- tracers
+def find_leaked_tracers(obj, _path="", _out=None, _seen=None):
+    """Walk a pytree / Layer / dict for jax Tracer instances. Returns
+    ``[(path, tracer), ...]`` — non-empty means a trace escaped its jit
+    (a later use will raise ``UnexpectedTracerError`` at a distance)."""
+    out = [] if _out is None else _out
+    seen = set() if _seen is None else _seen
+    if id(obj) in seen:
+        return out
+    seen.add(id(obj))
+    Tracer = jax.core.Tracer
+    if isinstance(obj, Tracer):
+        out.append((_path or "<root>", obj))
+        return out
+    # paddle Layer: parameters + buffers are where tracers leak
+    if hasattr(obj, "named_parameters") and hasattr(obj, "named_buffers"):
+        for k, p in obj.named_parameters():
+            find_leaked_tracers(
+                getattr(p, "value", p), f"{_path}params.{k}", out, seen
+            )
+        for k, b in obj.named_buffers():
+            find_leaked_tracers(
+                getattr(b, "value", b), f"{_path}buffers.{k}", out, seen
+            )
+        return out
+    if hasattr(obj, "value") and not isinstance(obj, (dict, list, tuple)):
+        find_leaked_tracers(obj.value, f"{_path}.value", out, seen)
+        return out
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            find_leaked_tracers(v, f"{_path}[{k!r}]", out, seen)
+        return out
+    if isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            find_leaked_tracers(v, f"{_path}[{i}]", out, seen)
+        return out
+    return out
+
+
+def lint_leaked_tracers(obj, graph=""):
+    """Finding-producing wrapper over :func:`find_leaked_tracers`."""
+    from .findings import Report
+
+    rep = Report()
+    for path, _tr in find_leaked_tracers(obj):
+        rep.add(Finding(
+            rule="leaked-tracer", severity=Severity.ERROR,
+            message=(
+                f"tracer leaked into {path} — a jit trace escaped; "
+                f"restore concrete state after tracing (write-back "
+                f"pattern)"
+            ),
+            graph=graph, detail=path,
+        ))
+    return rep

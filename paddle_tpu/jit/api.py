@@ -62,10 +62,29 @@ class StaticFunction:
         # one compiled program per train/eval mode: dropout/batch-norm
         # behavior is baked at trace time, so the cache is keyed on it
         self._jitted = {}
+        owner = type(layer).__name__ if layer is not None else getattr(
+            fn, "__qualname__", getattr(fn, "__name__", "fn")
+        )
+        self._guard_key = f"to_static::{owner}"
+        # per-instance recompile guard (the serving-engine pattern): a
+        # process-global registry would pin the jitted closure — and the
+        # whole Layer it closes over — for process lifetime, and two
+        # instances of one class would collide on the key
+        from ..analysis.trace_guard import TraceGuard
+
+        self.trace_guard = TraceGuard()
 
     def _build(self, mode):
         layer = self._layer
         fn = self._fn
+        # NO buffer donation here, deliberately: Layer buffer arrays are
+        # aliased by external snapshots (ServingEngine._buffers,
+        # functional_state() holders), so donating them would delete
+        # arrays a snapshot still references — 'Array has been deleted'
+        # at a distance on accelerators. The linter's donation-miss
+        # finding on this graph is accepted in the lint baseline with
+        # this reason; the un-aliased optimizer-state donations landed
+        # instead.
 
         if layer is not None:
             def pure(params, buffers, rng, *input_vals):
@@ -88,6 +107,13 @@ class StaticFunction:
                 return _to_values(out)
 
             self._jitted[mode] = jax.jit(pure)
+        # recompile guard: jax.jit re-traces on every new input
+        # shape/dtype signature invisibly to this wrapper — register the
+        # compiled callable so the trace guard can poll its cache
+        # growth and flag storms (drifting shapes)
+        self.trace_guard.watch(
+            f"{self._guard_key}[mode={int(mode)}]", self._jitted[mode]
+        )
 
     def __call__(self, *inputs):
         mode = bool(self._layer.training) if self._layer is not None else False
@@ -108,6 +134,7 @@ class StaticFunction:
                 self._layer.eval()
         else:
             out_vals = jitted(rng, *vals)
+        self.trace_guard.check()  # ≤2 entries: a cheap per-call poll
         return _to_tensors(out_vals)
 
     # paddle API parity

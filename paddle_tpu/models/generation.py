@@ -14,6 +14,8 @@ single fused device step. Finished sequences (EOS seen) keep emitting
 """
 from __future__ import annotations
 
+import itertools
+
 import jax
 import jax.numpy as jnp
 
@@ -49,6 +51,11 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
 
 
 DEFAULT_CACHE_DTYPE = "bfloat16"
+
+# monotonic per-net token for trace-guard keys: id(net) would be reused
+# after GC, merging a dead net's compile history (and _fired state) into
+# a new net's
+_NET_GUARD_IDS = itertools.count()
 
 
 def alloc_kv_caches(cfg, B, S_max, cache_dtype=None):
@@ -395,6 +402,22 @@ def generate(net, input_ids, max_new_tokens=32, do_sample=False,
     fn = cache.get(sig)
     if fn is None:
         fn = cache[sig] = _build_decode(net, *sig)
+        # compile-cache miss: every distinct (B, S, max_new, ...)
+        # signature is a full whole-decode recompile — report it so the
+        # analysis trace guard can flag callers whose prompt shapes
+        # drift (the hazard serving's bucketing exists to prevent).
+        # Keyed per net INSTANCE: several nets of one class each
+        # legitimately compile a few programs; only one net's cache
+        # growing unbounded is a storm.
+        from ..analysis import trace_guard
+
+        token = net.__dict__.setdefault(
+            "_generate_guard_id", next(_NET_GUARD_IDS)
+        )
+        trace_guard.record_compile(
+            f"generate::{type(net).__name__}#{token}", sig,
+            origin="models/generation.py",
+        )
     params = {k: p.value for k, p in net.named_parameters()}
     buffers = {k: b.value for k, b in net.named_buffers()}
     was_training = net.training

@@ -50,6 +50,10 @@ _LOCK = threading.Lock()
 _HOST_TIMES: dict = collections.defaultdict(list)
 _OP_TIMES: dict = collections.defaultdict(list)
 _EVENTS: list = []  # (name, kind, t_start, dur) for chrome export
+# analysis/trace-guard event counts (name -> count): bounded by name
+# cardinality, so counted even outside RECORD windows — a recompile
+# storm must show in summary() whether or not a trace was open
+_LINT_COUNTS: dict = collections.defaultdict(int)
 _EPOCH = time.perf_counter()
 # set while some Profiler is in a RECORD window; gates all appends so a
 # bare RecordEvent in a profiler-less training loop cannot grow memory
@@ -67,6 +71,25 @@ def reset_profiler_data():
         _HOST_TIMES.clear()
         _OP_TIMES.clear()
         _EVENTS.clear()
+        _LINT_COUNTS.clear()
+
+
+def record_lint_event(name):
+    """Count a static-analysis/trace-guard event (recompile storm,
+    leaked tracer, ...). Counts always accumulate (bounded: keyed by
+    name); when a RECORD window is open the event ALSO lands in the
+    chrome trace as a zero-duration span, so recompile storms show up
+    in traces instead of only as silent latency spikes."""
+    with _LOCK:
+        _LINT_COUNTS[name] += 1
+        if _RECORDING.is_set():
+            _EVENTS.append((name, "lint", time.perf_counter() - _EPOCH,
+                            0.0))
+
+
+def lint_event_counts():
+    with _LOCK:
+        return dict(_LINT_COUNTS)
 
 
 def record_span(name, dur, kind="user"):
@@ -316,6 +339,13 @@ class Profiler:
         with _LOCK:
             host = dict(_HOST_TIMES)
             ops = dict(_OP_TIMES)
+            lint = dict(_LINT_COUNTS)
+        if lint:
+            out.append("Static-analysis / trace-guard events")
+            out.append("-" * 36)
+            for name in sorted(lint):
+                out.append(f"{name}  x{lint[name]}")
+            out.append("")
         if host:
             out += table(f"UserEvent Summary ({time_unit})", host)
             out.append("")

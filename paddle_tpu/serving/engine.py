@@ -103,7 +103,7 @@ class ServingEngine:
                  top_k=0, top_p=1.0, seed=0, min_bucket=16,
                  max_queue_size=64, max_tokens_in_flight=None,
                  scheduler=None, metrics=None, pool=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, recompile_guard_max=None):
         cfg = net.config
         self.net = net
         self.config = cfg
@@ -149,6 +149,40 @@ class ServingEngine:
         self._donate = accel
         self._traced = set()
         self._closed = False
+        # runtime lint guard: the whole engine design exists so that
+        # admission/retirement NEVER recompile — if compile caches grow
+        # anyway (bucket sprawl, decode shape drift), the guard turns
+        # the silent latency spike into a finding + a chrome-trace span
+        from ..analysis.trace_guard import TraceGuard
+
+        if recompile_guard_max is None:
+            # expected steady state: one prefill + one adopt program per
+            # power-of-two bucket, one decode program; anything well
+            # past that is a storm. Bucket count comes from the POOL's
+            # geometry (a caller-supplied pool may use a different
+            # min_bucket/max_seq_len than this engine's defaults).
+            import math
+
+            pool_min = getattr(self.pool, "min_bucket", min_bucket)
+            pool_max = getattr(self.pool, "max_seq_len", None) \
+                or self.max_seq_len
+            buckets = 1 + max(
+                0, int(math.log2(max(pool_max, 1)))
+                - int(math.log2(max(pool_min, 1)))
+            )
+            recompile_guard_max = max(4, buckets + 2)
+        self.trace_guard = TraceGuard(max_compiles=recompile_guard_max)
+        self.trace_guard.on_fire(self._on_guard_fire)
+        self.trace_guard.watch("serving::decode_step", self._decode_fn)
+
+    def _on_guard_fire(self, finding):
+        """A recompile storm at runtime: emit a lint-guard span so the
+        storm shows in chrome traces instead of only as a latency
+        spike, and count it on the engine's metrics."""
+        profiler.record_span(
+            f"serving::lint_guard::{finding.rule}", 0.0, kind="lint"
+        )
+        self.metrics.guard_fires.inc(label=finding.graph)
 
     # ------------------------------------------------- compiled programs
     def _decode_body(self, params, buffers, tok, flat, pos, temperature,
@@ -182,6 +216,9 @@ class ServingEngine:
             body, donate_argnums=(4,) if self._donate else ()
         )
         self._prefill_fns[bucket] = fn
+        self.trace_guard.record_compile(
+            "serving::prefill", bucket, origin="serving/engine.py"
+        )
         return fn
 
     def _adopt_fn(self, bucket):
@@ -202,6 +239,9 @@ class ServingEngine:
             body, donate_argnums=(0,) if self._donate else ()
         )
         self._adopt_fns[bucket] = fn
+        self.trace_guard.record_compile(
+            "serving::adopt", bucket, origin="serving/engine.py"
+        )
         return fn
 
     def _run(self, trace_key, fn, *args):
@@ -413,6 +453,9 @@ class ServingEngine:
                 self.metrics.itl.observe(dt)
                 self._append(i, nxt[i])
         self.step_count += 1
+        # poll jit-internal compile caches (decode shape drift is
+        # invisible to the bucket maps above); fires _on_guard_fire
+        self.trace_guard.check()
         self.metrics.observe_step(self.scheduler.depth, self.active_slots)
 
     def run_until_idle(self, max_steps=100_000):
@@ -466,6 +509,9 @@ class ServingEngine:
             self._slab.release(i)
         self._flat = None
         self._decode_fn = None
+        # the guard's watch entry holds the jitted callable too — drop
+        # it, or close() would keep the compiled program resident
+        self.trace_guard.unwatch("serving::decode_step")
         self._prefill_fns.clear()
         self._adopt_fns.clear()
 

@@ -125,6 +125,7 @@ class ServingMetrics:
         self.timeouts = Counter("timeouts")
         self.tokens_out = Counter("tokens_out")
         self.prefill_tokens = Counter("prefill_tokens")
+        self.guard_fires = Counter("guard_fires")  # labeled by fn key
         self.ttft = Histogram("ttft")            # submit -> first token
         self.itl = Histogram("itl")              # inter-token latency
         self.e2e = Histogram("e2e")              # submit -> finished
@@ -150,6 +151,8 @@ class ServingMetrics:
                 "timeouts": self.timeouts.value,
                 "tokens_out": self.tokens_out.value,
                 "prefill_tokens": self.prefill_tokens.value,
+                "guard_fires": self.guard_fires.value,
+                "guard_fires_by_fn": self.guard_fires.by_label(),
             },
             "ttft": self.ttft.snapshot(),
             "itl": self.itl.snapshot(),

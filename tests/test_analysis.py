@@ -1,0 +1,511 @@
+"""paddle_tpu.analysis — the TPU-graph linter + recompilation guard.
+
+One minimal positive (rule fires) + one negative (clean graph stays
+clean) case per rule, a recompile-storm repro the trace guard must
+catch, and the repo-wide gate: the tpu_lint CLI must exit 0 against
+the checked-in baseline and nonzero on an injected violation.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import analysis, profiler
+from paddle_tpu.analysis import LintConfig, Severity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(rep):
+    return {f.rule for f in rep}
+
+
+# --------------------------------------------------------------- fp64-leak
+def test_fp64_leak_positive():
+    def f(x):
+        return x * jnp.asarray(2.0, jnp.float64)
+
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.float64), graph="g")
+    assert "fp64-leak" in rules_of(rep)
+    assert any(f.severity == Severity.ERROR for f in rep)
+
+
+def test_fp64_leak_negative():
+    def f(x):
+        return x * 2.0
+
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.float32), graph="g")
+    assert "fp64-leak" not in rules_of(rep)
+
+
+# ------------------------------------------------------------- dtype-churn
+def test_dtype_churn_positive_roundtrip():
+    def f(x):
+        return x.astype(jnp.float32).astype(jnp.bfloat16)
+
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.bfloat16), graph="g")
+    hits = [f for f in rep if f.rule == "dtype-churn"]
+    assert hits and "round trip" in hits[0].message
+
+
+def test_dtype_churn_positive_bulk_upcast():
+    cfg = LintConfig(min_upcast_bytes=1024)
+
+    def f(x):
+        return (x.astype(jnp.float32) * 2).sum()
+
+    rep = analysis.lint_fn(f, jnp.ones((64, 64), jnp.bfloat16),
+                           graph="g", config=cfg)
+    assert any(f.rule == "dtype-churn" and "upcast" in f.detail
+               for f in rep)
+
+
+def test_dtype_churn_negative():
+    def f(x):
+        return (x.astype(jnp.float32) * 2).astype(jnp.bfloat16)
+
+    # single convert each way with real work between: no chained pair
+    # (note: appending .sum() WOULD be churn — jnp reduces bf16 via an
+    # f32 accumulator, an immediate f32->bf16->f32 round trip)
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.bfloat16), graph="g")
+    assert "dtype-churn" not in rules_of(rep)
+
+
+# ----------------------------------------------------------- host-transfer
+def test_host_transfer_positive():
+    def f(x):
+        y = jax.pure_callback(
+            lambda a: np.asarray(a), jax.ShapeDtypeStruct((4,), x.dtype), x
+        )
+        return y + 1
+
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.float32), graph="g")
+    hits = [f for f in rep if f.rule == "host-transfer"]
+    assert hits and hits[0].severity == Severity.ERROR
+
+
+def test_host_transfer_negative():
+    def f(x):
+        return x + 1
+
+    rep = analysis.lint_fn(f, jnp.ones((4,), jnp.float32), graph="g")
+    assert "host-transfer" not in rules_of(rep)
+
+
+# ----------------------------------------------------------- donation-miss
+def test_donation_miss_positive_and_fix():
+    cfg = LintConfig(min_donation_bytes=1024)
+
+    def step(p, g):
+        return p - 0.1 * g
+
+    big = jnp.ones((64, 64), jnp.float32)
+    rep = analysis.lint_fn(step, big, big, graph="opt", config=cfg)
+    assert [f.rule for f in rep] == ["donation-miss"]
+    assert "arg0" in rep.findings[0].detail
+    # donating the state buffer clears the finding (and must not
+    # transfer the miss onto the gradient input)
+    rep2 = analysis.lint_fn(step, big, big, graph="opt",
+                            donate_argnums=(0,), config=cfg)
+    assert len(rep2) == 0
+
+
+def test_donation_miss_negative_small_buffer():
+    def step(p, g):
+        return p - 0.1 * g
+
+    small = jnp.ones((4,), jnp.float32)
+    rep = analysis.lint_fn(step, small, small, graph="opt")
+    assert "donation-miss" not in rules_of(rep)
+
+
+# ----------------------------------------- collective-mesh-mismatch
+def test_collective_mesh_mismatch():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    other = Mesh(devs.reshape(n), ("tp",))
+    fn = shard_map(lambda x: jax.lax.psum(x, "tp"), mesh=other,
+                   in_specs=P("tp"), out_specs=P())
+    x = jnp.ones((n,), jnp.float32)
+    # positive: installed mesh has no 'tp' axis
+    cfg = LintConfig(mesh_axes=("dp",))
+    rep = analysis.lint_fn(fn, x, graph="coll", config=cfg)
+    hits = [f for f in rep if f.rule == "collective-mesh-mismatch"]
+    assert hits and "tp" in hits[0].detail
+    # negative: matching axes
+    cfg2 = LintConfig(mesh_axes=("tp",))
+    rep2 = analysis.lint_fn(fn, x, graph="coll", config=cfg2)
+    assert "collective-mesh-mismatch" not in rules_of(rep2)
+    # no mesh known at all: the rule cannot judge and stays silent
+    cfg3 = LintConfig(mesh_axes=None)
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    if not mesh_mod.mesh_defined():
+        rep3 = analysis.lint_fn(fn, x, graph="coll", config=cfg3)
+        assert "collective-mesh-mismatch" not in rules_of(rep3)
+
+
+# ------------------------------------------------------- broadcast-blowup
+def test_broadcast_blowup():
+    cfg = LintConfig(min_broadcast_bytes=1024, broadcast_ratio=4.0)
+
+    def f(x):
+        return jnp.broadcast_to(x[None, :], (256, x.shape[0]))
+
+    rep = analysis.lint_fn(f, jnp.ones((64,), jnp.float32), graph="g",
+                           config=cfg)
+    assert "broadcast-blowup" in rules_of(rep)
+    # scalar fills (jnp.zeros) must NOT trip it — XLA fuses those
+    def g():
+        return jnp.zeros((256, 64), jnp.float32)
+
+    rep2 = analysis.lint_fn(g, graph="g", config=cfg)
+    assert "broadcast-blowup" not in rules_of(rep2)
+
+
+# --------------------------------------------------------- recompile storm
+def test_trace_guard_storm_repro():
+    """Same fn, drifting shapes — the exact failure mode serving's
+    bucketing prevents. The guard must flag it; bucketed shapes must
+    not."""
+    guard = analysis.TraceGuard(max_compiles=4)
+    fired = []
+    guard.on_fire(fired.append)
+    f = jax.jit(lambda x: x * 2)
+    guard.watch("decode", f)
+    for n in range(1, 8):  # 7 distinct shapes: a storm
+        f(jnp.ones((n,), jnp.float32))
+    findings = guard.check()
+    assert findings and findings[0].rule == "recompile-storm"
+    assert fired and fired[0].rule == "recompile-storm"
+    assert "decode" in fired[0].message
+    # negative: bucketed shapes reuse entries, no storm
+    guard2 = analysis.TraceGuard(max_compiles=4)
+    g = jax.jit(lambda x: x * 2)
+    guard2.watch("bucketed", g)
+    for n in (8, 16, 8, 16, 8):
+        g(jnp.ones((n,), jnp.float32))
+    assert guard2.check() == []
+
+
+def test_trace_guard_warm_watch_is_not_a_storm():
+    """Compiles that happened BEFORE watch() are not this guard's
+    storms: growth is measured against the watch-time baseline, and
+    reset() re-baselines."""
+    f = jax.jit(lambda x: x * 2)
+    for n in range(1, 7):  # warm the cache with 6 signatures
+        f(jnp.ones((n,), jnp.float32))
+    guard = analysis.TraceGuard(max_compiles=4)
+    guard.watch("warm", f)
+    assert guard.check() == []  # zero growth since watch
+    assert guard.compile_counts()["warm"] == 0
+    for n in range(7, 13):  # 6 NEW signatures: now a storm
+        f(jnp.ones((n,), jnp.float32))
+    assert [x.rule for x in guard.check()] == ["recompile-storm"]
+    guard.reset()
+    assert guard.check() == []  # re-baselined: quiet again
+
+
+def test_trace_guard_explicit_record():
+    guard = analysis.TraceGuard(max_compiles=2)
+    assert guard.record_compile("gen", (1, 8)) is None
+    assert guard.record_compile("gen", (1, 8)) is None  # hit, not a miss
+    assert guard.record_compile("gen", (1, 16)) is None
+    f = guard.record_compile("gen", (1, 24))
+    assert f is not None and f.rule == "recompile-storm"
+    # fires once per key, not per subsequent miss
+    assert guard.record_compile("gen", (1, 32)) is None
+    assert guard.compile_counts()["gen"] == 4
+
+
+def test_profiler_surfaces_guard_events():
+    profiler.reset_profiler_data()
+    guard = analysis.TraceGuard(max_compiles=1)
+    guard.record_compile("fn", "a")
+    guard.record_compile("fn", "b")
+    counts = profiler.lint_event_counts()
+    assert any("recompile-storm" in k for k in counts)
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    # events land in summary even when recorded outside the window
+    guard2 = analysis.TraceGuard(max_compiles=1)
+    guard2.record_compile("fn2", "a")
+    guard2.record_compile("fn2", "b")
+    text = prof.summary()
+    prof.stop()
+    assert "recompile-storm" in text
+
+
+# ----------------------------------------------------------- leaked tracer
+def test_leaked_tracer_detection():
+    leak = {}
+
+    def f(x):
+        leak["t"] = x * 2  # tracer escapes the trace
+        return x + 1
+
+    jax.make_jaxpr(f)(jnp.ones((2,)))
+    rep = analysis.lint_leaked_tracers(leak, graph="g")
+    assert [f.rule for f in rep] == ["leaked-tracer"]
+    assert analysis.find_leaked_tracers({"ok": jnp.ones(2)}) == []
+    leak.clear()
+
+
+# ----------------------------------------------------------------- AST lint
+AST_CASES = [
+    # (rule, positive source, negative source)
+    ("traced-branch",
+     "import jax\n@jax.jit\ndef f(x):\n    if x > 0:\n        x = -x\n"
+     "    return x\n",
+     "import jax\n@jax.jit\ndef f(x):\n    if x.shape[0] > 0:\n"
+     "        x = -x\n    return x\n"),
+    ("host-sync-in-jit",
+     "import jax\n@jax.jit\ndef f(x):\n    return float(x) + 1\n",
+     "import jax\ndef f(x):\n    return float(x) + 1\n"),
+    ("missing-static-argnums",
+     "import jax\n@jax.jit\ndef f(x, n):\n    for _ in range(n):\n"
+     "        x = x + 1\n    return x\n",
+     "import jax, functools\n"
+     "@functools.partial(jax.jit, static_argnums=(1,))\n"
+     "def f(x, n):\n    for _ in range(n):\n        x = x + 1\n"
+     "    return x\n"),
+]
+
+
+@pytest.mark.parametrize("rule,pos,neg", AST_CASES,
+                         ids=[c[0] for c in AST_CASES])
+def test_ast_rule(rule, pos, neg):
+    assert rule in rules_of(analysis.lint_source(pos, "demo.py"))
+    assert rule not in rules_of(analysis.lint_source(neg, "demo.py"))
+
+
+def test_ast_methods_and_sync_calls():
+    # the separating statement matters: a disable comment suppresses its
+    # own line AND the next line (comment-above style)
+    src = (
+        "import jax\n@jax.jit\ndef f(x):\n"
+        "    y = x.numpy()  # tpu-lint: disable=host-sync-in-jit\n"
+        "    y = y + 1\n"
+        "    z = x.item()\n"
+        "    return z\n"
+    )
+    rep = analysis.lint_source(src, "demo.py")
+    hits = [f for f in rep if f.rule == "host-sync-in-jit"]
+    # .numpy() suppressed inline; .item() still caught
+    assert len(hits) == 1 and "item" in hits[0].detail
+
+
+def test_ast_module_level_jit_assignment():
+    src = (
+        "import jax\n"
+        "def f(x, flag):\n"
+        "    if flag:\n        return x\n    return -x\n"
+        "g = jax.jit(f)\n"
+    )
+    assert "traced-branch" in rules_of(analysis.lint_source(src, "m.py"))
+
+
+def test_ast_is_none_and_isinstance_are_static():
+    src = (
+        "import jax\n@jax.jit\ndef f(x, m):\n"
+        "    if m is None:\n        return x\n"
+        "    if isinstance(m, tuple):\n        return x\n"
+        "    if len(m) > 2:\n        return x\n"
+        "    return x + 1\n"
+    )
+    assert rules_of(analysis.lint_source(src, "m.py")) == set()
+
+
+# ------------------------------------------------------------- baseline
+def test_baseline_roundtrip_and_diff(tmp_path):
+    from paddle_tpu.analysis import (
+        diff_against_baseline, load_baseline, save_baseline,
+    )
+    from paddle_tpu.analysis.findings import Finding, Report
+
+    f1 = Finding(rule="fp64-leak", severity="error", message="m",
+                 graph="g", detail="mul:float64")
+    f2 = Finding(rule="dtype-churn", severity="warning", message="m",
+                 graph="g", detail="a->b->a")
+    path = str(tmp_path / "base.json")
+    save_baseline(path, Report([f1]), notes={f1.key(): "known"},
+                  extra_entries=[{"key": "fixed|x", "why": "fixed"}])
+    keys, entries = load_baseline(path)
+    assert keys == {f1.key()}  # fixed| entries documented, not matched
+    assert len(entries) == 2
+    new, stale = diff_against_baseline(Report([f1, f2]), keys)
+    assert [f.rule for f in new] == ["dtype-churn"] and stale == []
+    new2, stale2 = diff_against_baseline(Report([f2]), keys)
+    assert len(new2) == 1 and stale2 == [f1.key()]
+
+
+# -------------------------------------------------------- serving guard
+def test_serving_engine_guard_span(monkeypatch):
+    """Satellite: when the engine's trace guard fires at runtime the
+    recompile shows up via profiler.record_span (chrome traces), not
+    only as a silent latency spike."""
+    from paddle_tpu.serving.engine import ServingEngine
+
+    spans = []
+    import paddle_tpu.serving.engine as eng_mod
+
+    real = profiler.record_span
+
+    def spy(name, dur, kind="user"):
+        spans.append((name, kind))
+        return real(name, dur, kind=kind)
+
+    monkeypatch.setattr(eng_mod.profiler, "record_span", spy)
+
+    class _Eng(ServingEngine):
+        def __init__(self):  # skeleton: only what the guard path needs
+            from paddle_tpu.serving.metrics import ServingMetrics
+
+            self.metrics = ServingMetrics()
+
+    e = _Eng()
+    guard = analysis.TraceGuard(max_compiles=1)
+    guard.on_fire(e._on_guard_fire)
+    e.trace_guard = guard
+    guard.record_compile("serving::prefill", 8)
+    assert spans == []  # under the limit: quiet
+    guard.record_compile("serving::prefill", 16)
+    assert any(n.startswith("serving::lint_guard::recompile-storm")
+               for n, _ in spans)
+    assert e.metrics.guard_fires.value == 1
+
+
+def test_serving_engine_wires_guard():
+    from paddle_tpu.serving.engine import ServingEngine
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    eng = ServingEngine(net, max_batch_size=1, max_seq_len=16,
+                        min_bucket=8)
+    assert eng.trace_guard is not None
+    h = eng.submit(np.array([[1, 2, 3]]), max_new_tokens=2)
+    eng.run_until_idle()
+    assert h.status is not None
+    # one prefill bucket + one adopt bucket recorded, no storm
+    counts = eng.trace_guard.compile_counts()
+    assert counts.get("serving::prefill") == 1
+    assert counts.get("serving::adopt") == 1
+    assert eng.trace_guard.findings == []
+    eng.close()
+
+
+# ------------------------------------------------------------ the CLI gate
+@pytest.fixture(scope="module")
+def lint_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_ENABLE_X64", None)  # lint the production (f32) graphs
+    return env
+
+
+def test_cli_ast_only_exits_zero_on_baseline(lint_env):
+    """Fast repo gate: the source tree must be clean vs the baseline."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py"),
+         "--ast-only", "--json"],
+        capture_output=True, text=True, env=lint_env, cwd=REPO,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["new"] == []
+
+
+def test_cli_fails_on_injected_violation(tmp_path, lint_env):
+    """The gate must demonstrably fail (nonzero exit, named rule) on an
+    injected violation."""
+    bad = tmp_path / "paddle_tpu_bad.py"
+    bad.write_text(
+        "import jax\n\n@jax.jit\ndef decode(x, n):\n"
+        "    if x > 0:\n        return x.numpy()\n"
+        "    for _ in range(n):\n        x = x + 1\n    return x\n"
+    )
+    code = (
+        "import sys, json\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from paddle_tpu import analysis\n"
+        f"rep = analysis.lint_path({str(tmp_path)!r})\n"
+        f"keys, _ = analysis.load_baseline("
+        f"{os.path.join(REPO, 'tools', 'tpu_lint_baseline.json')!r})\n"
+        "new, _ = analysis.diff_against_baseline(rep, keys)\n"
+        "print(json.dumps(sorted({f.rule for f in new})))\n"
+        "sys.exit(1 if len(new) else 0)\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, env=lint_env,
+                         timeout=300)
+    assert out.returncode == 1, out.stdout + out.stderr
+    rules = json.loads(out.stdout.strip().splitlines()[-1])
+    assert {"traced-branch", "host-sync-in-jit",
+            "missing-static-argnums"} <= set(rules)
+
+
+@pytest.mark.slow
+def test_cli_full_graph_gate(lint_env):
+    """The full dogfood: trace llama fwd / train step / serving decode /
+    optimizer step and gate against the baseline (slow: ~1 min)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tpu_lint.py")],
+        capture_output=True, text=True, env=lint_env, cwd=REPO,
+        timeout=560,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_graph_lint_in_process_on_tiny_graphs():
+    """Tier-1-speed version of the dogfood: the pure-jaxpr passes over a
+    tiny forward + optimizer update must produce no unbaselined
+    findings (x64 CI env: fp64 rule off — conftest enables float64
+    globally, which the production CLI env never does)."""
+    from paddle_tpu.optimizer.optimizer import _adam_update
+
+    cfg = LintConfig(check_fp64=False, min_donation_bytes=1024)
+    p = jnp.ones((64, 64), jnp.float32)
+    rep = analysis.lint_fn(
+        _adam_update.__wrapped__, p, p, p, p, jnp.float32(1e-3),
+        jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-8),
+        jnp.float32(1.0), jnp.float32(0.0), False,
+        graph="optimizer_step", donate_argnums=(0, 1, 2),
+        static_argnums=(10,), config=cfg,
+    )
+    assert len(rep) == 0, "\n".join(str(f) for f in rep)
+
+    from paddle_tpu.optimizer.optimizer import (
+        _adadelta_update, _adamax_update,
+    )
+
+    rep2 = analysis.lint_fn(
+        _adadelta_update.__wrapped__, p, p, p, p, jnp.float32(1e-3),
+        jnp.float32(0.95), jnp.float32(1e-6),
+        graph="adadelta_step", donate_argnums=(0, 1, 2), config=cfg,
+    )
+    assert len(rep2) == 0, "\n".join(str(f) for f in rep2)
+    rep3 = analysis.lint_fn(
+        _adamax_update.__wrapped__, p, p, p, p, jnp.float32(1e-3),
+        jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-8),
+        jnp.float32(1.0),
+        graph="adamax_step", donate_argnums=(0, 1, 2), config=cfg,
+    )
+    assert len(rep3) == 0, "\n".join(str(f) for f in rep3)
