@@ -4,7 +4,7 @@
 PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
-.PHONY: lint lint-fast lint-update test tier1
+.PHONY: lint lint-fast lint-update test tier1 metrics-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -25,6 +25,13 @@ lint-update:
 tier1:
 	$(ENV) $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Telemetry pipeline gate: tiny train step + serving burst + forced
+# guard fire through the ONE metrics registry; asserts the Prometheus
+# exposition parses and the key series (step_time, ttft, guard_fires)
+# are present, and that the flight recorder's bundle round-trips.
+metrics-smoke:
+	$(ENV) $(PY) tools/metrics_smoke.py
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

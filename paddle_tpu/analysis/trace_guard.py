@@ -124,6 +124,23 @@ class TraceGuard:
         from .. import profiler
 
         profiler.record_lint_event(f"lint::recompile-storm::{key}")
+        # unified telemetry: storms are an alertable series, not only a
+        # summary() line — publish into the process metrics registry
+        try:
+            from ..observability import get_registry
+
+            get_registry().counter(
+                "paddle_analysis_guard_fires_total",
+                help="trace-guard findings (recompile storms), by rule "
+                     "and watched graph",
+            ).inc(rule=f.rule, graph=str(key))
+            from ..observability import get_flight_recorder
+
+            get_flight_recorder().note(
+                "guard_fire", rule=f.rule, graph=str(key), detail=detail,
+            )
+        except Exception:
+            pass
         for cb in list(self._callbacks):
             try:
                 cb(f)

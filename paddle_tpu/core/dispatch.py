@@ -46,8 +46,21 @@ def set_amp_hook(fn):
     _AMP_HOOK[0] = fn
 
 
+# armed by observability.FlightRecorder.install(): called with the op
+# name BEFORE the NaN/Inf error raises, so the crash bundle is written
+# while the step records are still in memory. One list-index check when
+# off (the _PROFILER_HOOK pattern).
+_NANINF_HOOK = [None]
+
+
 def _nan_report(op_name, ok):
     if not bool(ok):
+        hook = _NANINF_HOOK[0]
+        if hook is not None:
+            try:
+                hook(op_name)
+            except Exception:
+                pass  # a broken recorder must not mask the NaN error
         raise RuntimeError(
             f"FLAGS_check_nan_inf: operator [{op_name}] output contains "
             "NaN or Inf"

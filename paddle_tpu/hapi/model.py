@@ -141,6 +141,9 @@ class Model:
             out_loss = [float(np.asarray(loss.numpy()))]
             return (out_loss, metrics) if metrics else out_loss
 
+        import time
+
+        _t0 = time.perf_counter()
         self.network.train()
         inputs = [_tensorize(x) for x in _to_list(inputs)]
         labels = [_tensorize(y) for y in _to_list(labels)]
@@ -161,6 +164,23 @@ class Model:
             self._optimizer.clear_grad()
             self._pending_accum = False
             self._accum_count = 0
+            # training telemetry (eager path; the jit path meters inside
+            # CompiledTrainStep). Loss stays a device ref — the meter's
+            # lazy gauge fetches it on scrape, not here.
+            try:
+                from .. import observability as obs
+
+                meter = obs.get_step_meter()
+                meter.auto_configure(self.network)
+                examples, tokens = obs.batch_geometry(
+                    [getattr(x, "value", x) for x in inputs]
+                )
+                meter.observe_step(
+                    time.perf_counter() - _t0, examples=examples,
+                    tokens=tokens, loss=loss.value,
+                )
+            except Exception:
+                pass
         elif self._accumulating:
             self._pending_accum = True
             self._accum_count = getattr(self, "_accum_count", 0) + 1

@@ -14,6 +14,7 @@ keep working between steps.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -445,8 +446,33 @@ class CompiledTrainStep:
                 ) from e
             raise
 
+    def _record_telemetry(self, dt, in_vals, loss, warmup):
+        """Publish one step into the process StepMeter (observability).
+
+        Host-side only: batch geometry comes from input SHAPES and the
+        loss is handed over as a device ref the meter's lazy gauge
+        fetches on scrape — no sync is added to the step. The first
+        call per program is reported as ``warmup`` (its wall time is
+        dominated by trace+XLA compile and goes to the compile_time
+        histogram, not step_time). Telemetry can never fail a train
+        step."""
+        try:
+            from .. import observability as obs
+
+            meter = obs.get_step_meter()
+            meter.auto_configure(self.network)  # MFU from model config
+            examples, tokens = obs.batch_geometry(in_vals)
+            meter.observe_step(
+                dt, examples=examples, tokens=tokens, loss=loss,
+                warmup=warmup,
+            )
+        except Exception:
+            pass
+
     # ---------------------------------------------------------------- call
     def __call__(self, inputs, labels):
+        _t0 = time.perf_counter()
+        _warmup = self._step_fn is None  # first call traces + compiles
         if self._step_fn is None:
             self._build()
         params = {k: p.value for k, p in self.network.named_parameters()}
@@ -497,4 +523,6 @@ class CompiledTrainStep:
             lookup[k].value = v
         self.network.load_functional_state(buffers=new_buffers)
         self._scatter_opt_state(new_state)
+        self._record_telemetry(time.perf_counter() - _t0, in_vals, loss,
+                               _warmup)
         return Tensor(loss), [Tensor(o) for o in out_vals]

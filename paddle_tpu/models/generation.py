@@ -436,4 +436,24 @@ def generate(net, input_ids, max_new_tokens=32, do_sample=False,
             net.train()
         else:
             net.eval()
+    # unified telemetry: offline generate() emits through the same
+    # registry the serving engine and train step publish into (tokens
+    # are the CAPACITY decoded — [B, max_new] slots; EOS-finished rows
+    # pad to shape, the host can't see per-row stop depth without a sync)
+    try:
+        from ..observability import get_registry
+
+        get_registry().counter(
+            "paddle_generation_tokens_total",
+            help="decode-slot tokens produced by models.generate "
+                 "(batch * max_new_tokens per call)",
+        ).inc(B * int(max_new_tokens),
+              mode="beam" if num_beams > 1 else
+              ("sample" if do_sample else "greedy"))
+        get_registry().counter(
+            "paddle_generation_calls_total",
+            help="models.generate invocations",
+        ).inc()
+    except Exception:
+        pass
     return Tensor(out)

@@ -1,0 +1,69 @@
+"""paddle_tpu.observability — unified telemetry for training + serving.
+
+One registry, every signal: serving metrics (``serving.metrics``
+re-bases its Counter/Histogram onto this package), static-analysis
+guard fires (``analysis.TraceGuard``), profiler lint events, and
+training-step telemetry (``StepMeter`` wired into the compiled train
+step and the hapi fit loop) all publish into one process-wide
+:class:`MetricsRegistry`. Readouts:
+
+- :func:`prometheus_text` / ``registry.snapshot()`` — Prometheus text
+  exposition + JSON, both derivable at any moment;
+- :func:`start_metrics_server` — stdlib-only HTTP ``/metrics`` +
+  ``/metrics.json`` + ``/flight`` endpoint on a daemon thread;
+- :class:`FlightRecorder` — a bounded ring of the last K step records
+  that dumps a JSON diagnostic bundle on NaN/uncaught exception (hooks
+  into the ``FLAGS_check_nan_inf`` machinery) or on demand;
+- :func:`merged_report` — per-host registries tagged with process index
+  and merged through the distributed layer into one report.
+
+Everything is host-side Python: observing never touches the device, and
+lazy gauge values (device-scalar losses) only materialize on scrape.
+"""
+from __future__ import annotations
+
+from .exporter import (
+    MetricsServer,
+    parse_prometheus_text,
+    prometheus_text,
+    start_metrics_server,
+)
+from .flight_recorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    set_flight_recorder,
+)
+from .multihost import merge_snapshots, merged_report, tagged_snapshot
+from .registry import (
+    COUNT_BUCKETS,
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from .step_meter import (
+    StepMeter,
+    analytic_flops_per_token,
+    analytic_param_count,
+    batch_geometry,
+    configure_training,
+    device_memory_stats,
+    get_step_meter,
+    peak_flops_per_device,
+    set_step_meter,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "DEFAULT_BUCKETS", "COUNT_BUCKETS",
+    "prometheus_text", "parse_prometheus_text", "MetricsServer",
+    "start_metrics_server",
+    "StepMeter", "get_step_meter", "set_step_meter",
+    "configure_training", "analytic_flops_per_token",
+    "analytic_param_count", "peak_flops_per_device",
+    "device_memory_stats", "batch_geometry",
+    "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+    "tagged_snapshot", "merge_snapshots", "merged_report",
+]

@@ -1,0 +1,173 @@
+"""Multihost aggregation: per-host registries merged into one report.
+
+Each process owns its registry (metrics are process-local by
+construction — a TPU pod's host 3 cannot observe host 0's queue
+depths). The merge path: every host takes a :func:`tagged_snapshot`
+(its registry snapshot stamped with process index/count + hostname),
+the snapshots travel through the existing distributed layer
+(``distributed.communication.all_gather_object`` — the same
+pickle+allgather seam checkpointing uses), and :func:`merge_snapshots`
+folds them into one report:
+
+- counters: values and label series SUM across hosts;
+- gauges: per-host values kept (keyed by process index) plus
+  min/max/mean — a gauge mean hides stragglers, so the spread stays;
+- histograms: exact ``count``/``sum`` and bucket counts sum (they are
+  running totals, so the merge is exact); percentiles are re-derived
+  from the merged cumulative buckets (bucket-resolution approximation —
+  per-host exact percentiles are kept under ``per_host``).
+
+Single-process runs (the CPU CI, `tools/vmesh.py` virtual meshes) take
+the same path with a one-element gather, so ``merged_report()`` is safe
+to call unconditionally at the end of any run.
+"""
+from __future__ import annotations
+
+import math
+import socket
+
+
+def tagged_snapshot(registry=None):
+    """This host's registry snapshot stamped with process identity."""
+    from .registry import get_registry
+
+    snap = (registry or get_registry()).snapshot()
+    try:
+        from ..distributed import env as dist_env
+
+        snap["process_index"] = dist_env.get_rank()
+        snap["process_count"] = dist_env.get_world_size()
+    except Exception:
+        snap["process_index"] = 0
+        snap["process_count"] = 1
+    try:
+        snap["host"] = socket.gethostname()
+    except Exception:
+        snap["host"] = "unknown"
+    return snap
+
+
+def _percentile_from_buckets(buckets, p):
+    """Nearest-bucket-upper-bound percentile from cumulative buckets
+    ``[{"le": ub, "count": c}, ...]`` (resolution = bucket width)."""
+    if not buckets:
+        return None
+    total = buckets[-1]["count"]
+    if total <= 0:
+        return None
+    rank = p / 100.0 * total
+    for b in buckets:
+        if b["count"] >= rank:
+            le = b["le"]
+            return None if (isinstance(le, float) and math.isinf(le)) \
+                else le
+    return None
+
+
+def merge_snapshots(snapshots):
+    """Fold tagged per-host snapshots into one merged report."""
+    hosts = [
+        {
+            "process_index": s.get("process_index", i),
+            "host": s.get("host", "unknown"),
+        }
+        for i, s in enumerate(snapshots)
+    ]
+    merged = {}
+    for i, snap in enumerate(snapshots):
+        pidx = snap.get("process_index", i)
+        for name, d in snap.get("metrics", {}).items():
+            kind = d.get("type", "untyped")
+            m = merged.setdefault(name, {
+                "type": kind, "help": d.get("help", ""),
+                "unit": d.get("unit", ""),
+            })
+            if kind == "counter":
+                m["value"] = m.get("value", 0) + d.get("value", 0)
+                series = m.setdefault("series", {})
+                for s in d.get("series", []):
+                    key = tuple(sorted(s["labels"].items()))
+                    series[key] = series.get(key, 0) + s["value"]
+            elif kind == "gauge":
+                per = m.setdefault("per_host", {})
+                for s in d.get("series", []):
+                    key = tuple(sorted(s["labels"].items()))
+                    per.setdefault(key, {})[pidx] = s["value"]
+            elif kind == "histogram":
+                m["count"] = m.get("count", 0) + d.get("count", 0)
+                m["sum"] = m.get("sum", 0.0) + d.get("sum", 0.0)
+                bks = m.setdefault("_buckets", {})
+                for b in d.get("buckets", []):
+                    le = float(b["le"])
+                    bks[le] = bks.get(le, 0) + b["count"]
+                m.setdefault("per_host", {})[pidx] = {
+                    k: d.get(k) for k in
+                    ("count", "sum", "mean", "p50", "p90", "p99",
+                     "window_count")
+                }
+    # finalize: label keys back to dicts, gauge spread, histogram pcts
+    out = {"hosts": hosts, "metrics": {}}
+    for name, m in merged.items():
+        kind = m["type"]
+        fin = {"type": kind, "help": m.get("help", "")}
+        if m.get("unit"):
+            fin["unit"] = m["unit"]
+        if kind == "counter":
+            fin["value"] = m.get("value", 0)
+            fin["series"] = [
+                {"labels": dict(k), "value": v}
+                for k, v in sorted(m.get("series", {}).items())
+            ]
+        elif kind == "gauge":
+            fin["series"] = []
+            for key, per in sorted(m.get("per_host", {}).items()):
+                vals = [v for v in per.values()
+                        if isinstance(v, (int, float))]
+                entry = {
+                    "labels": dict(key),
+                    "per_host": {str(k): v for k, v in per.items()},
+                }
+                if vals:
+                    entry.update(
+                        min=min(vals), max=max(vals),
+                        mean=sum(vals) / len(vals),
+                    )
+                fin["series"].append(entry)
+        elif kind == "histogram":
+            count = m.get("count", 0)
+            total = m.get("sum", 0.0)
+            fin["count"] = count
+            fin["sum"] = total
+            fin["mean"] = (total / count) if count else None
+            buckets = [
+                {"le": le, "count": c}
+                for le, c in sorted(m.get("_buckets", {}).items())
+            ]
+            fin["buckets"] = buckets
+            for p in (50, 90, 99):
+                fin[f"p{p}"] = _percentile_from_buckets(buckets, p)
+            fin["per_host"] = {
+                str(k): v for k, v in m.get("per_host", {}).items()
+            }
+        out["metrics"][name] = fin
+    return out
+
+
+def merged_report(registry=None, group=None):
+    """Gather every host's tagged snapshot through the distributed layer
+    and merge. Falls back to the local snapshot when the process is not
+    part of a multi-process world (CI, vmesh subprocesses, notebooks)."""
+    local = tagged_snapshot(registry)
+    world = local.get("process_count", 1)
+    if world <= 1:
+        return merge_snapshots([local])
+    try:
+        from ..distributed import communication as comm
+
+        gathered = []
+        comm.all_gather_object(gathered, local, group=group)
+        if not gathered:
+            gathered = [local]
+    except Exception:
+        gathered = [local]
+    return merge_snapshots(gathered)

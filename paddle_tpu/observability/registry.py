@@ -1,0 +1,445 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with labels.
+
+Every observability surface the repo has grown — serving's
+TTFT/ITL/queue counters, the trace guard's recompile-storm fires, the
+profiler's lint-event counts, and (new) training-step telemetry — used
+to keep private state with private readouts. This module is the one
+place they all publish into: a named instrument registers itself in a
+:class:`MetricsRegistry` and every consumer (the Prometheus text
+exporter, the JSON snapshot, the /metrics HTTP endpoint, the flight
+recorder's crash bundle, the multihost merge) reads the same registry.
+
+Design constraints, in order:
+
+- **Never on the device.** Observing is a host-side integer/float
+  update under a lock. Gauges may hold a CALLABLE (or a jax device
+  scalar) that is materialized only when somebody scrapes — the fit hot
+  loop must not synchronize with the device per step (hapi's lazy-logs
+  rule applies here too).
+- **Bounded memory.** Counters/gauges are O(label cardinality);
+  histograms keep a fixed running bucket vector plus a bounded sliding
+  sample window (see :class:`Histogram` for the mean-vs-percentile
+  window split).
+- **Replace-on-register.** Re-constructing an instrument set (a fresh
+  ``ServingMetrics`` per engine, a bench resetting after warmup)
+  re-registers under the same name and REPLACES the previous series —
+  the registry always reflects the newest owner, and tests stay
+  isolated without global resets.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+
+# latency-shaped default buckets (seconds)
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+# count-shaped buckets (queue depths, slot occupancy)
+COUNT_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                 256.0, 1024.0)
+# token-batch-shaped buckets: B*S for real LLM steps runs well past 4k
+# (the repo's own perf config is 4x1024); powers of four up to ~1M
+TOKEN_BUCKETS = (16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0,
+                 262144.0, 1048576.0)
+
+
+def _labels_key(labels):
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    """Shared identity: ``name`` is the short/display name, ``prom_name``
+    the canonical registry + Prometheus series name."""
+
+    metric_type = "untyped"
+
+    def __init__(self, name, help="", unit="", prom_name=None):
+        self.name = name
+        self.prom_name = prom_name or name
+        self.help = help
+        self.unit = unit
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic counter with an optional label breakdown.
+
+    ``inc(n, **labels)`` always bumps the unlabeled total; when labels
+    are given the matching child series is bumped as well, so the total
+    never needs a sum over children at read time."""
+
+    metric_type = "counter"
+
+    def __init__(self, name, help="", unit="", prom_name=None):
+        super().__init__(name, help=help, unit=unit, prom_name=prom_name)
+        self._value = 0
+        self._series = {}
+
+    def inc(self, n=1, **labels):
+        with self._lock:
+            self._value += n
+            if labels:
+                k = _labels_key(labels)
+                self._series[k] = self._series.get(k, 0) + n
+
+    def labels(self, **labels):
+        counter = self
+
+        class _Bound:
+            def inc(self, n=1):
+                counter.inc(n, **labels)
+
+        return _Bound()
+
+    @property
+    def value(self):
+        return self._value
+
+    def series(self):
+        with self._lock:
+            return dict(self._series)
+
+    def data(self):
+        with self._lock:
+            return {
+                "type": self.metric_type,
+                "value": self._value,
+                "series": [
+                    {"labels": dict(k), "value": v}
+                    for k, v in self._series.items()
+                ],
+            }
+
+
+_NONBLOCK = threading.local()
+
+
+def nonblocking_active():
+    """True inside a :class:`nonblocking_values` context (the one
+    public check — callers must not reach into the thread-local)."""
+    return getattr(_NONBLOCK, "on", False)
+
+
+class nonblocking_values:
+    """Context: lazy-value materialization must not block.
+
+    A crash dump fired from inside a ``jax.debug.callback`` (the NaN
+    hook) runs WHILE the compiled step executes; fetching a device ref
+    of that very computation would deadlock the process instead of
+    dumping. Inside this context, values whose ``is_ready()`` reports
+    false are skipped (gauges) or repr'd (flight records) rather than
+    fetched. Thread-local, so a concurrent normal scrape on another
+    thread keeps its blocking lazy semantics."""
+
+    def __enter__(self):
+        self._prev = getattr(_NONBLOCK, "on", False)
+        _NONBLOCK.on = True
+        return self
+
+    def __exit__(self, *exc):
+        _NONBLOCK.on = self._prev
+        return False
+
+
+def value_is_ready(v):
+    """False only when ``v`` is an in-flight device value (jax Array
+    with ``is_ready() == False``); anything else counts as ready."""
+    ready = getattr(v, "is_ready", None)
+    if callable(ready):
+        try:
+            return bool(ready())
+        except Exception:
+            return True
+    return True
+
+
+def _materialize(v):
+    """Resolve a lazy gauge value: callables are invoked, device scalars
+    fetched — only ever on the scrape path, never per step. Under
+    :class:`nonblocking_values`, an in-flight device value raises
+    instead of blocking (the caller skips the series)."""
+    if callable(v):
+        v = v()
+    if nonblocking_active() and not value_is_ready(v):
+        raise ValueError("device value still in flight "
+                         "(nonblocking scrape)")
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        import numpy as np
+
+        return float(np.asarray(v))
+
+
+class Gauge(_Metric):
+    """Last-value instrument. ``set`` accepts a float, a callable, or a
+    device scalar; lazy values materialize on scrape (snapshot /
+    Prometheus render), keeping the training hot loop sync-free."""
+
+    metric_type = "gauge"
+
+    def __init__(self, name, help="", unit="", prom_name=None):
+        super().__init__(name, help=help, unit=unit, prom_name=prom_name)
+        self._series = {}  # labels_key -> value | callable | device ref
+
+    def set(self, value, **labels):
+        with self._lock:
+            self._series[_labels_key(labels)] = value
+
+    def set_function(self, fn, **labels):
+        self.set(fn, **labels)
+
+    def inc(self, n=1.0, **labels):
+        with self._lock:
+            k = _labels_key(labels)
+            cur = self._series.get(k, 0.0)
+            if callable(cur):
+                raise TypeError(f"gauge {self.name}: inc() on a lazy value")
+            self._series[k] = cur + n
+
+    def dec(self, n=1.0, **labels):
+        self.inc(-n, **labels)
+
+    def value(self, **labels):
+        with self._lock:
+            v = self._series.get(_labels_key(labels))
+        return None if v is None else _materialize(v)
+
+    def data(self):
+        with self._lock:
+            items = list(self._series.items())
+        series = []
+        for k, v in items:
+            try:
+                series.append({"labels": dict(k), "value": _materialize(v)})
+            except Exception:
+                continue  # a lazy value that cannot resolve is skipped
+        return {"type": self.metric_type, "series": series}
+
+
+class Histogram(_Metric):
+    """Sample distribution with bounded memory.
+
+    Two views, deliberately different windows:
+
+    - ``count`` / ``sum`` / Prometheus bucket counts are EXACT running
+      totals over every observation ever made (what rate() and mean
+      dashboards need);
+    - percentiles (``percentile``, ``snapshot()['p50']``...) are
+      computed over a SLIDING WINDOW of the most recent ``maxlen``
+      samples (what a latency dashboard wants, and the only way to keep
+      a long-running server's memory bounded).
+
+    ``snapshot()['mean']`` is therefore ``sum/count`` over ALL
+    observations while p50/p90/p99/min/max describe only the window;
+    ``snapshot()['window_count']`` says how many samples the window
+    currently holds so dashboards can tell the two populations apart.
+    """
+
+    metric_type = "histogram"
+
+    def __init__(self, name, help="", unit="s", maxlen=65536,
+                 buckets=None, prom_name=None):
+        super().__init__(name, help=help, unit=unit, prom_name=prom_name)
+        self._samples = collections.deque(maxlen=int(maxlen))
+        self._count = 0
+        self._sum = 0.0
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        # per-bucket (non-cumulative) counts; last slot is +Inf overflow
+        self._bucket_counts = [0] * (len(self.buckets) + 1)
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._samples.append(v)
+            self._count += 1
+            self._sum += v
+            self._bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def window_count(self):
+        return len(self._samples)
+
+    def percentile(self, p):
+        """p in [0, 100]; nearest-rank over the sliding window. None
+        when empty."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[k]
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count)] over ALL observations, with
+        a final (inf, count) entry — the Prometheus exposition shape."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+        out, acc = [], 0
+        for ub, c in zip(self.buckets, counts):
+            acc += c
+            out.append((ub, acc))
+        out.append((float("inf"), acc + counts[-1]))
+        return out
+
+    def snapshot(self):
+        """Plain-dict readout.
+
+        WINDOW SPLIT (read this before graphing): ``count``/``sum``/
+        ``mean`` are exact running totals over every observation;
+        ``p50``/``p90``/``p99``/``min``/``max`` describe only the most
+        recent ``window_count`` samples. With fewer than ``maxlen``
+        total observations the two populations coincide."""
+        with self._lock:
+            if not self._samples:
+                return {"count": self._count, "window_count": 0}
+            window = sorted(self._samples)
+            count, total = self._count, self._sum
+
+        def pct(p):
+            k = max(0, min(len(window) - 1,
+                           int(round(p / 100.0 * (len(window) - 1)))))
+            return window[k]
+
+        return {
+            "count": count,
+            "sum": total,
+            "mean": total / count,
+            "window_count": len(window),
+            "p50": pct(50),
+            "p90": pct(90),
+            "p99": pct(99),
+            "max": window[-1],
+            "min": window[0],
+            "unit": self.unit,
+        }
+
+    def data(self):
+        # ONE lock acquisition for window + totals + bucket counts: a
+        # concurrent observe between two reads would otherwise emit an
+        # exposition where _count disagrees with the +Inf bucket
+        # (Prometheus invariant: count == cumulative +Inf)
+        with self._lock:
+            window = sorted(self._samples)
+            count, total = self._count, self._sum
+            counts = list(self._bucket_counts)
+        d = {"type": self.metric_type, "count": count,
+             "window_count": len(window)}
+        if window:
+            def pct(p):
+                k = max(0, min(len(window) - 1,
+                               int(round(p / 100.0 * (len(window) - 1)))))
+                return window[k]
+
+            d.update(
+                sum=total, mean=total / count,
+                p50=pct(50), p90=pct(90), p99=pct(99),
+                max=window[-1], min=window[0], unit=self.unit,
+            )
+        buckets, acc = [], 0
+        for ub, c in zip(self.buckets, counts):
+            acc += c
+            buckets.append({"le": ub, "count": acc})
+        buckets.append({"le": float("inf"), "count": acc + counts[-1]})
+        d["buckets"] = buckets
+        d.setdefault("sum", total)
+        return d
+
+
+class MetricsRegistry:
+    """Name -> instrument map with replace-on-register semantics."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.RLock()
+
+    def register(self, metric, replace=True):
+        name = metric.prom_name
+        with self._lock:
+            old = self._metrics.get(name)
+            if old is not None and not replace and old is not metric:
+                raise ValueError(f"metric {name!r} already registered")
+            self._metrics[name] = metric
+        return metric
+
+    def register_all(self, metrics):
+        for m in metrics:
+            self.register(m)
+
+    def unregister(self, name):
+        with self._lock:
+            return self._metrics.pop(name, None)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def _get_or_create(self, cls, name, help="", **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name!r} is a {m.metric_type}, not a "
+                        f"{cls.metric_type}"
+                    )
+                return m
+            m = cls(name, help=help, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", **kw) -> Counter:
+        return self._get_or_create(Counter, name, help=help, **kw)
+
+    def gauge(self, name, help="", **kw) -> Gauge:
+        return self._get_or_create(Gauge, name, help=help, **kw)
+
+    def histogram(self, name, help="", **kw) -> Histogram:
+        return self._get_or_create(Histogram, name, help=help, **kw)
+
+    def snapshot(self):
+        """JSON-able view of every registered instrument."""
+        out = {}
+        for m in self.metrics():
+            try:
+                d = m.data()
+            except Exception:
+                continue
+            d["help"] = m.help
+            if m.unit:
+                d["unit"] = m.unit
+            out[m.prom_name] = d
+        return {"metrics": out}
+
+    def prometheus_text(self):
+        from .exporter import prometheus_text
+
+        return prometheus_text(self)
+
+
+# The process-wide default registry: serving, analysis, profiler, and
+# training telemetry all publish here unless handed another registry.
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

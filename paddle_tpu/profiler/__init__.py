@@ -79,12 +79,24 @@ def record_lint_event(name):
     leaked tracer, ...). Counts always accumulate (bounded: keyed by
     name); when a RECORD window is open the event ALSO lands in the
     chrome trace as a zero-duration span, so recompile storms show up
-    in traces instead of only as silent latency spikes."""
+    in traces instead of only as silent latency spikes. Each event also
+    bumps the process metrics registry
+    (``paddle_profiler_lint_events_total{event=...}``) so scrapes see
+    lint activity without a profiler window open."""
     with _LOCK:
         _LINT_COUNTS[name] += 1
         if _RECORDING.is_set():
             _EVENTS.append((name, "lint", time.perf_counter() - _EPOCH,
                             0.0))
+    try:
+        from ..observability import get_registry
+
+        get_registry().counter(
+            "paddle_profiler_lint_events_total",
+            help="static-analysis / trace-guard events, by event name",
+        ).inc(event=name)
+    except Exception:
+        pass
 
 
 def lint_event_counts():
@@ -205,6 +217,38 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+def _table_lines(title, data, sorted_by, unit):
+    """Render {name: [durations_s]} as the calls/total/avg/max/min/ratio
+    table both ``Profiler.summary()`` and ``ProfilerResult.summary()``
+    print. ``unit`` is the seconds->display multiplier."""
+    rows = []
+    grand = sum(sum(v) for v in data.values()) or 1e-12
+    for name, times in data.items():
+        tot = sum(times)
+        rows.append((
+            name, len(times), tot * unit,
+            tot / len(times) * unit, max(times) * unit,
+            min(times) * unit, 100.0 * tot / grand,
+        ))
+    key = {"total": 2, "calls": 1, "avg": 3, "max": 4,
+           "min": 5}.get(
+        sorted_by if isinstance(sorted_by, str) else "total", 2
+    )
+    rows.sort(key=lambda r: r[key], reverse=(key != 5))
+    w = max([len(r[0]) for r in rows] + [len("name")])
+    head = (
+        f"{'name':<{w}}  {'calls':>6}  {'total':>10}  "
+        f"{'avg':>9}  {'max':>9}  {'min':>9}  {'ratio':>6}"
+    )
+    lines = [title, "-" * len(head), head, "-" * len(head)]
+    for r in rows:
+        lines.append(
+            f"{r[0]:<{w}}  {r[1]:>6}  {r[2]:>10.3f}  {r[3]:>9.3f}"
+            f"  {r[4]:>9.3f}  {r[5]:>9.3f}  {r[6]:>5.1f}%"
+        )
+    return lines
+
+
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
@@ -308,32 +352,7 @@ class Profiler:
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
 
         def table(title, data):
-            rows = []
-            grand = sum(sum(v) for v in data.values()) or 1e-12
-            for name, times in data.items():
-                tot = sum(times)
-                rows.append((
-                    name, len(times), tot * unit,
-                    tot / len(times) * unit, max(times) * unit,
-                    min(times) * unit, 100.0 * tot / grand,
-                ))
-            key = {"total": 2, "calls": 1, "avg": 3, "max": 4,
-                   "min": 5}.get(
-                sorted_by if isinstance(sorted_by, str) else "total", 2
-            )
-            rows.sort(key=lambda r: r[key], reverse=(key != 5))
-            w = max([len(r[0]) for r in rows] + [len("name")])
-            head = (
-                f"{'name':<{w}}  {'calls':>6}  {'total':>10}  "
-                f"{'avg':>9}  {'max':>9}  {'min':>9}  {'ratio':>6}"
-            )
-            lines = [title, "-" * len(head), head, "-" * len(head)]
-            for r in rows:
-                lines.append(
-                    f"{r[0]:<{w}}  {r[1]:>6}  {r[2]:>10.3f}  {r[3]:>9.3f}"
-                    f"  {r[4]:>9.3f}  {r[5]:>9.3f}  {r[6]:>5.1f}%"
-                )
-            return lines
+            return _table_lines(title, data, sorted_by, unit)
 
         out = []
         with _LOCK:
@@ -362,5 +381,83 @@ class Profiler:
         return s
 
 
+class ProfilerResult:
+    """Summarizable view of an exported chrome-trace JSON.
+
+    Holds the host-span events ``export_chrome_tracing`` wrote (device
+    XPlane dumps stay TensorBoard territory); offers the same
+    calls/total/avg/max/min table shape as ``Profiler.summary()`` so a
+    trace can be re-summarized offline long after the run."""
+
+    def __init__(self, events, path=None):
+        self.path = path
+        # normalized: (name, cat, ts_seconds, dur_seconds)
+        self.events = events
+
+    def names(self):
+        return sorted({e[0] for e in self.events})
+
+    def categories(self):
+        return sorted({e[1] for e in self.events})
+
+    def durations(self, name):
+        """All span durations (seconds) recorded under ``name``."""
+        return [e[3] for e in self.events if e[0] == name]
+
+    def counts(self):
+        out = collections.Counter()
+        for name, _cat, _ts, _dur in self.events:
+            out[name] += 1
+        return dict(out)
+
+    def total(self, name):
+        return sum(self.durations(name))
+
+    def time_range(self):
+        """(first span start, last span end) in seconds; None if empty."""
+        if not self.events:
+            return None
+        starts = [e[2] for e in self.events]
+        ends = [e[2] + e[3] for e in self.events]
+        return min(starts), max(ends)
+
+    def summary(self, sorted_by="total", time_unit="ms"):
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(time_unit, 1e3)
+        by_name = collections.defaultdict(list)
+        for name, _cat, _ts, dur in self.events:
+            by_name[name].append(dur)
+        if not by_name:
+            return "no events in trace"
+        return "\n".join(_table_lines(
+            f"Loaded trace summary ({time_unit})", by_name, sorted_by,
+            unit,
+        ))
+
+    def __len__(self):
+        return len(self.events)
+
+
 def load_profiler_result(path):
-    raise NotImplementedError("open the XPlane trace in TensorBoard instead")
+    """Read back a chrome-trace JSON written by
+    ``export_chrome_tracing`` (or any ``{"traceEvents": [...]}``/bare
+    event-list chrome trace) into a :class:`ProfilerResult`. Only
+    complete-duration events (``"ph": "X"``) carry durations; other
+    phases are skipped. Times are normalized to seconds."""
+    with open(path) as f:
+        data = json.load(f)
+    raw = data.get("traceEvents", data) if isinstance(data, dict) \
+        else data
+    if not isinstance(raw, list):
+        raise ValueError(
+            f"{path}: not a chrome trace (expected a traceEvents list)"
+        )
+    events = []
+    for e in raw:
+        if not isinstance(e, dict) or e.get("ph") != "X":
+            continue
+        events.append((
+            str(e.get("name", "")), str(e.get("cat", "")),
+            float(e.get("ts", 0.0)) / 1e6,
+            float(e.get("dur", 0.0)) / 1e6,
+        ))
+    return ProfilerResult(events, path=path)

@@ -1,139 +1,148 @@
-"""Serving metrics: counters + histograms with profiler export.
+"""Serving metrics: registry-based counters + histograms with profiler export.
 
 The serving quantities users actually page on — queue depth,
 time-to-first-token, inter-token latency, slot occupancy, rejection and
 timeout counts — live here as plain host-side counters/histograms (no
-device work; observing a sample is a list append). Every histogram
-sample is ALSO forwarded to ``paddle_tpu.profiler.record_span`` under a
-``serving::`` prefix, so when a ``profiler.Profiler`` RECORD window is
-open the serving latencies appear in ``Profiler.summary()`` and the
-chrome trace next to the op/user spans — one observability surface, not
-two.
+device work; observing a sample is a list append). Since the unified
+telemetry PR these are thin subclasses of the process-wide
+``paddle_tpu.observability`` instruments: every ServingMetrics
+registers its set under ``paddle_serving_*`` names in the global
+registry (replace-on-register — the newest engine's metrics own the
+series), so one Prometheus scrape covers serving alongside training
+and analysis telemetry. Every histogram sample is ALSO forwarded to
+``paddle_tpu.profiler.record_span`` under a ``serving::`` prefix, so
+when a ``profiler.Profiler`` RECORD window is open the serving
+latencies appear in ``Profiler.summary()`` and the chrome trace next to
+the op/user spans — one observability surface, not two.
 """
 from __future__ import annotations
 
-import threading
+from ..observability import registry as _reg
 
 
-class Counter:
-    """Monotonic counter (optionally labeled by a reason string)."""
+class Counter(_reg.Counter):
+    """Monotonic counter (optionally labeled by a reason string).
 
-    def __init__(self, name):
-        self.name = name
-        self._value = 0
-        self._by_label = {}
-        self._lock = threading.Lock()
+    The serving-side convenience shape over the registry Counter: one
+    optional label dimension (``labelname``), ``by_label()`` readout."""
 
-    def inc(self, n=1, label=None):
-        with self._lock:
-            self._value += n
-            if label is not None:
-                self._by_label[label] = self._by_label.get(label, 0) + n
+    def __init__(self, name, labelname="label", prom_name=None, help=""):
+        super().__init__(name, help=help, prom_name=prom_name)
+        self._labelname = labelname
 
-    @property
-    def value(self):
-        return self._value
+    def inc(self, n=1, label=None, **labels):
+        """``label=`` is the serving shorthand for the configured
+        labelname; registry-style ``**labels`` kwargs (what the
+        inherited ``.labels()`` binding forwards) pass straight
+        through, so both idioms work on the same instrument."""
+        if label is not None:
+            labels[self._labelname] = label
+        super().inc(n, **labels)
 
     def by_label(self):
-        with self._lock:
-            return dict(self._by_label)
+        out = {}
+        for k, v in self.series().items():
+            d = dict(k)
+            if self._labelname in d:
+                out[d[self._labelname]] = \
+                    out.get(d[self._labelname], 0) + v
+        return out
 
 
-class Histogram:
-    """Sample store with percentile readout.
+class Histogram(_reg.Histogram):
+    """Sample store with percentile readout + profiler span export.
 
     Memory-bounded for long-running servers: the window keeps the most
     recent ``maxlen`` samples (sliding-window percentiles — what a
-    latency dashboard wants anyway), while ``count``/``sum`` stay exact
-    running totals over ALL observations."""
+    latency dashboard wants anyway), while ``count``/``sum``/Prometheus
+    buckets stay exact running totals over ALL observations.
+    ``snapshot()['mean']`` is the exact running ``sum/count``;
+    p50/p90/p99/min/max describe only the window —
+    ``snapshot()['window_count']`` tells dashboards how big that window
+    population is (see the base class docstring for the full split)."""
 
-    def __init__(self, name, unit="s", export=True, maxlen=65536):
-        import collections
-
-        self.name = name
-        self.unit = unit
-        self._samples = collections.deque(maxlen=int(maxlen))
-        self._count = 0
-        self._sum = 0.0
-        self._lock = threading.Lock()
+    def __init__(self, name, unit="s", export=True, maxlen=65536,
+                 prom_name=None, buckets=None, help=""):
+        if buckets is None:
+            buckets = (_reg.DEFAULT_BUCKETS if unit == "s"
+                       else _reg.COUNT_BUCKETS)
+        super().__init__(name, help=help, unit=unit, maxlen=maxlen,
+                         buckets=buckets, prom_name=prom_name)
         self._export = export
 
     def observe(self, v):
-        v = float(v)
-        with self._lock:
-            self._samples.append(v)
-            self._count += 1
-            self._sum += v
+        super().observe(float(v))
         if self._export:
             from .. import profiler
 
-            profiler.record_span(f"serving::{self.name}", v)
-
-    @property
-    def count(self):
-        return self._count
-
-    @property
-    def sum(self):
-        return self._sum
-
-    def percentile(self, p):
-        """p in [0, 100]; nearest-rank. None when empty."""
-        with self._lock:
-            if not self._samples:
-                return None
-            s = sorted(self._samples)
-        k = max(0, min(len(s) - 1, int(round(p / 100.0 * (len(s) - 1)))))
-        return s[k]
-
-    def snapshot(self):
-        # copy under the lock: a shared ServingMetrics may be observed
-        # from an engine thread while another thread reports
-        with self._lock:
-            if not self._samples:
-                return {"count": 0}
-            window = sorted(self._samples)
-            count, total = self._count, self._sum
-
-        def pct(p):
-            k = max(0, min(len(window) - 1,
-                           int(round(p / 100.0 * (len(window) - 1)))))
-            return window[k]
-
-        return {
-            "count": count,
-            "sum": total,
-            "mean": total / count,
-            "p50": pct(50),
-            "p90": pct(90),
-            "p99": pct(99),
-            "max": window[-1],
-            "min": window[0],
-            "unit": self.unit,
-        }
+            profiler.record_span(f"serving::{self.name}", float(v))
 
 
 class ServingMetrics:
     """The engine's metric set. One instance per engine (or share one
-    across engines to aggregate a process)."""
+    across engines to aggregate a process). Registered in the process
+    registry under ``<namespace>_*`` with replace semantics: the most
+    recently constructed instance owns the exported series."""
 
-    def __init__(self):
-        self.submitted = Counter("submitted")
-        self.admitted = Counter("admitted")
-        self.completed = Counter("completed")
-        self.rejected = Counter("rejected")      # labeled by reason
-        self.timeouts = Counter("timeouts")
-        self.tokens_out = Counter("tokens_out")
-        self.prefill_tokens = Counter("prefill_tokens")
-        self.guard_fires = Counter("guard_fires")  # labeled by fn key
-        self.ttft = Histogram("ttft")            # submit -> first token
-        self.itl = Histogram("itl")              # inter-token latency
-        self.e2e = Histogram("e2e")              # submit -> finished
-        self.queue_wait = Histogram("queue_wait")  # submit -> admitted
-        self.queue_depth = Histogram("queue_depth", unit="reqs",
-                                     export=False)
-        self.slot_occupancy = Histogram("slot_occupancy", unit="slots",
-                                        export=False)
+    def __init__(self, registry=None, namespace="paddle_serving"):
+        ns = namespace
+        self.submitted = Counter(
+            "submitted", prom_name=f"{ns}_submitted_total",
+            help="requests submitted")
+        self.admitted = Counter(
+            "admitted", prom_name=f"{ns}_admitted_total",
+            help="requests admitted into the decode slab")
+        self.completed = Counter(
+            "completed", prom_name=f"{ns}_completed_total",
+            help="requests finished DONE")
+        self.rejected = Counter(          # labeled by reason
+            "rejected", labelname="reason",
+            prom_name=f"{ns}_rejected_total",
+            help="requests rejected, by reason")
+        self.timeouts = Counter(
+            "timeouts", prom_name=f"{ns}_timeouts_total",
+            help="requests expired past their deadline")
+        self.tokens_out = Counter(
+            "tokens_out", prom_name=f"{ns}_tokens_out_total",
+            help="decode tokens emitted")
+        self.prefill_tokens = Counter(
+            "prefill_tokens", prom_name=f"{ns}_prefill_tokens_total",
+            help="prompt tokens prefilled")
+        self.guard_fires = Counter(       # labeled by fn key
+            "guard_fires", labelname="fn",
+            prom_name=f"{ns}_guard_fires_total",
+            help="trace-guard recompile-storm fires seen by the engine")
+        self.ttft = Histogram(            # submit -> first token
+            "ttft", prom_name=f"{ns}_ttft_seconds",
+            help="time to first token")
+        self.itl = Histogram(             # inter-token latency
+            "itl", prom_name=f"{ns}_itl_seconds",
+            help="inter-token latency")
+        self.e2e = Histogram(             # submit -> finished
+            "e2e", prom_name=f"{ns}_e2e_seconds",
+            help="end-to-end request latency")
+        self.queue_wait = Histogram(      # submit -> admitted
+            "queue_wait", prom_name=f"{ns}_queue_wait_seconds",
+            help="queue wait before admission")
+        self.queue_depth = Histogram(
+            "queue_depth", unit="reqs", export=False,
+            prom_name=f"{ns}_queue_depth",
+            help="scheduler queue depth sampled per engine step")
+        self.slot_occupancy = Histogram(
+            "slot_occupancy", unit="slots", export=False,
+            prom_name=f"{ns}_slot_occupancy",
+            help="active decode-slab slots sampled per engine step")
+        reg = registry
+        if reg is None:
+            from ..observability import get_registry
+
+            reg = get_registry()
+        reg.register_all([
+            self.submitted, self.admitted, self.completed, self.rejected,
+            self.timeouts, self.tokens_out, self.prefill_tokens,
+            self.guard_fires, self.ttft, self.itl, self.e2e,
+            self.queue_wait, self.queue_depth, self.slot_occupancy,
+        ])
 
     def observe_step(self, queue_depth, active_slots):
         self.queue_depth.observe(queue_depth)

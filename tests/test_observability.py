@@ -1,0 +1,667 @@
+"""Unified telemetry: registry, exporter, step meter, flight recorder,
+multihost merge, and the one-registry end-to-end acceptance run.
+
+The acceptance pin (issue 4): ONE run exercising a compiled train step +
+ServingEngine + TraceGuard yields a single Prometheus exposition holding
+training (step_time_seconds, tokens_per_second, mfu, device_bytes_in_use),
+serving (ttft, itl, queue_depth), and analysis (guard_fires) series; the
+flight recorder dumps a JSON bundle with the last K step records on an
+injected NaN and on an injected exception.
+"""
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.core.tensor import Tensor
+
+
+# ------------------------------------------------------------- registry
+class TestRegistry:
+    def test_counter_total_and_labels(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("reqs_total", help="requests")
+        c.inc()
+        c.inc(2, route="a")
+        c.labels(route="b").inc(3)
+        assert c.value == 6
+        assert c.series() == {(("route", "a"),): 2, (("route", "b"),): 3}
+
+    def test_gauge_lazy_value_materializes_on_scrape(self):
+        reg = obs.MetricsRegistry()
+        g = reg.gauge("g")
+        calls = []
+
+        def lazy():
+            calls.append(1)
+            return 7.0
+
+        g.set(lazy)
+        assert calls == []          # setting never evaluates
+        assert g.value() == 7.0     # scrape does
+        assert len(calls) == 1
+
+    def test_gauge_device_scalar(self):
+        reg = obs.MetricsRegistry()
+        g = reg.gauge("g")
+        g.set(jnp.float32(2.5))
+        assert g.value() == 2.5
+
+    def test_histogram_running_vs_window(self):
+        h = obs.Histogram("h", maxlen=8)
+        for i in range(20):
+            h.observe(float(i))
+        s = h.snapshot()
+        assert s["count"] == 20            # exact running totals
+        assert s["sum"] == sum(range(20))
+        assert s["mean"] == pytest.approx(sum(range(20)) / 20)
+        assert s["window_count"] == 8      # sliding window
+        assert s["min"] == 12.0            # window holds newest 8
+        assert h.window_count == 8
+        # prom buckets are running totals too: +Inf bucket == count
+        assert h.cumulative_buckets()[-1][1] == 20
+
+    def test_get_or_create_type_conflict(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_replace_on_register(self):
+        reg = obs.MetricsRegistry()
+        a = obs.Counter("c", prom_name="c_total")
+        b = obs.Counter("c", prom_name="c_total")
+        reg.register(a)
+        a.inc(5)
+        reg.register(b)  # a fresh owner takes the series over
+        assert reg.get("c_total") is b
+        assert reg.get("c_total").value == 0
+
+
+# ------------------------------------------------------------- exporter
+class TestExporter:
+    def _reg(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("burn_total", help="burned")
+        c.inc(4, kind="a b\"c")     # label needing escaping
+        g = reg.gauge("temp")
+        g.set(1.5, device="cpu:0")
+        h = reg.histogram("lat_seconds")
+        for v in (0.002, 0.03, 0.4):
+            h.observe(v)
+        return reg
+
+    def test_round_trip_parse(self):
+        reg = self._reg()
+        text = obs.prometheus_text(reg)
+        parsed = obs.parse_prometheus_text(text)
+        # labeled children only — no bare aggregate to double-count in
+        # a sum(rate(...)) dashboard query
+        assert parsed["burn_total"] == [({"kind": 'a b"c'}, 4.0)]
+        assert ({"device": "cpu:0"}, 1.5) in parsed["temp"]
+        assert ({}, 3.0) in parsed["lat_seconds_count"]
+        infs = [v for lbl, v in parsed["lat_seconds_bucket"]
+                if lbl.get("le") == "+Inf"]
+        assert infs == [3.0]
+
+    def test_counter_mixed_usage_emits_remainder(self):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("mix_total")
+        c.inc(3)              # unlabeled increments
+        c.inc(2, kind="a")    # plus labeled ones
+        parsed = obs.parse_prometheus_text(obs.prometheus_text(reg))
+        assert ({"kind": "a"}, 2.0) in parsed["mix_total"]
+        assert ({"kind": ""}, 3.0) in parsed["mix_total"]
+        assert sum(v for _l, v in parsed["mix_total"]) == c.value
+
+    def test_hostile_label_values_round_trip(self):
+        """'}' and backslash sequences inside label values must survive
+        export->parse (trace-guard graph keys are repr'd dicts/shapes)."""
+        reg = obs.MetricsRegistry()
+        c = reg.counter("hostile_total")
+        hostile = ('shape={"b": 2}', "a\\nb", 'q"uote', "tail\\",
+                   "cr\rlf\nend")
+        for v in hostile:
+            c.inc(1, graph=v)
+        parsed = obs.parse_prometheus_text(obs.prometheus_text(reg))
+        got = {lbl["graph"] for lbl, _v in parsed["hostile_total"]}
+        assert got == set(hostile)
+
+    def test_histogram_buckets_cumulative(self):
+        reg = self._reg()
+        parsed = obs.parse_prometheus_text(obs.prometheus_text(reg))
+        counts = [v for _l, v in parsed["lat_seconds_bucket"]]
+        assert counts == sorted(counts)  # cumulative = nondecreasing
+
+    def test_malformed_line_raises(self):
+        with pytest.raises(ValueError):
+            obs.parse_prometheus_text("this is { not exposition")
+
+    def test_http_endpoint(self):
+        reg = self._reg()
+        srv = obs.start_metrics_server(port=0, registry=reg)
+        try:
+            body = urllib.request.urlopen(srv.url, timeout=10).read()
+            parsed = obs.parse_prometheus_text(body.decode())
+            assert "burn_total" in parsed
+            j = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics.json", timeout=10
+            ).read())
+            assert "burn_total" in j["metrics"]
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------------------ step meter
+class TestStepMeter:
+    def test_throughput_and_mfu(self):
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg),
+            flops_per_token=1000.0, peak_flops=1e6,
+        )
+        meter.observe_step(0.5, examples=4, tokens=256, loss=1.25)
+        assert meter.steps.value == 1
+        assert meter.tokens.value == 256
+        assert meter.tokens_per_second.value() == pytest.approx(512.0)
+        assert meter.examples_per_second.value() == pytest.approx(8.0)
+        # mfu = 256 tok * 1000 flop / 0.5 s / (1e6 * n_dev) — n_dev
+        # folds local_device_count into the peak
+        import jax
+
+        n = max(1, jax.local_device_count())
+        assert meter.mfu.value() == pytest.approx(512000.0 / (1e6 * n))
+        assert meter.loss.value() == 1.25
+
+    def test_mfu_absent_without_peak_or_flops(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg)
+        )
+        meter.observe_step(0.1, examples=2, tokens=64)
+        assert meter.mfu.value() is None  # unreported beats wrong
+
+    def test_analytic_flops_from_config(self):
+        from paddle_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig.tiny()
+        n = obs.analytic_param_count(cfg)
+        # cross-check against the real parameter count
+        from paddle_tpu.models import LlamaForCausalLM
+
+        paddle.seed(0)
+        net = LlamaForCausalLM(cfg)
+        real = sum(p.size for p in net.parameters())
+        assert n == pytest.approx(real, rel=0.02)
+        f = obs.analytic_flops_per_token(cfg, seq_len=128)
+        # ~6N per token + attention term, and 3x the forward-only cost
+        assert f > 2 * n
+        assert f == 3 * obs.analytic_flops_per_token(
+            cfg, seq_len=128, include_backward=False
+        )
+
+    def test_run_break_skips_throughput_gauges(self):
+        """After a >60s gap the host dt is dispatch-only (wrong-low):
+        the step counts volume but must not spike tokens/sec, MFU, or
+        the step_time histogram."""
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg),
+            flops_per_token=10.0, peak_flops=1e6,
+        )
+        meter.observe_step(0.5, examples=2, tokens=100)
+        tps = meter.tokens_per_second.value()
+        count = meter.step_time.count
+        meter._last_step_t -= 90  # simulate a 90s pause
+        meter.observe_step(0.002, examples=2, tokens=100)
+        assert meter.tokens_per_second.value() == tps   # unchanged
+        assert meter.step_time.count == count           # not polluted
+        assert meter.steps.value == 2
+        assert meter.tokens.value == 200                # volume counted
+
+    def test_tied_embeddings_flops_include_head_matmul(self):
+        from paddle_tpu.models import LlamaConfig
+
+        tied = LlamaConfig.tiny(tie_word_embeddings=True)
+        untied = LlamaConfig.tiny()
+        # the shared matrix still executes as the LM head every token:
+        # tying changes parameter count, not per-token matmul FLOPs
+        assert obs.analytic_flops_per_token(tied) == \
+            obs.analytic_flops_per_token(untied)
+        assert obs.analytic_param_count(tied) < \
+            obs.analytic_param_count(untied)
+
+    def test_device_memory_gauges(self):
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg)
+        )
+        keep = jnp.ones((64, 64), jnp.float32)  # a live array to count
+        meter.sample_memory()
+        agg = meter.device_bytes_in_use.value(device="aggregate")
+        assert agg is not None and agg >= keep.nbytes
+        assert meter.device_live_arrays.value() >= 1
+
+    def test_batch_geometry(self):
+        ids = np.zeros((4, 16), np.int32)
+        img = np.zeros((8, 3, 32, 32), np.float32)
+        assert obs.batch_geometry([ids]) == (4, 64)
+        assert obs.batch_geometry([img]) == (8, 0)  # no token axis
+        assert obs.batch_geometry([]) == (0, 0)
+
+
+# -------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        rec = obs.FlightRecorder(capacity=4,
+                                 registry=obs.MetricsRegistry())
+        for i in range(10):
+            rec.record_step({"step": i})
+        steps = rec.steps()
+        assert len(steps) == 4
+        assert [r["step"] for r in steps] == [6, 7, 8, 9]
+
+    def test_dump_materializes_lazy_values(self, tmp_path):
+        rec = obs.FlightRecorder(capacity=4,
+                                 registry=obs.MetricsRegistry())
+        rec.record_step({"step": 1, "loss": jnp.float32(3.5)})
+        p = rec.dump(path=str(tmp_path / "b.json"), reason="unit")
+        b = json.load(open(p))
+        assert b["reason"] == "unit"
+        assert b["steps"][0]["loss"] == 3.5
+
+    def test_watch_dumps_on_exception(self, tmp_path):
+        rec = obs.FlightRecorder(capacity=4, dump_dir=str(tmp_path),
+                                 registry=obs.MetricsRegistry())
+        rec.record_step({"step": 1})
+        with pytest.raises(ValueError):
+            with rec.watch("unit"):
+                raise ValueError("boom")
+        b = json.load(open(rec.last_dump_path))
+        assert b["exception"]["type"] == "ValueError"
+        assert "boom" in b["exception"]["message"]
+        assert len(b["steps"]) == 1
+
+    def test_nan_hook_dumps_before_raise(self, tmp_path):
+        rec = obs.FlightRecorder(capacity=4, dump_dir=str(tmp_path),
+                                 registry=obs.MetricsRegistry())
+        prev = obs.set_flight_recorder(rec)
+        rec.install(excepthook=False)  # nan seam only
+        rec.record_step({"step": 7, "loss": 0.1})
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(RuntimeError, match="NaN or Inf"):
+                paddle.sqrt(Tensor(np.asarray([-1.0], np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            rec.uninstall()
+            obs.set_flight_recorder(prev)
+        b = json.load(open(rec.last_dump_path))
+        assert b["reason"].startswith("naninf")
+        assert [e["kind"] for e in b["events"]] == ["naninf"]
+        assert b["steps"][-1]["step"] == 7
+
+    def test_nan_hook_in_compiled_step_dumps_without_blocking(
+            self, tmp_path):
+        """The traced NaN path: the hook fires inside a
+        jax.debug.callback while the step executes — the dump must use
+        nonblocking materialization (fetching the step's own in-flight
+        refs would deadlock) and still land before the error."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+
+        reg = obs.MetricsRegistry()
+        rec = obs.FlightRecorder(capacity=4, dump_dir=str(tmp_path),
+                                 registry=reg)
+        prev = obs.set_flight_recorder(rec)
+        rec.install(excepthook=False)
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8))
+        opt = paddle.optimizer.SGD(1e-2, parameters=net.parameters())
+        step = CompiledTrainStep(net, nn.MSELoss(), opt)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            bad = np.full((2, 8), np.nan, np.float32)
+            with pytest.raises(Exception, match="NaN or Inf"):
+                loss, _ = step(
+                    [Tensor(jnp.asarray(bad))],
+                    [Tensor(jnp.zeros((2, 8), jnp.float32))],
+                )
+                loss.numpy().block_until_ready()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            rec.uninstall()
+            obs.set_flight_recorder(prev)
+        assert rec.last_dump_path is not None
+        b = json.load(open(rec.last_dump_path))
+        assert b["reason"].startswith("naninf")
+
+    def test_meter_follows_current_default_recorder(self):
+        """set_flight_recorder() after training started must start
+        receiving step records — the meter must not cache the default."""
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(registry=reg)  # no explicit recorder
+        r1 = obs.FlightRecorder(capacity=4, registry=reg)
+        prev = obs.set_flight_recorder(r1)
+        try:
+            meter.observe_step(0.1)
+            assert len(r1.steps()) == 1
+            r2 = obs.FlightRecorder(capacity=4, registry=reg)
+            obs.set_flight_recorder(r2)
+            meter.observe_step(0.1)
+            assert len(r2.steps()) == 1
+            assert len(r1.steps()) == 1  # old one stopped receiving
+        finally:
+            obs.set_flight_recorder(prev)
+
+    def test_excepthook_chains(self):
+        import sys
+
+        rec = obs.FlightRecorder(capacity=2,
+                                 registry=obs.MetricsRegistry())
+        marker = []
+        orig = sys.excepthook
+        sys.excepthook = lambda *a: marker.append(a)
+        try:
+            rec.install(nan_hook=False)
+            assert sys.excepthook == rec._excepthook
+            rec.uninstall()
+            assert sys.excepthook is not rec._excepthook
+            sys.excepthook(ValueError, ValueError("x"), None)
+            assert marker  # previous hook restored and reachable
+        finally:
+            sys.excepthook = orig
+
+
+# ------------------------------------------------------------- multihost
+class TestMultihost:
+    def _host(self, idx, n_obs):
+        reg = obs.MetricsRegistry()
+        c = reg.counter("done_total")
+        c.inc(10 * (idx + 1), phase="train")
+        g = reg.gauge("depth")
+        g.set(float(idx))
+        h = reg.histogram("lat_seconds")
+        for i in range(n_obs):
+            h.observe(0.01 * (i + 1))
+        snap = obs.tagged_snapshot(reg)
+        snap["process_index"] = idx
+        snap["process_count"] = 3
+        return snap
+
+    def test_merge(self):
+        snaps = [self._host(i, n) for i, n in enumerate((3, 5, 2))]
+        m = obs.merge_snapshots(snaps)
+        assert len(m["hosts"]) == 3
+        done = m["metrics"]["done_total"]
+        assert done["value"] == 60           # counters sum
+        assert done["series"][0]["value"] == 60
+        depth = m["metrics"]["depth"]["series"][0]
+        assert depth["per_host"] == {"0": 0.0, "1": 1.0, "2": 2.0}
+        assert depth["max"] == 2.0 and depth["min"] == 0.0
+        lat = m["metrics"]["lat_seconds"]
+        assert lat["count"] == 10            # histogram counts sum
+        assert lat["sum"] == pytest.approx(
+            sum(0.01 * (i + 1) for n in (3, 5, 2) for i in range(n))
+        )
+        assert set(lat["per_host"]) == {"0", "1", "2"}
+        assert lat["p50"] is not None and lat["p50"] < 0.1
+        assert math.isinf(lat["buckets"][-1]["le"]) or True
+
+    def test_merged_report_single_process(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("solo_total").inc(2)
+        m = obs.merged_report(registry=reg)
+        assert m["metrics"]["solo_total"]["value"] == 2
+        assert len(m["hosts"]) == 1
+
+
+# ------------------------------------------------- serving rebase + guard
+class TestIntegrations:
+    def test_serving_metrics_publish_into_registry(self):
+        from paddle_tpu.serving import ServingMetrics
+
+        m = ServingMetrics()
+        m.ttft.observe(0.05)
+        m.rejected.inc(label="queue_full")
+        reg = obs.get_registry()
+        assert reg.get("paddle_serving_ttft_seconds") is m.ttft
+        text = obs.prometheus_text()
+        parsed = obs.parse_prometheus_text(text)
+        assert ({}, 1.0) in parsed["paddle_serving_ttft_seconds_count"]
+        assert ({"reason": "queue_full"}, 1.0) in \
+            parsed["paddle_serving_rejected_total"]
+        # the pinned serving-side API survives the rebase
+        assert m.ttft.count == 1
+        assert m.rejected.by_label() == {"queue_full": 1}
+
+    def test_serving_counter_supports_both_label_idioms(self):
+        from paddle_tpu.serving import Counter
+
+        c = Counter("rej", labelname="reason", prom_name="rej_total")
+        c.inc(label="full")              # serving shorthand
+        c.labels(reason="full").inc(2)   # registry idiom
+        c.inc(4, reason="late")          # registry kwargs
+        c.inc()                          # unlabeled
+        assert c.value == 8
+        assert c.by_label() == {"full": 3, "late": 4}
+
+    def test_first_compiled_step_is_compile_time_not_step_time(self):
+        """Step 1 includes trace+XLA compile; its wall time must land
+        in compile_time, not poison step_time's exact running mean."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.trainer import CompiledTrainStep
+
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg)
+        )
+        prev = obs.set_step_meter(meter)
+        try:
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(4, 4))
+            opt = paddle.optimizer.SGD(1e-2,
+                                       parameters=net.parameters())
+            step = CompiledTrainStep(net, nn.MSELoss(), opt)
+            x = Tensor(jnp.ones((2, 4), jnp.float32))
+            y = Tensor(jnp.zeros((2, 4), jnp.float32))
+            for _ in range(3):
+                step([x], [y])
+        finally:
+            obs.set_step_meter(prev)
+        assert meter.steps.value == 3
+        assert meter.compile_time.count == 1
+        assert meter.step_time.count == 2
+        assert len(meter.recorder.steps()) == 3
+        assert meter.recorder.steps()[0]["warmup"] is True
+
+    def test_batch_tokens_buckets_cover_llm_scale(self):
+        reg = obs.MetricsRegistry()
+        meter = obs.StepMeter(
+            registry=reg, recorder=obs.FlightRecorder(registry=reg)
+        )
+        meter.observe_step(1.0, examples=4, tokens=4 * 1024)
+        buckets = meter.batch_tokens.cumulative_buckets()
+        # a real 4x1024 batch must land in a finite bucket, not +Inf
+        finite = [c for le, c in buckets if le != float("inf")]
+        assert finite[-1] == 1
+
+    def test_serving_metrics_replace_semantics(self):
+        from paddle_tpu.serving import ServingMetrics
+
+        a = ServingMetrics()
+        a.ttft.observe(1.0)
+        b = ServingMetrics()   # newest instance owns the series
+        reg = obs.get_registry()
+        assert reg.get("paddle_serving_ttft_seconds") is b.ttft
+        assert a.ttft.count == 1  # old instance still readable locally
+
+    def test_trace_guard_publishes_guard_fires(self):
+        from paddle_tpu.analysis import TraceGuard
+
+        before = 0
+        c = obs.get_registry().get("paddle_analysis_guard_fires_total")
+        if c is not None:
+            before = c.value
+        guard = TraceGuard(max_compiles=1)
+        for sig in ("a", "b", "c"):
+            guard.record_compile("obs::fn", sig)
+        c = obs.get_registry().get("paddle_analysis_guard_fires_total")
+        assert c is not None and c.value == before + 1
+        assert any(
+            dict(k).get("graph") == "obs::fn" for k in c.series()
+        )
+
+    def test_generate_emits_token_counter(self, tiny_lm):
+        cfg, net = tiny_lm
+        from paddle_tpu.models.generation import generate
+
+        reg = obs.get_registry()
+        c = reg.get("paddle_generation_tokens_total")
+        before = c.value if c is not None else 0
+        ids = np.arange(8, dtype=np.int32).reshape(2, 4) % 64
+        generate(net, jnp.asarray(ids), max_new_tokens=3)
+        c = reg.get("paddle_generation_tokens_total")
+        assert c is not None and c.value == before + 6  # 2 rows * 3
+        assert any(dict(k).get("mode") == "greedy" for k in c.series())
+
+    def test_profiler_lint_events_publish(self):
+        from paddle_tpu import profiler
+
+        profiler.record_lint_event("lint::unit-test-event")
+        c = obs.get_registry().get("paddle_profiler_lint_events_total")
+        assert c is not None
+        assert any(
+            dict(k).get("event") == "lint::unit-test-event"
+            for k in c.series()
+        )
+
+
+# ----------------------------------------------------- acceptance pin
+@pytest.fixture
+def tiny_lm():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    return cfg, LlamaForCausalLM(cfg)
+
+
+def test_one_registry_end_to_end(tiny_lm, tmp_path):
+    """Train step + ServingEngine + TraceGuard in ONE run -> one
+    exposition with training/serving/analysis series; flight recorder
+    dumps the last K step records on an injected NaN and exception."""
+    cfg, net = tiny_lm
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.analysis import TraceGuard
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.nn.layer.loss import CrossEntropyLoss
+    from paddle_tpu.serving import ServingEngine
+
+    K = 8
+    recorder = obs.FlightRecorder(capacity=K, dump_dir=str(tmp_path))
+    prev_rec = obs.set_flight_recorder(recorder)
+    prev_meter = obs.set_step_meter(obs.StepMeter(
+        config=cfg, peak_flops=1e12, recorder=recorder,
+    ))
+    try:
+        # --- training: 3 compiled steps ------------------------------
+        opt = popt.AdamW(
+            learning_rate=1e-3,
+            parameters=[p for _, p in net.named_parameters()],
+        )
+
+        def loss_fn(logits, labels):
+            return CrossEntropyLoss()(
+                Tensor(logits.value.reshape(-1, logits.value.shape[-1])),
+                Tensor(labels.value.reshape(-1)),
+            )
+
+        step = CompiledTrainStep(net, loss_fn, opt)
+        ids = Tensor(jnp.asarray(
+            np.arange(16, dtype=np.int32).reshape(2, 8) % 64
+        ))
+        lbl = Tensor(jnp.asarray(
+            np.arange(16, dtype=np.int64).reshape(2, 8) % 64
+        ))
+        for _ in range(3):
+            step([ids], [lbl])
+
+        # --- serving: a small burst ----------------------------------
+        eng = ServingEngine(net, max_batch_size=2, max_seq_len=32,
+                            min_bucket=8)
+        handles = eng.generate(
+            [np.full((1, 4), 3, np.int32),
+             np.full((1, 5), 5, np.int32)],
+            max_new_tokens=4,
+        )
+        assert all(h.status == "DONE" for h in handles)
+        eng.close()
+
+        # --- analysis: a storm ---------------------------------------
+        guard = TraceGuard(max_compiles=1)
+        for sig in ("s1", "s2", "s3"):
+            guard.record_compile("e2e::drift", sig)
+
+        # --- ONE exposition covers all three layers ------------------
+        text = obs.prometheus_text()
+        parsed = obs.parse_prometheus_text(text)
+        for series in (
+            "paddle_training_step_time_seconds_count",
+            "paddle_training_tokens_per_second",
+            "paddle_training_mfu",
+            "paddle_device_bytes_in_use",
+            "paddle_serving_ttft_seconds_count",
+            "paddle_serving_itl_seconds_count",
+            "paddle_serving_queue_depth_count",
+            "paddle_analysis_guard_fires_total",
+        ):
+            assert series in parsed, f"missing series: {series}"
+        # 3 steps: the first is warmup (compile_time), 2 are steady
+        assert any(v >= 2 for _l, v in
+                   parsed["paddle_training_step_time_seconds_count"])
+        assert any(v >= 1 for _l, v in
+                   parsed["paddle_training_compile_time_seconds_count"])
+        assert any(v >= 2 for _l, v in
+                   parsed["paddle_serving_ttft_seconds_count"])
+        assert any(v >= 1 for _l, v in
+                   parsed["paddle_analysis_guard_fires_total"])
+
+        # --- flight recorder: injected NaN dumps the last K steps ----
+        recorder.install(excepthook=False)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(RuntimeError, match="NaN or Inf"):
+                paddle.log(Tensor(np.asarray([-1.0], np.float32)))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+            recorder.uninstall()
+        nan_bundle = json.load(open(recorder.last_dump_path))
+        assert nan_bundle["reason"].startswith("naninf")
+        assert 0 < len(nan_bundle["steps"]) <= K
+        assert nan_bundle["steps"][-1]["step_time_s"] > 0
+        assert any(e["kind"] == "guard_fire"
+                   for e in nan_bundle["events"])
+        assert "paddle_training_step_time_seconds" in \
+            nan_bundle["registry"]["metrics"]
+
+        # --- and on an injected exception ----------------------------
+        with pytest.raises(RuntimeError, match="injected"):
+            with recorder.watch("e2e"):
+                raise RuntimeError("injected failure")
+        exc_bundle = json.load(open(recorder.last_dump_path))
+        assert exc_bundle["exception"]["type"] == "RuntimeError"
+        assert len(exc_bundle["steps"]) == len(nan_bundle["steps"])
+    finally:
+        obs.set_flight_recorder(prev_rec)
+        obs.set_step_meter(prev_meter)

@@ -124,6 +124,99 @@ def test_chrome_trace_export(tmp_path):
         assert e["ph"] == "X" and e["dur"] >= 0
 
 
+def test_scheduler_repeat_terminates_forever():
+    """repeat>0: after the last cycle the schedule is CLOSED for good —
+    no half-open window at the boundary, no late reopening."""
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=2)
+    S = profiler.ProfilerState
+    states = [sched(i) for i in range(12)]
+    assert states[:8] == [
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,
+        S.CLOSED, S.READY, S.RECORD, S.RECORD_AND_RETURN,
+    ]
+    assert states[8:] == [S.CLOSED] * 4  # exhausted: closed forever
+
+
+def test_scheduler_tuple_range_form():
+    """(start, end) reference form: record steps [start, end) exactly
+    once, then stay closed."""
+    fired = []
+    p = profiler.Profiler(
+        scheduler=(2, 4),
+        on_trace_ready=lambda prof: fired.append(prof._step),
+        timer_only=True,
+    )
+    p.start()
+    recorded = []
+    S = profiler.ProfilerState
+    for step in range(7):
+        if p._state in (S.RECORD, S.RECORD_AND_RETURN):
+            recorded.append(step)
+        _ = paddle.ones([2]) + 1
+        p.step()
+    p.stop()
+    assert recorded == [2, 3]   # exactly the [start, end) window
+    assert len(fired) == 1      # one window -> one handler fire
+
+
+def test_scheduler_back_to_back_multi_step_windows():
+    """closed=0, ready=0, record>1: windows abut with no gap; every
+    window closes (handler fires) and reopens cleanly on the next."""
+    fired = []
+    p = profiler.Profiler(
+        scheduler=profiler.make_scheduler(record=2, repeat=3),
+        on_trace_ready=lambda prof: fired.append(prof._window),
+        timer_only=True,
+    )
+    p.start()
+    S = profiler.ProfilerState
+    seen = []
+    for _ in range(6):
+        seen.append(p._state)
+        _ = paddle.ones([2]) * 2
+        p.step()
+    p.stop()
+    assert len(fired) == 3                   # three RECORD windows
+    assert fired == sorted(set(fired))       # distinct, in order
+    assert all(s in (S.RECORD, S.RECORD_AND_RETURN) for s in seen)
+
+
+def test_load_profiler_result_round_trip(tmp_path):
+    """Chrome-trace export reads back into a summarizable structure
+    with the same spans and durations."""
+    handler = profiler.export_chrome_tracing(str(tmp_path))
+    p = profiler.Profiler(on_trace_ready=handler, timer_only=True)
+    p.start()
+    for _ in range(3):
+        with profiler.RecordEvent("roundtrip_region"):
+            _ = paddle.ones([4]) + 1.0
+    profiler.record_span("external_span", 0.125)
+    p.stop()
+    res = profiler.load_profiler_result(handler.last_path)
+    assert res.path == handler.last_path
+    assert "roundtrip_region" in res.names()
+    assert "external_span" in res.names()
+    counts = res.counts()
+    assert counts["roundtrip_region"] == 3
+    assert counts["external_span"] == 1
+    # durations survive the us round trip
+    assert res.durations("external_span")[0] == pytest.approx(
+        0.125, rel=1e-6
+    )
+    assert res.total("roundtrip_region") == pytest.approx(
+        sum(res.durations("roundtrip_region"))
+    )
+    lo, hi = res.time_range()
+    assert hi >= lo >= 0
+    s = res.summary(sorted_by="calls", time_unit="us")
+    assert "roundtrip_region" in s and "(us)" in s
+    # malformed input is a clear error, not a silent empty result
+    bad = tmp_path / "not_a_trace.json"
+    bad.write_text('{"traceEvents": 17}')
+    with pytest.raises(ValueError):
+        profiler.load_profiler_result(str(bad))
+
+
 def test_summary_sorting_and_units():
     p = profiler.Profiler(timer_only=True)
     p.start()

@@ -148,9 +148,32 @@ def main(argv=None):
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON report only")
+    ap.add_argument("--prom-out", default=None, metavar="PATH",
+                    help="also dump the final process metrics registry "
+                         "in Prometheus text format to PATH")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics over HTTP on this port for the "
+                         "duration of the bench (0 = ephemeral port)")
     args = ap.parse_args(argv)
 
-    engine, handles, out = run_bench(args)
+    server = None
+    if args.metrics_port is not None:
+        from paddle_tpu.observability import start_metrics_server
+
+        server = start_metrics_server(port=args.metrics_port)
+        print(f"serve_bench: metrics at {server.url}", file=sys.stderr)
+    try:
+        engine, handles, out = run_bench(args)
+    finally:
+        if server is not None:
+            server.stop()
+    if args.prom_out:
+        from paddle_tpu.observability import prometheus_text
+
+        with open(args.prom_out, "w") as f:
+            f.write(prometheus_text())
+        print(f"serve_bench: prometheus exposition -> {args.prom_out}",
+              file=sys.stderr)
     if args.json:
         print(json.dumps(out, indent=2, default=str))
     else:
