@@ -4,7 +4,7 @@
 PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
-.PHONY: lint lint-fast lint-update test tier1 metrics-smoke
+.PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -32,6 +32,13 @@ tier1:
 # are present, and that the flight recorder's bundle round-trips.
 metrics-smoke:
 	$(ENV) $(PY) tools/metrics_smoke.py
+
+# Crash-consistency gate: a subprocess trains with async saves enabled,
+# is SIGKILLed mid-save (several rounds, varied kill points), relaunches,
+# and must resume from the last COMMITTED step with bit-identical params;
+# every committed checkpoint must pass full manifest verification.
+ckpt-smoke:
+	$(ENV) $(PY) tools/ckpt_smoke.py
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

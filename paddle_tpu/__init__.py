@@ -208,6 +208,7 @@ from . import static  # noqa: E402
 from . import jit  # noqa: E402
 from . import profiler  # noqa: E402
 from . import observability  # noqa: E402
+from . import checkpoint  # noqa: E402
 from . import utils  # noqa: E402
 from .utils.flags import get_flags, set_flags  # noqa: E402
 from . import audio  # noqa: E402

@@ -1,0 +1,51 @@
+"""paddle_tpu.checkpoint — fault-tolerant checkpoint runtime.
+
+Reference parity: ``paddle.distributed.checkpoint`` + fleet elastic's
+restart-from-checkpoint recovery model (unverified, mount empty),
+re-architected around Orbax-style async TPU checkpointing. The layered
+design:
+
+- :mod:`snapshot` — on-device copies decouple the save from the train
+  loop's buffer donation; the device→host fetch happens off-thread;
+- :mod:`async_saver` — one background writer, at most one save in
+  flight, backpressure (reported as blocked time) when a second save
+  triggers early;
+- :mod:`commit` — shards + per-file CRC32s stream into ``step_N.tmp``,
+  a manifest is written last, and one rename publishes the checkpoint:
+  it exists completely or not at all;
+- :mod:`manager` — :class:`CheckpointManager` owns save policy,
+  last-K/every-M retention, orphan GC, verified restore with fallback,
+  SIGTERM emergency saves, and ``paddle_ckpt_*`` registry metrics.
+
+The raw sharded serializer (reshard-on-load) stays in
+``paddle_tpu.distributed.checkpoint``; this package is the runtime that
+decides when to call it and whether to trust what it reads back.
+"""
+from .async_saver import AsyncSaver  # noqa: F401
+from .commit import (  # noqa: F401
+    LATEST_FILE,
+    MANIFEST_FILE,
+    gc_orphans,
+    latest_committed,
+    list_committed,
+    read_manifest,
+    verify_checkpoint,
+)
+from .manager import (  # noqa: F401
+    CheckpointManager,
+    CheckpointPolicy,
+    RestoreResult,
+)
+from .snapshot import (  # noqa: F401
+    snapshot_is_ready,
+    snapshot_nbytes,
+    snapshot_state,
+)
+
+__all__ = [
+    "CheckpointManager", "CheckpointPolicy", "RestoreResult",
+    "AsyncSaver",
+    "snapshot_state", "snapshot_is_ready", "snapshot_nbytes",
+    "latest_committed", "list_committed", "verify_checkpoint",
+    "read_manifest", "gc_orphans", "MANIFEST_FILE", "LATEST_FILE",
+]

@@ -1,0 +1,607 @@
+"""CheckpointManager — policy, async saves, verified restore, preemption.
+
+Reference parity: upstream fleet's checkpoint/elastic pairing ("kill one
+worker → training resumes", python/paddle/distributed/fleet/, unverified,
+mount empty) with Orbax-style async commit discipline on the TPU side.
+
+The manager owns everything the raw serializer does not decide:
+
+- **when** to save (:class:`CheckpointPolicy` — every N steps and/or
+  every S seconds), driven by :meth:`on_step` from the compiled trainer
+  or the hapi fit loop;
+- **how** to save without stalling the chip: an on-device snapshot
+  (snapshot.py) handed to a single background writer (async_saver.py),
+  committed atomically (commit.py); backpressure and emergency saves
+  report into ``paddle_ckpt_blocked_seconds`` and are excluded from
+  ``step_time`` via ``StepMeter.note_blocked``;
+- **what** to keep: last-K plus every-M-steps retention, orphaned
+  ``.tmp`` GC at startup;
+- **whether** what came back is intact: :meth:`restore_or_init` verifies
+  manifest checksums and falls back to the previous committed
+  checkpoint (bumping ``paddle_ckpt_restore_fallbacks_total``) instead
+  of crashing on a torn or bit-rotted save;
+- **preemption**: SIGTERM triggers an emergency synchronous save within
+  a grace window, so a preempted worker loses at most the current step.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import signal
+import threading
+import time
+
+import numpy as np
+
+from ..core import random as random_mod
+from ..distributed.checkpoint.save_load import (
+    load_state_dict,
+    save_state_dict,
+)
+from ..observability import (
+    Counter,
+    Gauge,
+    Histogram,
+    get_registry,
+)
+from . import commit as commit_mod
+from .async_saver import AsyncSaver
+from .snapshot import snapshot_nbytes, snapshot_state
+
+logger = logging.getLogger("paddle_tpu.checkpoint")
+
+# save durations run from milliseconds (tiny CI nets) to many minutes
+# (multi-TB sharded states on real pods)
+SAVE_SECONDS_BUCKETS = (
+    0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
+)
+
+# a .tmp dir younger than this may belong to ANOTHER process's live save
+# (launcher-style deployments share the root without sharing a jax
+# world); startup GC only reaps older ones
+ORPHAN_TMP_MIN_AGE_S = 300.0
+
+
+class CheckpointPolicy:
+    """When to save and what to keep.
+
+    ``save_every_steps`` / ``save_every_seconds``: either (or both) may
+    trigger a save; time-based triggers are what keep a slow-step run
+    from going hours between checkpoints. ``keep_last_k`` bounds disk;
+    ``keep_every_m`` additionally pins every M-th step forever (the
+    "keep a trail for post-hoc analysis" knob)."""
+
+    def __init__(self, save_every_steps=None, save_every_seconds=None,
+                 keep_last_k=3, keep_every_m=None):
+        self.save_every_steps = (
+            int(save_every_steps) if save_every_steps else None
+        )
+        self.save_every_seconds = (
+            float(save_every_seconds) if save_every_seconds else None
+        )
+        self.keep_last_k = max(1, int(keep_last_k))
+        self.keep_every_m = int(keep_every_m) if keep_every_m else None
+
+    def should_save(self, step, now, last_saved_step, last_saved_time):
+        if step == last_saved_step:
+            return False
+        if self.save_every_steps is not None and \
+                step - last_saved_step >= self.save_every_steps:
+            return True
+        if self.save_every_seconds is not None and \
+                now - last_saved_time >= self.save_every_seconds:
+            return True
+        return False
+
+    def retained_steps(self, steps_newest_first):
+        keep = set(steps_newest_first[: self.keep_last_k])
+        if self.keep_every_m:
+            keep.update(
+                s for s in steps_newest_first if s % self.keep_every_m == 0
+            )
+        return keep
+
+
+class RestoreResult:
+    """What :meth:`CheckpointManager.restore_or_init` found."""
+
+    def __init__(self, restored, step, path):
+        self.restored = bool(restored)
+        self.step = int(step)
+        self.path = path
+
+    def __repr__(self):
+        return (
+            f"RestoreResult(restored={self.restored}, step={self.step}, "
+            f"path={self.path!r})"
+        )
+
+
+def _fallback_reason(problems):
+    first = problems[0] if problems else ""
+    if first.startswith("manifest"):
+        return "manifest_missing"
+    if first.startswith("missing file"):
+        return "missing_shard"
+    if first.startswith(("size mismatch", "checksum mismatch")):
+        return "checksum_mismatch"
+    if first.startswith(("metadata", "shard not covered")):
+        return "metadata_error"
+    return "load_error"
+
+
+class CheckpointManager:
+    """Fault-tolerant checkpoint runtime over a checkpoint root dir.
+
+    Typical wiring::
+
+        mgr = CheckpointManager("ckpts", network=net, optimizer=opt,
+                                policy=CheckpointPolicy(save_every_steps=100))
+        res = mgr.restore_or_init()          # crash-safe auto-resume
+        trainer.attach_checkpoint(mgr)       # or Model.fit(checkpoint=mgr)
+        mgr.install_preemption_handler()     # SIGTERM -> emergency save
+
+    ``state_fn(step)`` may replace the default state assembly (model +
+    optimizer state dicts + step + RNG key data) for custom loops.
+    """
+
+    def __init__(self, root, *, network=None, optimizer=None,
+                 state_fn=None, policy=None, async_saves=True,
+                 registry=None, manifest_extra_fn=None,
+                 coordinator_rank=0):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.network = network
+        self.optimizer = optimizer
+        self._state_fn = state_fn
+        self.policy = policy or CheckpointPolicy()
+        self.async_saves = bool(async_saves)
+        self.coordinator_rank = int(coordinator_rank)
+        self._manifest_extra_fn = manifest_extra_fn
+        self._serialize = save_state_dict  # test seam: wrap to slow/fault
+        self._lock = threading.Lock()
+        self._last_step = 0
+        self._last_saved_step = 0  # steps are 1-based: first save at N
+        self._last_saved_time = time.monotonic()
+        self.preempted = False
+        self._prev_handlers = {}
+        self._preempt_thread = None
+        self._init_metrics(registry or get_registry())
+        self._saver = (
+            AsyncSaver(on_error=self._on_writer_error)
+            if self.async_saves else None
+        )
+        if self._should_gc_orphans():
+            removed = commit_mod.gc_orphans(
+                self.root, min_age_s=ORPHAN_TMP_MIN_AGE_S
+            )
+            for p in removed:
+                logger.warning("checkpoint: removed orphaned save %s", p)
+                self.fallbacks_total.inc(reason="orphan_tmp")
+                self._note_event("checkpoint_orphan_gc", path=p)
+
+    # ------------------------------------------------------------- plumbing
+    def _init_metrics(self, reg):
+        self.registry = reg
+        self.save_seconds = Histogram(
+            "ckpt_save_seconds", unit="s", buckets=SAVE_SECONDS_BUCKETS,
+            prom_name="paddle_ckpt_save_seconds",
+            help="wall time of one checkpoint write+commit (writer-side)",
+        )
+        self.blocked_seconds = Histogram(
+            "ckpt_blocked_seconds", unit="s",
+            prom_name="paddle_ckpt_blocked_seconds",
+            help="train-loop stalls caused by checkpointing (writer "
+                 "backpressure, synchronous/emergency saves) — excluded "
+                 "from paddle_training_step_time_seconds",
+        )
+        self.bytes_total = Counter(
+            "ckpt_bytes", unit="bytes",
+            prom_name="paddle_ckpt_bytes_total",
+            help="checkpoint bytes committed to storage",
+        )
+        self.saves_total = Counter(
+            "ckpt_saves", prom_name="paddle_ckpt_saves_total",
+            help="committed checkpoints by mode (async|sync|emergency)",
+        )
+        self.save_failures_total = Counter(
+            "ckpt_save_failures",
+            prom_name="paddle_ckpt_save_failures_total",
+            help="checkpoint saves that errored (training continued)",
+        )
+        self.last_step = Gauge(
+            "ckpt_last_step", prom_name="paddle_ckpt_last_step",
+            help="step of the newest committed checkpoint",
+        )
+        self.fallbacks_total = Counter(
+            "ckpt_restore_fallbacks",
+            prom_name="paddle_ckpt_restore_fallbacks_total",
+            help="restore candidates rejected (torn/corrupt/orphaned) "
+                 "by reason",
+        )
+        self.restores_total = Counter(
+            "ckpt_restores", prom_name="paddle_ckpt_restores_total",
+            help="restore_or_init outcomes (restored|init)",
+        )
+        reg.register_all([
+            self.save_seconds, self.blocked_seconds, self.bytes_total,
+            self.saves_total, self.save_failures_total, self.last_step,
+            self.fallbacks_total, self.restores_total,
+        ])
+
+    @staticmethod
+    def _process_count():
+        try:
+            import jax
+
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    @staticmethod
+    def _process_index():
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    def _is_coordinator(self):
+        return self._process_index() == self.coordinator_rank or \
+            self._process_count() == 1
+
+    def _should_gc_orphans(self):
+        """Startup GC touches a SHARED directory: in launcher-style
+        deployments every rank is its own single-process jax world
+        (process_count == 1 everywhere), so gate on the launcher's rank
+        env too — peers' in-flight saves are not this rank's to reap
+        (the age window in gc_orphans is the second guard)."""
+        if not self._is_coordinator():
+            return False
+        env_rank = os.environ.get("PADDLE_TRAINER_ID", "").strip()
+        if env_rank.isdigit():
+            return int(env_rank) == self.coordinator_rank
+        return True
+
+    def _note_event(self, kind, **info):
+        try:
+            from ..observability import get_flight_recorder
+
+            get_flight_recorder().note(kind, **info)
+        except Exception:
+            pass
+
+    def _note_blocked(self, seconds, reason):
+        """A train-loop stall attributable to checkpointing: publish the
+        dedicated histogram and tell the StepMeter to EXCLUDE it from
+        the next dispatch-to-dispatch step_time interval, so tokens/sec
+        and MFU are not silently deflated by save stalls."""
+        self.blocked_seconds.observe(seconds)
+        try:
+            from ..observability import get_step_meter
+
+            get_step_meter().note_blocked(seconds)
+        except Exception:
+            pass
+        self._note_event(
+            "checkpoint_blocked", seconds=seconds, reason=reason
+        )
+
+    def _on_writer_error(self, exc):
+        self.save_failures_total.inc()
+        self._note_event("checkpoint_save_failed", error=repr(exc))
+        logger.error("checkpoint: background save failed: %r", exc)
+
+    # ---------------------------------------------------------------- state
+    def bind(self, network=None, optimizer=None):
+        """Late binding for managers constructed before the model (the
+        hapi callback binds at on_train_begin)."""
+        if network is not None and self.network is None:
+            self.network = network
+        if optimizer is not None and self.optimizer is None:
+            self.optimizer = optimizer
+        return self
+
+    def _build_state(self, step):
+        if self._state_fn is not None:
+            return self._state_fn(step)
+        if self.network is None:
+            raise RuntimeError(
+                "CheckpointManager has no network bound and no state_fn; "
+                "pass network=/optimizer= or state_fn= at construction, "
+                "or bind() before saving"
+            )
+        state = {"model": self.network.state_dict()}
+        if self.optimizer is not None:
+            state["opt"] = self.optimizer.state_dict()
+        state["step"] = int(step if step is not None else self._last_step)
+        state["rng"] = np.asarray(random_mod.get_rng_state())
+        return state
+
+    # ---------------------------------------------------------------- saves
+    def on_step(self, step):
+        """Per-step hook (compiled trainer / fit loop): updates the step
+        clock and saves when policy says so. Returns True if a save was
+        triggered."""
+        step = int(step)
+        with self._lock:
+            self._last_step = step
+            trigger = self.policy.should_save(
+                step, time.monotonic(),
+                self._last_saved_step, self._last_saved_time,
+            )
+        if trigger:
+            self.save(step)
+        return trigger
+
+    def save(self, step=None, blocking=None, mode=None):
+        """Checkpoint the current state at ``step``. ``blocking=False``
+        (the async default) snapshots on the caller thread and hands the
+        write to the background writer; ``blocking=True`` writes+commits
+        before returning."""
+        step = int(self._last_step if step is None else step)
+        if blocking is None:
+            blocking = not self.async_saves
+        mode = mode or ("sync" if blocking else "async")
+        state = self._build_state(step)
+        snap = snapshot_state(state)
+        with self._lock:
+            prev = (self._last_saved_step, self._last_saved_time)
+            self._last_saved_step = step
+            self._last_saved_time = time.monotonic()
+
+        def write():
+            # the saved-marker was advanced optimistically (policy must
+            # not re-trigger while the write runs); a FAILED write rolls
+            # it back so the next policy check — and an emergency save —
+            # knows this step never landed
+            try:
+                self._write_and_commit(step, snap, mode)
+            except BaseException:
+                with self._lock:
+                    if self._last_saved_step == step:
+                        (self._last_saved_step,
+                         self._last_saved_time) = prev
+                raise
+
+        if blocking or self._saver is None:
+            t0 = time.perf_counter()
+            write()
+            self._note_blocked(time.perf_counter() - t0, reason=mode)
+        else:
+            blocked = self._saver.submit(write)
+            if blocked > 1e-4:
+                # backpressure: the previous save was still in flight
+                self._note_blocked(blocked, reason="backpressure")
+        return step
+
+    def _write_and_commit(self, step, snap, mode):
+        """Writer-side: serialize shards into step_N.tmp, write the
+        manifest, barrier, rename. Runs on the background writer thread
+        for async saves."""
+        t0 = time.perf_counter()
+        tmp = commit_mod.tmp_dir(self.root, step)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        files = self._serialize(snap, tmp) or {}
+        nprocs = self._process_count()
+        if nprocs > 1:
+            # manifest needs every process's file digests; the gather
+            # doubles as the all-shards-on-storage barrier
+            from ..distributed import communication as comm
+
+            gathered = []  # all_gather_object APPENDS one entry per rank
+            comm.all_gather_object(gathered, files)
+            files = {}
+            for part in gathered:
+                files.update(part or {})
+        extra = None
+        if self._manifest_extra_fn is not None:
+            extra = self._manifest_extra_fn(step, snap)
+        path = None
+        if self._is_coordinator():
+            commit_mod.write_manifest(tmp, step, files, extra=extra)
+            path = commit_mod.commit(self.root, step)
+            self._apply_retention()
+        if nprocs > 1:
+            from ..distributed import communication as comm
+
+            comm.barrier()  # nobody resumes past a half-published commit
+        dt = time.perf_counter() - t0
+        nbytes = sum(int(rec["bytes"]) for rec in files.values())
+        self.save_seconds.observe(dt)
+        self.bytes_total.inc(nbytes)
+        self.saves_total.inc(mode=mode)
+        self.last_step.set(step)
+        self._note_event(
+            "checkpoint_commit", step=step, seconds=dt, bytes=nbytes,
+            mode=mode, path=path or commit_mod.step_dir(self.root, step),
+        )
+        return path
+
+    def _apply_retention(self):
+        committed = commit_mod.list_committed(self.root)
+        keep = self.policy.retained_steps([s for s, _ in committed])
+        for s, path in committed:
+            if s not in keep:
+                shutil.rmtree(path, ignore_errors=True)
+                self._note_event("checkpoint_retired", step=s, path=path)
+
+    def wait(self, timeout=None):
+        """Drain any in-flight background save."""
+        if self._saver is not None:
+            return self._saver.wait(timeout)
+        return True
+
+    def finalize(self):
+        """End-of-training: drain the writer (and any emergency save)
+        so the last commit lands."""
+        self.join_preemption()
+        self.wait()
+        if self._saver is not None and self._saver.last_error is not None:
+            logger.error(
+                "checkpoint: last background save error: %r",
+                self._saver.last_error,
+            )
+
+    def close(self):
+        self.finalize()
+        if self._saver is not None:
+            self._saver.close()
+
+    # -------------------------------------------------------------- restore
+    def restore_or_init(self):
+        """Crash-safe auto-resume: load the newest INTACT committed
+        checkpoint into the bound network/optimizer (and RNG state), or
+        leave the fresh init in place when none exists.
+
+        Every candidate is verified against its manifest (checksums,
+        sizes, shard coverage) before any load; a torn or corrupted
+        checkpoint is logged, counted in
+        ``paddle_ckpt_restore_fallbacks_total{reason=...}``, and skipped
+        in favor of the previous one — a bad newest save degrades to
+        losing one checkpoint interval, never to a crash loop."""
+        state = self._build_state(None)
+        for step, path, manifest in commit_mod.list_candidates(self.root):
+            if manifest is None:
+                self._reject(path, ["manifest missing or unparsable"])
+                continue
+            problems = commit_mod.verify_checkpoint(path)
+            if problems:
+                self._reject(path, problems)
+                continue
+            try:
+                load_state_dict(state, path)
+            except Exception as e:
+                self._reject(path, [f"load failed: {e!r}"], reason="load_error")
+                continue
+            if self.optimizer is not None and isinstance(
+                state.get("opt"), dict
+            ):
+                self.optimizer.set_state_dict(state["opt"])
+            if state.get("rng") is not None and self._state_fn is None:
+                try:
+                    random_mod.set_rng_state(np.asarray(state["rng"]))
+                except Exception:
+                    logger.warning(
+                        "checkpoint: RNG state from %s not restorable",
+                        path,
+                    )
+            restored_step = int(state.get("step", step))
+            with self._lock:
+                self._last_step = restored_step
+                self._last_saved_step = restored_step
+                self._last_saved_time = time.monotonic()
+            self.restores_total.inc(outcome="restored")
+            self._note_event(
+                "checkpoint_restore", step=restored_step, path=path
+            )
+            logger.info(
+                "checkpoint: resumed from %s (step %d)", path, restored_step
+            )
+            return RestoreResult(True, restored_step, path)
+        self.restores_total.inc(outcome="init")
+        self._note_event("checkpoint_restore", step=0, path=None)
+        return RestoreResult(False, 0, None)
+
+    def _reject(self, path, problems, reason=None):
+        reason = reason or _fallback_reason(problems)
+        self.fallbacks_total.inc(reason=reason)
+        self._note_event(
+            "checkpoint_fallback", path=path, reason=reason,
+            problems=problems[:4],
+        )
+        logger.warning(
+            "checkpoint: skipping %s (%s): %s", path, reason, problems[:4]
+        )
+
+    # ----------------------------------------------------------- preemption
+    def install_preemption_handler(self, signals=(signal.SIGTERM,),
+                                   grace_seconds=30.0):
+        """SIGTERM (preemption notice) → drain any in-flight save within
+        the grace window, then take an emergency synchronous save of the
+        current step. Sets :attr:`preempted` for the train loop to exit;
+        the previous handler is chained after the save lands.
+
+        The handler itself only sets the flag and hands the save to a
+        dedicated thread: signal handlers run on the main thread between
+        bytecodes, and taking the manager/saver locks from one would
+        deadlock whenever the signal lands inside a frame that already
+        holds them (the interrupted frame can't release a lock while the
+        handler sits on top of it). The thread is non-daemon so the
+        process outlives the main loop long enough for the save to
+        commit; :meth:`join_preemption` waits for it explicitly."""
+        grace_seconds = float(grace_seconds)
+
+        def handler(signum, frame, _grace=grace_seconds):
+            self.preempted = True
+            if self._preempt_thread is not None and \
+                    self._preempt_thread.is_alive():
+                return  # a second notice while the save is running
+            prev = self._prev_handlers.get(signum)
+
+            def run():
+                self.emergency_save(grace_seconds=_grace)
+                if callable(prev):
+                    prev(signum, frame)
+
+            self._preempt_thread = threading.Thread(
+                target=run, name="ckpt-preempt", daemon=False
+            )
+            self._preempt_thread.start()
+
+        for sig in signals:
+            self._prev_handlers[sig] = signal.signal(sig, handler)
+        return self
+
+    def join_preemption(self, timeout=None):
+        """Wait for an in-progress emergency save (train loops that see
+        :attr:`preempted` call this before exiting). Returns True when
+        no emergency save is running."""
+        t = self._preempt_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def emergency_save(self, grace_seconds=30.0):
+        """Synchronous best-effort save of the current step (preemption
+        path). Never raises — a failed emergency save still lets the
+        chained handler / exit proceed."""
+        t0 = time.perf_counter()
+        try:
+            drained = self.wait(timeout=grace_seconds)
+            if not drained:
+                logger.error(
+                    "checkpoint: in-flight save did not drain within "
+                    "%.0fs grace; emergency save skipped", grace_seconds
+                )
+                return None
+            with self._lock:
+                already = self._last_saved_step == self._last_step
+            if already:
+                return self._last_step
+            return self.save(blocking=True, mode="emergency")
+        except Exception as e:
+            self.save_failures_total.inc()
+            self._note_event("checkpoint_save_failed", error=repr(e),
+                             mode="emergency")
+            logger.error("checkpoint: emergency save failed: %r", e)
+            return None
+        finally:
+            self._note_event(
+                "checkpoint_preempted",
+                seconds=time.perf_counter() - t0,
+                step=self._last_step,
+            )
+
+    # -------------------------------------------------------------- context
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

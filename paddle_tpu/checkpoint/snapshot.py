@@ -1,0 +1,88 @@
+"""Device→host snapshots that do not block the train loop.
+
+Reference parity: Orbax-style async checkpointing on TPU — the save's
+device reads are decoupled from the train loop's dispatch.
+
+The hazard this module exists for: ``jit.CompiledTrainStep`` donates the
+parameter and optimizer-state buffers every step, so a background writer
+that held the ORIGINAL array refs would race the next step's donation —
+by the time it serializes, the buffers have been invalidated. A
+:func:`snapshot_state` therefore tree-maps every device leaf through an
+on-device copy (``jnp.copy`` — an async dispatch into the device stream,
+microseconds on the host) so the snapshot owns buffers no later step can
+donate away. The actual device→host transfer then happens on the writer
+thread when it serializes the copies, following the same ``is_ready()``
+discipline the flight recorder uses for in-flight values: the train
+loop never waits on it.
+
+Host leaves (numpy arrays, python scalars) are copied eagerly — they are
+mutable in place by later steps, and cheap.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _snap_leaf(v):
+    if isinstance(v, Tensor):
+        v = v.value
+    if isinstance(v, jax.Array):
+        # own buffer: an async on-device copy, immune to later donation;
+        # sharding follows the source so the sharded serializer writes
+        # the same per-process shard boxes the live array had
+        return jnp.copy(v)
+    if isinstance(v, np.ndarray):
+        return np.array(v, copy=True)
+    return v
+
+
+def snapshot_state(state):
+    """Deep-copy a (possibly nested) state dict into snapshot form:
+    device leaves become freshly dispatched on-device copies, host
+    leaves are copied now. Returns the parallel structure."""
+    if isinstance(state, dict):
+        return {k: snapshot_state(v) for k, v in state.items()}
+    if isinstance(state, (list, tuple)):
+        return [snapshot_state(v) for v in state]
+    return _snap_leaf(state)
+
+
+def _device_leaves(snap):
+    if isinstance(snap, dict):
+        for v in snap.values():
+            yield from _device_leaves(v)
+    elif isinstance(snap, (list, tuple)):
+        for v in snap:
+            yield from _device_leaves(v)
+    elif isinstance(snap, jax.Array):
+        yield snap
+
+
+def snapshot_is_ready(snap):
+    """True when every device copy in the snapshot has materialized
+    (the writer may serialize without blocking on the device)."""
+    for leaf in _device_leaves(snap):
+        try:
+            if not leaf.is_ready():
+                return False
+        except AttributeError:
+            pass  # backends without is_ready: treat as ready (blocking ok)
+    return True
+
+
+def snapshot_nbytes(snap):
+    """Approximate payload size (device + host array bytes)."""
+    total = 0
+    if isinstance(snap, dict):
+        return sum(snapshot_nbytes(v) for v in snap.values())
+    if isinstance(snap, (list, tuple)):
+        return sum(snapshot_nbytes(v) for v in snap)
+    nbytes = getattr(snap, "nbytes", None)
+    if nbytes:
+        total += int(nbytes)
+    return total

@@ -1,0 +1,96 @@
+"""Durable file primitives for checkpointing: write-temp, fsync, rename.
+
+Reference parity: python/paddle/distributed/checkpoint/ (unverified,
+mount empty) writes files in place; the fault-tolerant runtime in
+``paddle_tpu.checkpoint`` layers an atomic commit protocol on top and
+that protocol only holds if every INDIVIDUAL file write is already
+atomic — a file either has its complete contents or does not exist.
+These helpers are that primitive: write to a ``.inflight`` temp name in
+the same directory, flush + fsync the file, ``os.replace`` onto the
+final name, and (for commit points) fsync the parent directory so the
+rename itself is durable.
+
+Every writer also returns a CRC32 + byte count computed WHILE the bytes
+stream through, so callers get checksums for the commit manifest
+without re-reading what they just wrote.
+"""
+from __future__ import annotations
+
+import os
+import zlib
+
+import numpy as np
+
+INFLIGHT_SUFFIX = ".inflight"
+
+
+def fsync_dir(dirname):
+    """fsync a directory so a just-performed rename/create in it is
+    durable (no-op on platforms that cannot open directories)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class _CRC32Writer:
+    """File-object wrapper accumulating CRC32/size of everything written
+    (np.save and json dumps stream through it unchanged)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc32 = 0
+        self.nbytes = 0
+
+    def write(self, data):
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self._f.write(data)
+        self.crc32 = zlib.crc32(data, self.crc32)
+        self.nbytes += len(data)
+        return len(data)
+
+
+def _atomic_write(path, emit):
+    """Run ``emit(crc_writer)`` against ``path + INFLIGHT_SUFFIX``, fsync,
+    rename into place. Returns (crc32, nbytes)."""
+    tmp = path + INFLIGHT_SUFFIX
+    with open(tmp, "wb") as f:
+        w = _CRC32Writer(f)
+        emit(w)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return w.crc32, w.nbytes
+
+
+def atomic_save_npy(path, array):
+    """np.save ``array`` to ``path`` atomically; returns (crc32, nbytes)
+    of the serialized .npy stream."""
+    arr = np.asarray(array)
+    return _atomic_write(path, lambda w: np.save(w, arr))
+
+
+def atomic_write_text(path, text):
+    """Write ``text`` to ``path`` atomically; returns (crc32, nbytes)."""
+    return _atomic_write(path, lambda w: w.write(text))
+
+
+def crc32_file(path, chunk_size=1 << 20):
+    """CRC32 + size of an existing file (the verify side of the
+    manifest's checksums)."""
+    crc, n = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            n += len(chunk)
+    return crc, n

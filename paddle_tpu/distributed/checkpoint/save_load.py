@@ -29,7 +29,8 @@ import numpy as np
 import jax
 
 from ...core.tensor import Tensor
-from .metadata import Metadata, ShardMeta, TensorMeta, metadata_path
+from .fsio import atomic_save_npy, atomic_write_text, fsync_dir
+from .metadata import METADATA_FILE, Metadata, ShardMeta, TensorMeta, metadata_path
 
 
 def _walk(obj, prefix=""):
@@ -61,9 +62,18 @@ def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0):
     """Write a sharded checkpoint of ``state_dict`` (possibly nested) to
     directory ``path``. Every process writes its own shards; the
-    coordinator writes metadata."""
+    coordinator writes metadata.
+
+    Every file is written atomically (temp name, fsync, rename — see
+    fsio.py): a crash mid-save can leave the directory incomplete but
+    never a HALF-written .npy or metadata file, which is the primitive
+    the ``paddle_tpu.checkpoint`` commit protocol builds on. Returns
+    ``{filename: {"crc32": int, "bytes": int}}`` for the files THIS
+    process wrote, so callers can assemble a commit manifest without
+    re-reading them."""
     os.makedirs(path, exist_ok=True)
     proc = jax.process_index()
+    files = {}
     tensors, scalars = {}, {}
     for name, leaf in _walk(state_dict):
         if not _is_array_leaf(leaf):
@@ -84,7 +94,10 @@ def save_state_dict(state_dict, path, process_group=None,
                 for s, dim in zip(sh.index, arr.shape)
             ]
             fname = f"{_sanitize(name)}.p{proc}.s{i}.npy"
-            np.save(os.path.join(path, fname), np.asarray(sh.data))
+            crc, nbytes = atomic_save_npy(
+                os.path.join(path, fname), np.asarray(sh.data)
+            )
+            files[fname] = {"crc32": crc, "bytes": nbytes}
             shards.append(ShardMeta(file=fname, box=box))
         tensors[name] = TensorMeta(
             shape=list(arr.shape), dtype=str(arr.dtype), shards=shards
@@ -101,11 +114,12 @@ def save_state_dict(state_dict, path, process_group=None,
     if proc == coordinator_rank or jax.process_count() == 1:
         meta = Metadata(tensors=tensors, scalars=scalars)
         # atomic publish: metadata existence is the checkpoint's
-        # completeness marker (latest_checkpoint relies on it)
-        tmp = metadata_path(path) + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(meta.to_json())
-        os.replace(tmp, metadata_path(path))
+        # completeness marker (latest_checkpoint relies on it), written
+        # LAST so it never declares shards that are not on disk yet
+        crc, nbytes = atomic_write_text(metadata_path(path), meta.to_json())
+        files[METADATA_FILE] = {"crc32": crc, "bytes": nbytes}
+        fsync_dir(path)
+    return files
 
 
 class _ShardReader:

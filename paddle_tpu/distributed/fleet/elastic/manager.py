@@ -141,17 +141,31 @@ _STEP_PAT = re.compile(r"(\d+)")
 def latest_checkpoint(ckpt_dir):
     """Newest COMPLETE checkpoint under ``ckpt_dir``.
 
-    Distributed-checkpoint saves are directories containing
-    metadata.json (incomplete saves lack it and are skipped);
-    paddle.save files are plain files. Ordered by the trailing step
-    number in the name when present, else by mtime. Returns a path or
-    None."""
+    Discovery is manifest-based for checkpoint-runtime saves
+    (``paddle_tpu.checkpoint``): a directory only counts once its
+    commit manifest parses, and the step comes FROM the manifest — a
+    directory name is never trusted on its own, so a torn save (killed
+    mid-write, before the commit rename) can never be picked up.
+    Legacy layouts remain discoverable: bare distributed-checkpoint
+    dirs need a parsable metadata.json; paddle.save files are plain
+    files ordered by the trailing step number in the name (else
+    mtime). Returns a path or None."""
     if not os.path.isdir(ckpt_dir):
         return None
+    from ....checkpoint.commit import TMP_SUFFIX, read_manifest
+
     candidates = []
     for name in os.listdir(ckpt_dir):
         p = os.path.join(ckpt_dir, name)
         if os.path.isdir(p):
+            if name.endswith(TMP_SUFFIX):
+                continue  # in-flight or orphaned save: never committed
+            manifest = read_manifest(p)
+            if manifest is not None:
+                candidates.append(
+                    (int(manifest["step"]), os.path.getmtime(p), p)
+                )
+                continue
             meta = os.path.join(p, "metadata.json")
             try:
                 import json

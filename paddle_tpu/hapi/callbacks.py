@@ -159,6 +159,42 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+class FaultTolerantCheckpoint(Callback):
+    """Drive a ``checkpoint.CheckpointManager`` from the fit loop.
+
+    Unlike :class:`ModelCheckpoint` (epoch-granular ``model.save``),
+    this is the fault-tolerance path: per-STEP policy checks, async
+    atomic saves, and an end-of-training drain so the last commit
+    lands. The manager is bound to the fitted network/optimizer at
+    train begin if it was constructed bare. Saves key off the global
+    optimizer step so resume semantics match the compiled trainer's.
+    """
+
+    def __init__(self, manager):
+        super().__init__()
+        self.manager = manager
+        self._it = 0
+
+    def on_train_begin(self, logs=None):
+        self.manager.bind(
+            self.model.network, getattr(self.model, "_optimizer", None)
+        )
+
+    def _global_step(self):
+        opt = getattr(self.model, "_optimizer", None)
+        n = getattr(opt, "_step_count", 0) if opt is not None else 0
+        return n or self._it
+
+    def on_train_batch_end(self, step, logs=None):
+        # no logs read here: the sync-free fit path stays sync-free
+        # (the manager snapshots device refs, it never fetches)
+        self._it += 1
+        self.manager.on_step(self._global_step())
+
+    def on_train_end(self, logs=None):
+        self.manager.finalize()
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):

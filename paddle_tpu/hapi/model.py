@@ -295,8 +295,18 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, checkpoint=None):
         assert train_data is not None
+        if checkpoint is not None:
+            # fault-tolerant path: a checkpoint.CheckpointManager rides
+            # the callback stream (per-step policy, async atomic saves,
+            # drained at train end)
+            cb = callbacks if isinstance(callbacks, (list, tuple)) else (
+                [callbacks] if callbacks is not None else []
+            )
+            callbacks = list(cb) + [
+                cbks_mod.FaultTolerantCheckpoint(checkpoint)
+            ]
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,

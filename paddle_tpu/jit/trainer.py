@@ -77,6 +77,16 @@ class CompiledTrainStep:
             )
         self._step_fn = None
         self._param_names = [k for k, _ in network.named_parameters()]
+        self._checkpoint = None
+
+    def attach_checkpoint(self, manager):
+        """Wire a ``checkpoint.CheckpointManager`` into the step loop:
+        after each optimizer step the manager's policy decides whether
+        to kick off an async save. The manager is bound to this
+        trainer's network/optimizer if it was constructed bare."""
+        manager.bind(self.network, self.optimizer)
+        self._checkpoint = manager
+        return manager
 
     @staticmethod
     def _normalize_scaler(scaler):
@@ -525,4 +535,9 @@ class CompiledTrainStep:
         self._scatter_opt_state(new_state)
         self._record_telemetry(time.perf_counter() - _t0, in_vals, loss,
                                _warmup)
+        if self._checkpoint is not None:
+            # after write-back: the snapshot must see the POST-step
+            # params. Policy check + on-device snapshot only — the
+            # write happens on the manager's background thread
+            self._checkpoint.on_step(self.optimizer._step_count)
         return Tensor(loss), [Tensor(o) for o in out_vals]

@@ -270,6 +270,7 @@ class StepMeter:
         self._peak_total = None
         self._mem_high_water = 0
         self._last_step_t = None
+        self._blocked_pending = 0.0
         cfg = getattr(model, "config", None) or config
         if self._flops_per_token is None and cfg is not None and \
                 hasattr(cfg, "hidden_size"):
@@ -322,6 +323,16 @@ class StepMeter:
     # not a slow step — fall back to the caller's host measurement
     MAX_STEP_GAP_S = 60.0
 
+    def note_blocked(self, seconds):
+        """Report a train-loop stall that is NOT step work — checkpoint
+        writer backpressure, an emergency synchronous save. The stall is
+        subtracted from the next dispatch-to-dispatch interval so
+        step_time / tokens-per-sec / MFU are not silently deflated by
+        save stalls (the caller publishes the stall itself, e.g. into
+        ``paddle_ckpt_blocked_seconds``)."""
+        with self._lock:
+            self._blocked_pending += float(seconds)
+
     def observe_step(self, step_time, *, examples=0, tokens=0, loss=None,
                      grad_norm=None, warmup=False):
         """Record one optimizer step. ``loss``/``grad_norm`` may be
@@ -349,9 +360,13 @@ class StepMeter:
         now = time.perf_counter()
         with self._lock:
             last, self._last_step_t = self._last_step_t, now
+            blocked, self._blocked_pending = self._blocked_pending, 0.0
         broke = False
         if not warmup and last is not None:
-            interval = now - last
+            # checkpoint (and similar) stalls are excluded: they are
+            # real wall time but not step work, and would otherwise
+            # deflate throughput between checkpoints
+            interval = now - last - blocked
             if step_time <= interval <= self.MAX_STEP_GAP_S:
                 step_time = interval
             elif interval > self.MAX_STEP_GAP_S:

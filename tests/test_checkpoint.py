@@ -1,0 +1,619 @@
+"""Fault-tolerant checkpoint runtime: async snapshots, atomic commits,
+crash-safe auto-resume.
+
+Acceptance pins (ISSUE 5):
+- crash consistency: SIGKILL at an arbitrary point during an async save
+  never yields an unloadable state — ``restore_or_init`` returns the
+  last committed checkpoint (subprocess test below + the ckpt-smoke
+  gate);
+- async overlap: with background saves enabled, step times between
+  checkpoints stay within noise of checkpointing-disabled — the write
+  happens off-thread.
+"""
+import glob
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.checkpoint import (
+    CheckpointManager,
+    CheckpointPolicy,
+    latest_committed,
+    list_committed,
+    snapshot_state,
+    verify_checkpoint,
+)
+from paddle_tpu.checkpoint import commit as commit_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.checkpoint.fsio import (
+    atomic_save_npy,
+    atomic_write_text,
+    crc32_file,
+)
+from paddle_tpu.distributed.checkpoint.save_load import save_state_dict
+from paddle_tpu.jit.trainer import CompiledTrainStep
+
+
+def _make(seed, lr=1e-2):
+    paddle.seed(seed)
+    net = nn.Linear(6, 6)
+    opt = paddle.optimizer.AdamW(lr, parameters=net.parameters())
+    return net, opt
+
+
+def _train_batch(net, opt, bx, by):
+    loss = ((net(bx) - by) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return (paddle.to_tensor(rng.randn(8, 6).astype("float32")),
+            paddle.to_tensor(rng.randn(8, 6).astype("float32")))
+
+
+def _params(net):
+    return {k: np.asarray(v.numpy()) for k, v in net.state_dict().items()}
+
+
+# ------------------------------------------------------ atomic primitives
+def test_atomic_npy_write_and_checksum(tmp_path):
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    path = str(tmp_path / "a.npy")
+    crc, nbytes = atomic_save_npy(path, arr)
+    np.testing.assert_array_equal(np.load(path), arr)
+    assert (crc, nbytes) == crc32_file(path)
+    assert os.path.getsize(path) == nbytes
+    # no in-flight temp left behind
+    assert glob.glob(str(tmp_path / "*.inflight")) == []
+
+
+def test_atomic_text_write(tmp_path):
+    path = str(tmp_path / "m.json")
+    crc, nbytes = atomic_write_text(path, '{"ok": true}')
+    assert json.load(open(path)) == {"ok": True}
+    assert (crc, nbytes) == crc32_file(path)
+
+
+def test_save_state_dict_returns_file_digests(tmp_path):
+    net, _ = _make(0)
+    path = str(tmp_path / "ck")
+    files = save_state_dict(net.state_dict(), path)
+    on_disk = {
+        n for n in os.listdir(path) if not n.endswith(".inflight")
+    }
+    assert set(files) == on_disk and "metadata.json" in files
+    for fname, rec in files.items():
+        crc, nbytes = crc32_file(os.path.join(path, fname))
+        assert (crc, nbytes) == (rec["crc32"], rec["bytes"]), fname
+
+
+# -------------------------------------------------------------- snapshots
+def test_snapshot_isolated_from_later_updates():
+    net, opt = _make(1)
+    bx, by = _batch()
+    snap = snapshot_state({"model": net.state_dict()})
+    before = _params(net)
+    _train_batch(net, opt, bx, by)  # mutates the live params
+    after = _params(net)
+    for k in before:
+        got = np.asarray(snap["model"][k])
+        np.testing.assert_array_equal(got, before[k])
+        assert not np.array_equal(got, after[k])  # training really moved
+
+
+# -------------------------------------------------------- commit protocol
+def test_commit_layout_latest_and_verify(tmp_path):
+    net, opt = _make(2)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(7)
+    assert sorted(os.listdir(tmp_path)) == ["LATEST", "step_00000007"]
+    assert open(tmp_path / "LATEST").read().strip() == "step_00000007"
+    path = latest_committed(str(tmp_path))
+    assert path.endswith("step_00000007")
+    assert verify_checkpoint(path) == []
+    manifest = commit_mod.read_manifest(path)
+    assert manifest["step"] == 7 and len(manifest["files"]) >= 3
+    mgr.close()
+
+
+def test_stale_latest_marker_falls_back_to_scan(tmp_path):
+    net, opt = _make(3)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(1)
+    mgr.save(2)
+    (tmp_path / "LATEST").write_text("step_00000099")  # torn/stale marker
+    assert latest_committed(str(tmp_path)).endswith("step_00000002")
+    mgr.close()
+
+
+def test_orphan_tmp_gc_on_startup(tmp_path):
+    net, opt = _make(4)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(3)
+    mgr.close()
+    stale = tmp_path / "step_00000005.tmp"
+    stale.mkdir()
+    (stale / "junk.npy").write_bytes(b"partial")
+    # GC only reaps tmp dirs old enough that no live writer can own
+    # them; backdate past the age window
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+    net2, opt2 = _make(5)
+    mgr2 = CheckpointManager(str(tmp_path), network=net2, optimizer=opt2)
+    assert not stale.exists()
+    assert mgr2.fallbacks_total.series().get(
+        (("reason", "orphan_tmp"),)
+    ) == 1
+    res = mgr2.restore_or_init()
+    assert res.restored and res.step == 3
+    mgr2.close()
+
+
+def test_fresh_tmp_of_a_live_writer_not_reaped(tmp_path):
+    """A .tmp modified moments ago may be ANOTHER process's in-flight
+    save (shared root, launcher-style deployment): startup GC must
+    leave it alone."""
+    live = tmp_path / "step_00000009.tmp"
+    live.mkdir()
+    (live / "w.p0.s0.npy").write_bytes(b"being written right now")
+    net, opt = _make(14)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt)
+    assert live.exists()
+    assert mgr.fallbacks_total.value == 0
+    mgr.close()
+
+
+def test_failed_write_rolls_back_saved_marker(tmp_path):
+    """A failed background write must not leave the manager believing
+    the step was saved — the emergency (and next policy) save must
+    retry it."""
+    net, opt = _make(13)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt)
+
+    def boom(state, path, **kw):
+        raise OSError("disk full")
+
+    mgr._serialize = boom
+    mgr.on_step(5)  # default policy: no auto-save, just the step clock
+    mgr.save(5)
+    mgr.wait()
+    assert mgr.save_failures_total.value == 1
+    assert list_committed(str(tmp_path)) == []
+    mgr._serialize = save_state_dict  # "disk recovered"
+    assert mgr.emergency_save() == 5  # NOT skipped as already-saved
+    assert [s for s, _ in list_committed(str(tmp_path))] == [5]
+    mgr.close()
+
+
+# ----------------------------------------------------------------- policy
+def test_policy_every_steps_and_seconds():
+    p = CheckpointPolicy(save_every_steps=5)
+    assert not p.should_save(4, 0.0, 0, 0.0)
+    assert p.should_save(5, 0.0, 0, 0.0)
+    assert not p.should_save(5, 0.0, 5, 0.0)  # same step never re-saves
+    t = CheckpointPolicy(save_every_seconds=10)
+    assert not t.should_save(1, 9.0, 0, 0.0)
+    assert t.should_save(1, 10.0, 0, 0.0)
+
+
+def test_retention_keep_last_k_and_every_m(tmp_path):
+    net, opt = _make(6)
+    mgr = CheckpointManager(
+        str(tmp_path), network=net, optimizer=opt, async_saves=False,
+        policy=CheckpointPolicy(keep_last_k=2, keep_every_m=4),
+    )
+    for step in range(1, 11):
+        mgr.save(step)
+    kept = sorted(s for s, _ in list_committed(str(tmp_path)))
+    assert kept == [4, 8, 9, 10]  # every-4th pinned + last two
+    mgr.close()
+
+
+# ------------------------------------------------------ corruption matrix
+def _two_checkpoints(tmp_path):
+    """Two committed checkpoints with DIFFERENT params; returns
+    (root, golds) where golds[step] is the param dict at save time."""
+    net, opt = _make(7)
+    bx, by = _batch()
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    golds = {}
+    _train_batch(net, opt, bx, by)
+    golds[1] = _params(net)
+    mgr.save(1)
+    _train_batch(net, opt, bx, by)
+    golds[2] = _params(net)
+    mgr.save(2)
+    mgr.close()
+    return str(tmp_path), golds
+
+
+def _corrupt_truncate(path):
+    shard = sorted(glob.glob(os.path.join(path, "*.npy")))[0]
+    data = open(shard, "rb").read()
+    open(shard, "wb").write(data[: len(data) // 2])
+    return "checksum_mismatch"  # size check catches it first
+
+
+def _corrupt_bitflip(path):
+    shard = sorted(glob.glob(os.path.join(path, "*.npy")))[-1]
+    data = bytearray(open(shard, "rb").read())
+    data[-1] ^= 0xFF
+    open(shard, "wb").write(bytes(data))
+    return "checksum_mismatch"
+
+
+def _corrupt_delete_manifest(path):
+    os.remove(os.path.join(path, "manifest.json"))
+    return "manifest_missing"
+
+
+def _corrupt_delete_shard(path):
+    os.remove(sorted(glob.glob(os.path.join(path, "*.npy")))[0])
+    return "missing_shard"
+
+
+def _corrupt_manifest_step(path):
+    # parsable JSON, files dict intact, but no usable step: a malformed
+    # manifest must degrade exactly like a missing one, never crash
+    mpath = os.path.join(path, "manifest.json")
+    doc = json.load(open(mpath))
+    del doc["step"]
+    open(mpath, "w").write(json.dumps(doc))
+    return "manifest_missing"
+
+
+@pytest.mark.parametrize("corrupt", [
+    _corrupt_truncate, _corrupt_bitflip, _corrupt_delete_manifest,
+    _corrupt_delete_shard, _corrupt_manifest_step,
+], ids=["truncate", "bitflip", "no-manifest", "no-shard",
+        "malformed-manifest"])
+def test_corruption_detected_and_falls_back(tmp_path, corrupt):
+    root, golds = _two_checkpoints(tmp_path)
+    newest = os.path.join(root, "step_00000002")
+    expect_reason = corrupt(newest)
+    net2, opt2 = _make(8)
+    bx, by = _batch()
+    _train_batch(net2, opt2, bx, by)  # prime moments so opt state loads
+    mgr = CheckpointManager(root, network=net2, optimizer=opt2)
+    res = mgr.restore_or_init()
+    assert res.restored and res.step == 1, res
+    assert res.path.endswith("step_00000001")
+    for k, v in _params(net2).items():
+        np.testing.assert_array_equal(v, golds[1][k])
+    series = mgr.fallbacks_total.series()
+    assert series.get((("reason", expect_reason),)) == 1, series
+    mgr.close()
+
+
+def test_restore_or_init_empty_root(tmp_path):
+    net, opt = _make(9)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt)
+    res = mgr.restore_or_init()
+    assert not res.restored and res.step == 0 and res.path is None
+    assert mgr.restores_total.series().get((("outcome", "init"),)) == 1
+    mgr.close()
+
+
+# ------------------------------------------------- full-state auto-resume
+def test_compiled_trainer_resume_parity(tmp_path):
+    """restore_or_init returns model/optimizer/step/RNG state: a resumed
+    run's loss trajectory matches the uninterrupted one exactly."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randn(8, 6).astype(np.float32)
+
+    def make_step(seed):
+        net, opt = _make(seed)
+        step = CompiledTrainStep(
+            net, lambda o, t: ((o - t) ** 2).mean(), opt
+        )
+        return net, opt, step
+
+    def run(step_fn, n):
+        return [
+            float(np.asarray(
+                step_fn([Tensor(x)], [Tensor(y)])[0].numpy()
+            ))
+            for _ in range(n)
+        ]
+
+    net, opt, step = make_step(300)
+    gold = run(step, 6)
+
+    net, opt, step = make_step(300)
+    mgr = CheckpointManager(
+        str(tmp_path), async_saves=False,
+        policy=CheckpointPolicy(save_every_steps=3),
+    )
+    step.attach_checkpoint(mgr)
+    first = run(step, 3)  # manager saves at optimizer step 3, then "crash"
+    mgr.close()
+    assert [s for s, _ in list_committed(str(tmp_path))] == [3]
+
+    net2, opt2, step2 = make_step(301)  # different init/RNG stream
+    run(step2, 1)  # prime optimizer moments so they restore
+    mgr2 = CheckpointManager(str(tmp_path), network=net2, optimizer=opt2)
+    res = mgr2.restore_or_init()
+    assert res.restored and res.step == 3
+    # optimizer scalars (@step_count — the Adam bias-correction clock)
+    # came back through set_state_dict inside restore_or_init
+    assert opt2._step_count == 3
+    rest = run(step2, 3)
+    np.testing.assert_allclose(first + rest, gold, rtol=2e-4)
+    mgr2.close()
+
+
+def test_hapi_fit_checkpoint_wiring(tmp_path):
+    from paddle_tpu.io import TensorDataset
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(24, 6).astype(np.float32)
+    Y = rng.randn(24, 6).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    net, opt = _make(10)
+    model = paddle.Model(net)
+    model.prepare(opt, lambda o, t: ((o - t) ** 2).mean())
+    mgr = CheckpointManager(
+        str(tmp_path), policy=CheckpointPolicy(save_every_steps=2),
+    )
+    model.fit(ds, batch_size=4, epochs=1, verbose=0, shuffle=False,
+              checkpoint=mgr)
+    steps = [s for s, _ in list_committed(str(tmp_path))]
+    assert steps and steps[0] >= 4  # saved on the every-2-steps cadence
+    assert verify_checkpoint(latest_committed(str(tmp_path))) == []
+    mgr.close()
+
+
+# --------------------------------------------- async overlap + blocked time
+def _slow_serializer(mgr, delay):
+    real = mgr._serialize
+
+    def slow(state, path, **kw):
+        time.sleep(delay)
+        return real(state, path, **kw)
+
+    mgr._serialize = slow
+
+
+def test_async_save_overlaps_training(tmp_path):
+    """Acceptance pin: with background saves on, the train loop's
+    dispatch-to-dispatch step clock between checkpoints stays within
+    noise of checkpointing-disabled — the write happens off-thread."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randn(8, 6).astype(np.float32)
+
+    def timed_steps(step_fn, n, mgr=None):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            step_fn([Tensor(x)], [Tensor(y)])
+            dt = time.perf_counter() - t0
+            triggered = (
+                mgr is not None and mgr._last_saved_step == mgr._last_step
+            )
+            times.append((dt, triggered))
+        return times
+
+    net, opt = _make(400)
+    step = CompiledTrainStep(net, lambda o, t: ((o - t) ** 2).mean(), opt)
+    step([Tensor(x)], [Tensor(y)])  # warmup/compile outside timing
+    base = [dt for dt, _ in timed_steps(step, 10)]
+
+    net, opt = _make(400)
+    step = CompiledTrainStep(net, lambda o, t: ((o - t) ** 2).mean(), opt)
+    step([Tensor(x)], [Tensor(y)])
+    mgr = CheckpointManager(
+        str(tmp_path), policy=CheckpointPolicy(save_every_steps=4),
+    )
+    _slow_serializer(mgr, 0.25)  # writer takes >> a train step
+    step.attach_checkpoint(mgr)
+    timed = timed_steps(step, 10, mgr)
+    mgr.finalize()
+
+    # steps that did NOT trigger a save ran while the writer was busy;
+    # they must not have waited on the 0.25s write
+    quiet = [dt for dt, trig in timed if not trig]
+    assert quiet, "every step triggered a save — policy misconfigured"
+    base_med = sorted(base)[len(base) // 2]
+    quiet_med = sorted(quiet)[len(quiet) // 2]
+    assert quiet_med < max(3 * base_med, base_med + 0.05), (
+        f"steps between checkpoints slowed from {base_med:.4f}s to "
+        f"{quiet_med:.4f}s — the save is not off-thread"
+    )
+    assert max(quiet) < 0.2, f"a non-save step waited on the writer: {timed}"
+    # and the writes really were slow + really committed
+    assert mgr.save_seconds.count >= 2
+    assert mgr.save_seconds.sum >= 0.25 * mgr.save_seconds.count
+    assert verify_checkpoint(latest_committed(str(tmp_path))) == []
+    mgr.close()
+
+
+def test_backpressure_blocks_and_reports(tmp_path):
+    net, opt = _make(11)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt)
+    _slow_serializer(mgr, 0.3)
+    t0 = time.perf_counter()
+    mgr.save(1)  # async: returns immediately
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    mgr.save(2)  # previous still writing: submit must block
+    second = time.perf_counter() - t0
+    mgr.finalize()
+    assert first < 0.15 and second > 0.15, (first, second)
+    assert mgr.blocked_seconds.count >= 1
+    assert mgr.blocked_seconds.sum >= 0.1
+    assert [s for s, _ in list_committed(str(tmp_path))] == [2, 1]
+    mgr.close()
+
+
+def test_step_meter_excludes_blocked_time():
+    from paddle_tpu.observability import StepMeter
+
+    meter = StepMeter()
+    meter.observe_step(0.001)  # arms the dispatch-to-dispatch clock
+    time.sleep(0.25)  # a checkpoint stall between dispatches...
+    meter.note_blocked(0.25)  # ...reported by the manager
+    rec = meter.observe_step(0.001)
+    # the 0.25s stall is excluded: recorded step time is the raw
+    # interval minus the blocked share
+    assert rec["step_time_s"] < 0.15, rec
+    assert meter.step_time.snapshot()["max"] < 0.15
+
+
+# ------------------------------------------------------------- preemption
+def test_sigterm_emergency_save(tmp_path):
+    net, opt = _make(12)
+    mgr = CheckpointManager(
+        str(tmp_path), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1000),  # never by policy
+    )
+    mgr.install_preemption_handler(signals=(signal.SIGUSR1,),
+                                   grace_seconds=10.0)
+    try:
+        mgr.on_step(41)  # policy does not fire
+        assert list_committed(str(tmp_path)) == []
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert mgr.preempted
+        # the save runs on a dedicated thread (never in signal context)
+        assert mgr.join_preemption(timeout=30)
+        assert [s for s, _ in list_committed(str(tmp_path))] == [41]
+        assert mgr.saves_total.series().get(
+            (("mode", "emergency"),)
+        ) == 1
+        assert verify_checkpoint(latest_committed(str(tmp_path))) == []
+    finally:
+        signal.signal(signal.SIGUSR1, mgr._prev_handlers[signal.SIGUSR1])
+        mgr.close()
+
+
+# ------------------------------------------- SIGKILL crash consistency pin
+CRASH_CHILD = textwrap.dedent("""
+    import hashlib, json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+
+    work = {work!r}
+    paddle.seed(0)
+    net = nn.Linear(6, 6)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    mgr = CheckpointManager(
+        os.path.join(work, "ckpts"), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1, keep_last_k=1000),
+    )
+    real = mgr._serialize
+    def slow(state, path, **kw):
+        time.sleep(0.05)      # widen the mid-save window the parent
+        files = real(state, path, **kw)
+        time.sleep(0.05)      # kills into
+        return files
+    mgr._serialize = slow
+
+    def digest():
+        h = hashlib.sha256()
+        for k in sorted(net.state_dict()):
+            h.update(np.ascontiguousarray(
+                net.state_dict()[k].numpy()).tobytes())
+        return h.hexdigest()
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 6).astype("float32"))
+    y = paddle.to_tensor(rng.randn(8, 6).astype("float32"))
+    dig = open(os.path.join(work, "digests.jsonl"), "a")
+    for step in range(1, 200):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        # digest logged (flushed+fsynced) BEFORE the save can commit
+        print(json.dumps({{"step": step, "digest": digest()}}),
+              file=dig, flush=True)
+        os.fsync(dig.fileno())
+        mgr.on_step(step)
+""")
+
+
+@pytest.mark.parametrize("extra_delay", [0.0, 0.07],
+                         ids=["early-kill", "late-kill"])
+def test_sigkill_mid_save_never_corrupts(tmp_path, extra_delay):
+    """Crash-consistency pin: SIGKILL during an async save leaves every
+    COMMITTED checkpoint loadable; restore_or_init returns the newest
+    one with bit-identical params."""
+    work = str(tmp_path)
+    script = tmp_path / "child.py"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script.write_text(CRASH_CHILD.format(repo=repo, work=work))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE)
+    root = os.path.join(work, "ckpts")
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if len(list_committed(root)) >= 2:
+                break
+            time.sleep(0.01)
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "child died early: " + proc.stderr.read().decode()
+                )
+        else:
+            raise AssertionError("no checkpoints committed within 120s")
+        time.sleep(extra_delay)  # vary where in the save the kill lands
+        proc.kill()
+    finally:
+        proc.wait(timeout=30)
+
+    committed = list_committed(root)
+    assert len(committed) >= 2
+    for s, path in committed:
+        assert verify_checkpoint(path) == [], (s, path)
+
+    digests = {}
+    for line in open(os.path.join(work, "digests.jsonl")):
+        rec = json.loads(line)
+        digests[rec["step"]] = rec["digest"]
+
+    paddle.seed(123)  # deliberately different init
+    net = nn.Linear(6, 6)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    mgr = CheckpointManager(root, network=net, optimizer=opt)
+    res = mgr.restore_or_init()
+    newest = max(s for s, _ in committed)
+    assert res.restored and res.step == newest, (res, committed)
+    assert mgr.fallbacks_total.series().get(
+        (("reason", "checksum_mismatch"),)
+    ) is None
+    h = hashlib.sha256()
+    for k in sorted(net.state_dict()):
+        h.update(np.ascontiguousarray(
+            net.state_dict()[k].numpy()).tobytes())
+    assert h.hexdigest() == digests[res.step], (
+        "restored params are not bit-identical to the params the child "
+        f"had at step {res.step}"
+    )
+    mgr.close()

@@ -90,7 +90,8 @@ def test_scale_out_detected(tmp_path):
 # ---------------------------------------------------- latest_checkpoint
 def test_latest_checkpoint_selection(tmp_path):
     assert latest_checkpoint(str(tmp_path / "nope")) is None
-    # dist-checkpoint dirs: step-numbered, one torn (no metadata.json)
+    # legacy dist-checkpoint dirs: step-numbered, one torn (no
+    # metadata.json)
     for step, complete in [(1, True), (5, True), (9, False)]:
         d = tmp_path / f"ckpt_step{step}"
         d.mkdir()
@@ -100,6 +101,39 @@ def test_latest_checkpoint_selection(tmp_path):
     # a plain paddle.save file with a higher step wins
     (tmp_path / "model_step12.pdparams").write_text("x")
     assert latest_checkpoint(str(tmp_path)).endswith("model_step12.pdparams")
+
+
+def test_latest_checkpoint_manifest_discovery(tmp_path):
+    """Runtime checkpoints are discovered by their commit manifest — a
+    directory NAME is never trusted on its own."""
+    from paddle_tpu.checkpoint.commit import write_manifest
+
+    for step in (3, 20):
+        d = tmp_path / f"step_{step:08d}"
+        d.mkdir()
+        write_manifest(str(d), step, {})
+    # a torn async save: highest step in its name, but still .tmp —
+    # it was never committed, so it must never be picked up
+    torn = tmp_path / "step_00000099.tmp"
+    torn.mkdir()
+    (torn / "w.p0.s0.npy").write_bytes(b"half a shard")
+    # a step-shaped dir whose name lies (no manifest, no metadata)
+    (tmp_path / "step_00000050").mkdir()
+    assert latest_checkpoint(str(tmp_path)).endswith("step_00000020")
+
+
+def test_latest_checkpoint_manifest_step_beats_name(tmp_path):
+    """The step comes FROM the manifest: a renamed/copied directory
+    still resumes at the step it actually holds."""
+    from paddle_tpu.checkpoint.commit import write_manifest
+
+    legacy = tmp_path / "ckpt_step5"
+    legacy.mkdir()
+    (legacy / "metadata.json").write_text("{}")
+    moved = tmp_path / "restored_copy"  # no usable number in the name
+    moved.mkdir()
+    write_manifest(str(moved), 7, {})
+    assert latest_checkpoint(str(tmp_path)).endswith("restored_copy")
 
 
 # -------------------------------------------- kill-one-worker integration
@@ -112,39 +146,45 @@ TRAIN_SCRIPT = textwrap.dedent("""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.core.tensor import Tensor
-    from paddle_tpu.distributed.checkpoint import (
-        load_state_dict, save_state_dict)
-    from paddle_tpu.distributed.fleet.elastic import latest_checkpoint
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
 
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     work = {work!r}
     ckdir = os.path.join(work, "ckpts")
-    os.makedirs(ckdir, exist_ok=True)
 
     paddle.seed(0)
     net = nn.Linear(4, 4)
     opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
-    start = 0
-    latest = latest_checkpoint(ckdir)
-    if latest:
-        st = {{"model": net.state_dict(), "step": 0}}
-        load_state_dict(st, latest)
-        start = int(st["step"]) + 1
+    # every rank resumes through the runtime (manifest-verified: a torn
+    # directory can never be picked up); only rank 0 writes
+    mgr = CheckpointManager(ckdir, network=net, optimizer=opt,
+                            policy=CheckpointPolicy(keep_last_k=100),
+                            async_saves=False)
+    res = mgr.restore_or_init()
+    start = res.step + 1 if res.restored else 0
 
     rng = np.random.RandomState(0)
     x = Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32"))
     y = Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32"))
     crash_marker = os.path.join(work, "crashed_once")
-    log = open(os.path.join(work, f"steps.{{rank}}.log"), "a")
+    logpath = os.path.join(work, f"steps.{{rank}}.log")
+    # a kill can land between logging step N and committing its save;
+    # the rerun of N recomputes the identical step (restored params,
+    # fixed batch), so only the log line needs dedup
+    lastlogged = -1
+    if os.path.exists(logpath):
+        for line in open(logpath):
+            lastlogged = max(lastlogged, json.loads(line)["step"])
+    log = open(logpath, "a")
     for step in range(start, 8):
         loss = ((net(x) - y) ** 2).mean()
         loss.backward(); opt.step(); opt.clear_grad()
-        print(json.dumps({{"step": step,
-                           "loss": float(loss.numpy())}}), file=log,
-              flush=True)
+        if step > lastlogged:
+            print(json.dumps({{"step": step,
+                               "loss": float(loss.numpy())}}), file=log,
+                  flush=True)
         if rank == 0:
-            save_state_dict({{"model": net.state_dict(), "step": step}},
-                            os.path.join(ckdir, f"ck_step{{step}}"))
+            mgr.save(step)
         if step == 3 and rank == 1 and not os.path.exists(crash_marker):
             open(crash_marker, "w").close()
             os._exit(17)  # simulated worker crash

