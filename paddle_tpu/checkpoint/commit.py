@@ -45,6 +45,11 @@ from ..distributed.checkpoint.metadata import Metadata, metadata_path
 MANIFEST_FILE = "manifest.json"
 LATEST_FILE = "LATEST"
 TMP_SUFFIX = ".tmp"
+# when re-saving an already-committed step, the old generation is
+# renamed aside to step_N.replaced.tmp for the duration of the commit
+# rename (never rmtree'd while the replacement is unpublished); startup
+# GC renames it back if a crash left the step with no committed dir
+REPLACED_SUFFIX = ".replaced" + TMP_SUFFIX
 
 _STEP_DIR_RE = re.compile(r"step_(\d+)")
 
@@ -109,13 +114,31 @@ def commit(root, step):
     """The commit point: rename ``step_N.tmp`` -> ``step_N`` and refresh
     the LATEST marker. Returns the committed path."""
     src, dst = tmp_dir(root, step), step_dir(root, step)
+    aside = None
     if os.path.isdir(dst):
         # a previous save of the same step (re-run after restore):
-        # replace it wholesale — two generations of one step must not mix
-        shutil.rmtree(dst)
-    os.rename(src, dst)
+        # replace it wholesale — two generations of one step must not
+        # mix. Rename the old generation ASIDE rather than rmtree'ing
+        # it: a crash during an rmtree-then-rename would destroy the
+        # committed generation while the replacement is still
+        # unpublished, losing the step entirely. The aside copy is
+        # deleted only after the new one is in place (and startup GC
+        # renames it back if a crash lands between the two renames).
+        aside = dst + REPLACED_SUFFIX
+        if os.path.isdir(aside):
+            shutil.rmtree(aside)
+        os.rename(dst, aside)
+        os.utime(aside, None)  # rename keeps mtime; stamp for GC's age window
+    try:
+        os.rename(src, dst)
+    except OSError:
+        if aside is not None and not os.path.isdir(dst):
+            os.rename(aside, dst)  # put the old generation back
+        raise
     atomic_write_text(os.path.join(root, LATEST_FILE), step_dir_name(step))
     fsync_dir(root)
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
     return dst
 
 
@@ -151,14 +174,24 @@ def list_committed(root):
 
 def latest_committed(root):
     """Path of the newest committed checkpoint, or None. The LATEST
-    marker is an O(1) fast path; a stale/torn marker falls back to the
-    directory scan."""
+    marker is a fast path but only a LOWER bound: a crash between the
+    commit rename and the marker write leaves it one step behind, so any
+    step-shaped dir newer than the marker (a cheap name scan, no
+    manifest reads) forces the full scan; a stale/torn marker falls back
+    the same way."""
     try:
         with open(os.path.join(root, LATEST_FILE)) as f:
             name = f.read().strip()
+        m = _STEP_DIR_RE.fullmatch(name)
         p = os.path.join(root, name)
-        if _STEP_DIR_RE.fullmatch(name) and read_manifest(p) is not None:
-            return p
+        if m and read_manifest(p) is not None:
+            marker_step = int(m.group(1))
+            newer = any(
+                mm and int(mm.group(1)) > marker_step
+                for mm in map(_STEP_DIR_RE.fullmatch, os.listdir(root))
+            )
+            if not newer:
+                return p
     except OSError:
         pass
     committed = list_committed(root)
@@ -216,7 +249,13 @@ def gc_orphans(root, min_age_s=0.0):
     this process has a save in flight. ``min_age_s`` protects OTHER
     processes sharing the root: a tmp dir modified within the window is
     presumed to be a live writer's (every shard write touches the dir —
-    create + rename per file) and is left alone."""
+    create + rename per file) and is left alone.
+
+    ``step_N.replaced.tmp`` dirs (the old generation a same-step re-save
+    moved aside mid-commit) get recovery instead of plain reaping: if a
+    crash between commit()'s two renames left the step with NO committed
+    dir, the aside copy — still intact, manifest and all — is renamed
+    back into place; otherwise it is reaped like any orphan."""
     removed = []
     now = time.time()
     try:
@@ -226,15 +265,31 @@ def gc_orphans(root, min_age_s=0.0):
     for name in names:
         if not name.endswith(TMP_SUFFIX):
             continue
-        if not _STEP_DIR_RE.fullmatch(name[: -len(TMP_SUFFIX)]):
+        stem = name[: -len(TMP_SUFFIX)]
+        replaced = name.endswith(REPLACED_SUFFIX)
+        if replaced:
+            stem = name[: -len(REPLACED_SUFFIX)]
+        if not _STEP_DIR_RE.fullmatch(stem):
             continue
         p = os.path.join(root, name)
         if not os.path.isdir(p):
             continue
+        if replaced and not os.path.isdir(os.path.join(root, stem)) \
+                and read_manifest(p) is not None:
+            # recovery is NOT age-gated: an elastic relaunch seconds
+            # after a mid-commit crash must get its step back, not
+            # restart from an older checkpoint until the window expires
+            # (worst case against a still-LIVE committer: its commit
+            # rename fails and that save errors — no data loss)
+            try:
+                os.rename(p, os.path.join(root, stem))
+                continue  # recovered, not removed
+            except OSError:
+                pass
         if min_age_s > 0:
             try:
                 if now - os.path.getmtime(p) < min_age_s:
-                    continue  # plausibly a live writer
+                    continue  # plausibly a live writer/committer
             except OSError:
                 continue
         shutil.rmtree(p, ignore_errors=True)

@@ -62,6 +62,12 @@ SAVE_SECONDS_BUCKETS = (
 # world); startup GC only reaps older ones
 ORPHAN_TMP_MIN_AGE_S = 300.0
 
+# multiprocess time-based triggers need cross-rank agreement (a
+# collective); running it every step would make the host loop
+# collective-synchronous, so agreement points fire on this step cadence
+# — a seconds-scale save interval is delayed by at most 15 steps
+TIME_TRIGGER_AGREE_STEPS = 16
+
 
 class CheckpointPolicy:
     """When to save and what to keep.
@@ -83,13 +89,18 @@ class CheckpointPolicy:
         self.keep_last_k = max(1, int(keep_last_k))
         self.keep_every_m = int(keep_every_m) if keep_every_m else None
 
-    def should_save(self, step, now, last_saved_step, last_saved_time):
+    def should_save(self, step, now, last_saved_step, last_saved_time,
+                    include_time=True):
+        """``include_time=False`` asks for the clock-free verdict only —
+        the one every rank of a multiprocess run computes identically
+        (the manager uses it between cross-rank agreement points, where
+        a rank-local clock read could split the ranks)."""
         if step == last_saved_step:
             return False
         if self.save_every_steps is not None and \
                 step - last_saved_step >= self.save_every_steps:
             return True
-        if self.save_every_seconds is not None and \
+        if include_time and self.save_every_seconds is not None and \
                 now - last_saved_time >= self.save_every_seconds:
             return True
         return False
@@ -166,6 +177,7 @@ class CheckpointManager:
         self._last_saved_time = time.monotonic()
         self.preempted = False
         self._prev_handlers = {}
+        self._preempt_rethrow = {}
         self._preempt_thread = None
         self._init_metrics(registry or get_registry())
         self._saver = (
@@ -326,12 +338,34 @@ class CheckpointManager:
         clock and saves when policy says so. Returns True if a save was
         triggered."""
         step = int(step)
+        now = time.monotonic()
         with self._lock:
             self._last_step = step
-            trigger = self.policy.should_save(
-                step, time.monotonic(),
-                self._last_saved_step, self._last_saved_time,
-            )
+            last_step = self._last_saved_step
+            last_time = self._last_saved_time
+        pol = self.policy
+        trigger = pol.should_save(step, now, last_step, last_time)
+        if pol.save_every_seconds is not None and \
+                self._process_count() > 1:
+            # time-based triggers read each rank's LOCAL clock; ranks
+            # straddling the threshold would disagree, and a save whose
+            # collectives only some ranks enter is a distributed hang.
+            # The coordinator's verdict is broadcast at agreement points
+            # on a deterministic step cadence; between them only the
+            # policy's clock-free verdict — identical on every rank —
+            # may trigger.
+            if step % TIME_TRIGGER_AGREE_STEPS == 0:
+                from ..distributed import communication as comm
+
+                verdict = [bool(trigger)]
+                comm.broadcast_object_list(
+                    verdict, src=self.coordinator_rank
+                )
+                trigger = bool(verdict[0])
+            else:
+                trigger = pol.should_save(
+                    step, now, last_step, last_time, include_time=False
+                )
         if trigger:
             self.save(step)
         return trigger
@@ -344,6 +378,14 @@ class CheckpointManager:
         step = int(self._last_step if step is None else step)
         if blocking is None:
             blocking = not self.async_saves
+        if self._process_count() > 1:
+            # multiprocess writes contain collectives; issued from the
+            # background writer they would interleave nondeterministically
+            # with the train loop's own collectives across ranks (rank 0
+            # pairs writer-barrier against rank 1's main-thread gather —
+            # a distributed hang), so the write runs on the calling
+            # thread, where collective order is program order
+            blocking = True
         mode = mode or ("sync" if blocking else "async")
         state = self._build_state(step)
         snap = snapshot_state(state)
@@ -383,33 +425,83 @@ class CheckpointManager:
         for async saves."""
         t0 = time.perf_counter()
         tmp = commit_mod.tmp_dir(self.root, step)
-        if os.path.isdir(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-        files = self._serialize(snap, tmp) or {}
         nprocs = self._process_count()
         if nprocs > 1:
+            # every process writes shards into the SHARED tmp dir: only
+            # the coordinator may clear a stale one, and the barrier
+            # keeps any peer from streaming shards into a dir that is
+            # about to be rmtree'd under it
+            from ..distributed import communication as comm
+
+            if self._is_coordinator() and os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            comm.barrier()
+        elif os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+        err = None
+        try:
+            files = self._serialize(snap, tmp) or {}
+        except Exception as e:
+            err, files = e, {}
+        if nprocs > 1:
             # manifest needs every process's file digests; the gather
-            # doubles as the all-shards-on-storage barrier
+            # doubles as the all-shards-on-storage barrier. A rank-local
+            # serialize failure is gathered too, never raised before the
+            # collective — a rank bailing early would strand its peers
+            # in the allgather forever — and then raised on ALL ranks,
+            # so every rank rolls its saved-marker back and the step
+            # triggers stay in sync.
             from ..distributed import communication as comm
 
             gathered = []  # all_gather_object APPENDS one entry per rank
-            comm.all_gather_object(gathered, files)
-            files = {}
-            for part in gathered:
-                files.update(part or {})
-        extra = None
-        if self._manifest_extra_fn is not None:
-            extra = self._manifest_extra_fn(step, snap)
+            comm.all_gather_object(
+                gathered,
+                {"files": files, "error": repr(err) if err else None},
+            )
+            files, failed = {}, {}
+            for rank, part in enumerate(gathered):
+                part = part or {}
+                if part.get("error"):
+                    failed[rank] = part["error"]
+                files.update(part.get("files") or {})
+            if failed:
+                raise RuntimeError(
+                    f"checkpoint save for step {step} failed on "
+                    f"rank(s) {failed}"
+                ) from err
+        elif err is not None:
+            raise err
         path = None
+        commit_err = None
         if self._is_coordinator():
-            commit_mod.write_manifest(tmp, step, files, extra=extra)
-            path = commit_mod.commit(self.root, step)
-            self._apply_retention()
+            try:
+                extra = (
+                    self._manifest_extra_fn(step, snap)
+                    if self._manifest_extra_fn is not None else None
+                )
+                commit_mod.write_manifest(tmp, step, files, extra=extra)
+                path = commit_mod.commit(self.root, step)
+                self._apply_retention()
+            except Exception as e:
+                commit_err = e
         if nprocs > 1:
             from ..distributed import communication as comm
 
-            comm.barrier()  # nobody resumes past a half-published commit
+            # the outcome broadcast doubles as the pre-resume barrier
+            # (nobody resumes past a half-published commit), and a
+            # coordinator-side manifest/commit/retention failure raises
+            # on EVERY rank instead of stranding peers in a barrier the
+            # coordinator never reached
+            outcome = [repr(commit_err) if commit_err else None]
+            comm.broadcast_object_list(outcome, src=self.coordinator_rank)
+            if outcome[0]:
+                raise RuntimeError(
+                    f"checkpoint commit for step {step} failed on the "
+                    f"coordinator: {outcome[0]}"
+                ) from commit_err
+        elif commit_err is not None:
+            raise commit_err
         dt = time.perf_counter() - t0
         nbytes = sum(int(rec["bytes"]) for rec in files.values())
         self.save_seconds.observe(dt)
@@ -524,7 +616,12 @@ class CheckpointManager:
         """SIGTERM (preemption notice) → drain any in-flight save within
         the grace window, then take an emergency synchronous save of the
         current step. Sets :attr:`preempted` for the train loop to exit;
-        the previous handler is chained after the save lands.
+        a previous (callable) handler is honored after the save lands by
+        RE-RAISING the signal at the process with it restored — never by
+        calling it from the worker thread, where e.g.
+        ``signal.default_int_handler``'s KeyboardInterrupt would kill
+        only that thread and the stale interrupted frame would be
+        invoked long after its signal context.
 
         The handler itself only sets the flag and hands the save to a
         dedicated thread: signal handlers run on the main thread between
@@ -537,6 +634,18 @@ class CheckpointManager:
         grace_seconds = float(grace_seconds)
 
         def handler(signum, frame, _grace=grace_seconds):
+            if self._preempt_rethrow.pop(signum, None):
+                # the emergency save committed and the worker re-raised:
+                # restore the previous handler and deliver the signal to
+                # it ON THE MAIN THREAD with real signal semantics
+                # (signal.signal is main-thread-only, so the restore has
+                # to happen here, not on the worker)
+                prev = self._prev_handlers.get(signum)
+                signal.signal(
+                    signum, prev if prev is not None else signal.SIG_DFL
+                )
+                signal.raise_signal(signum)
+                return
             self.preempted = True
             if self._preempt_thread is not None and \
                     self._preempt_thread.is_alive():
@@ -545,8 +654,9 @@ class CheckpointManager:
 
             def run():
                 self.emergency_save(grace_seconds=_grace)
-                if callable(prev):
-                    prev(signum, frame)
+                if callable(prev) and prev is not handler:
+                    self._preempt_rethrow[signum] = True
+                    os.kill(os.getpid(), signum)
 
             self._preempt_thread = threading.Thread(
                 target=run, name="ckpt-preempt", daemon=False

@@ -180,6 +180,71 @@ def test_fresh_tmp_of_a_live_writer_not_reaped(tmp_path):
     mgr.close()
 
 
+def test_resave_same_step_replaces_without_loss_window(tmp_path):
+    """Re-saving an already-committed step replaces it wholesale, and
+    the old generation is renamed ASIDE (not rmtree'd) while the new
+    one is unpublished — a crash mid-commit must never leave the step
+    with zero committed generations."""
+    net, opt = _make(20)
+    bx, by = _batch()
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(6)
+    old = _params(net)
+    _train_batch(net, opt, bx, by)
+    mgr.save(6)  # same step, new params
+    mgr.close()
+    assert [s for s, _ in list_committed(str(tmp_path))] == [6]
+    assert verify_checkpoint(latest_committed(str(tmp_path))) == []
+    # no aside/tmp debris after a clean commit
+    assert sorted(os.listdir(tmp_path)) == ["LATEST", "step_00000006"]
+    # and the surviving generation is the NEW one
+    state = {"model": net.state_dict()}
+    from paddle_tpu.distributed.checkpoint.save_load import load_state_dict
+    load_state_dict(state, latest_committed(str(tmp_path)))
+    for k, v in state["model"].items():
+        assert not np.array_equal(np.asarray(v.numpy()), old[k]), k
+
+
+def test_gc_recovers_replaced_aside_after_commit_crash(tmp_path):
+    """A crash between commit()'s two renames leaves the old generation
+    at step_N.replaced.tmp and NO step_N: startup GC must rename it
+    back so the committed generation is not lost."""
+    net, opt = _make(21)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(4)
+    mgr.close()
+    committed = tmp_path / "step_00000004"
+    aside = tmp_path / "step_00000004.replaced.tmp"
+    os.rename(committed, aside)  # the crash window, reconstructed
+    # recovery is immediate — an elastic relaunch seconds after the
+    # crash must not lose the step to the orphan age window
+    assert commit_mod.gc_orphans(str(tmp_path), min_age_s=300.0) == []
+    assert committed.is_dir() and not aside.exists()
+    assert verify_checkpoint(str(committed)) == []
+    res_net, res_opt = _make(22)
+    mgr2 = CheckpointManager(str(tmp_path), network=res_net,
+                             optimizer=res_opt)
+    assert mgr2.restore_or_init().step == 4
+    mgr2.close()
+
+
+def test_latest_marker_is_only_a_lower_bound(tmp_path):
+    """A crash between the commit rename and the LATEST write leaves the
+    marker one step behind; the fast path must not return the older
+    checkpoint when a newer committed one exists on disk."""
+    net, opt = _make(23)
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    mgr.save(1)
+    mgr.save(2)
+    # reconstruct the crash: marker still names step 1 (itself intact)
+    (tmp_path / "LATEST").write_text("step_00000001")
+    assert latest_committed(str(tmp_path)).endswith("step_00000002")
+    mgr.close()
+
+
 def test_failed_write_rolls_back_saved_marker(tmp_path):
     """A failed background write must not leave the manager believing
     the step was saved — the emergency (and next policy) save must
@@ -502,6 +567,43 @@ def test_sigterm_emergency_save(tmp_path):
         assert verify_checkpoint(latest_committed(str(tmp_path))) == []
     finally:
         signal.signal(signal.SIGUSR1, mgr._prev_handlers[signal.SIGUSR1])
+        mgr.close()
+
+
+def test_preemption_chains_prev_handler_on_main_thread(tmp_path):
+    """A previous Python handler is honored by re-raising the signal
+    with it restored — it must run on the MAIN thread in real signal
+    context (a KeyboardInterrupt-style handler invoked on the ckpt
+    worker thread would kill only that thread), not be called directly
+    from the emergency-save thread."""
+    import threading
+
+    seen = []
+
+    def prev_handler(signum, frame):
+        seen.append(threading.current_thread() is threading.main_thread())
+
+    orig = signal.signal(signal.SIGUSR2, prev_handler)
+    net, opt = _make(24)
+    mgr = CheckpointManager(
+        str(tmp_path), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1000),
+    )
+    mgr.install_preemption_handler(signals=(signal.SIGUSR2,),
+                                   grace_seconds=10.0)
+    try:
+        mgr.on_step(9)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert mgr.join_preemption(timeout=30)
+        deadline = time.time() + 10
+        while not seen and time.time() < deadline:
+            time.sleep(0.005)  # re-raise lands between bytecodes
+        assert seen == [True], seen
+        assert [s for s, _ in list_committed(str(tmp_path))] == [9]
+        # the previous handler was RESTORED before the re-raise
+        assert signal.getsignal(signal.SIGUSR2) is prev_handler
+    finally:
+        signal.signal(signal.SIGUSR2, orig)
         mgr.close()
 
 
