@@ -4,7 +4,8 @@
 PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
-.PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke
+.PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
+	tune-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -39,6 +40,13 @@ metrics-smoke:
 # every committed checkpoint must pass full manifest verification.
 ckpt-smoke:
 	$(ENV) $(PY) tools/ckpt_smoke.py
+
+# Kernel-autotuner gate: candidate generators emit only legal block
+# configs, a tiny measured search round-trips through the persistent
+# cache (second run = 100% hits, zero re-measurements), and both fusion
+# kernels hold bit-exact parity vs their composed references.
+tune-smoke:
+	$(ENV) $(PY) tools/kernel_tune.py --smoke
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

@@ -386,6 +386,25 @@ def lower_7b_check():
         raise SystemExit(r.returncode)
 
 
+def tune_kernels():
+    """``--tune``: measured-search the kernel block configs over the
+    flagship + serving-decode shapes and print ONE self-describing JSON
+    record — chosen configs, per-candidate timings, and cache
+    accounting (a repeat run on a tuned device reports 100% cache hits
+    and zero re-measurements). Results persist in the tune cache
+    (tools/kernel_tune_cache.json or PADDLE_TPU_TUNE_CACHE), which the
+    kernels' selection paths read at trace time."""
+    from tools.kernel_tune import run_tune
+
+    rec = run_tune()
+    # run_tune's device/platform are the NORMALIZED kind used in the
+    # cache keys (e.g. "tpu-v5e", not "TPU v5 lite") — never clobber
+    for k, v in _device_desc().items():
+        rec.setdefault(k, v)
+    print(json.dumps(rec))
+    return rec
+
+
 def probe_backend(timeout=240):
     """Classify backend health in a KILLABLE subprocess: "tpu" /
     "cpu" (responsive backends) or "wedged" (init hung or crashed). A
@@ -455,6 +474,13 @@ def main(profile=False, all_configs=False):
 if __name__ == "__main__":
     if "--lower-7b" in sys.argv:
         lower_7b_check()
+    elif "--tune" in sys.argv:
+        if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
+                and probe_backend() == "wedged"):
+            print(json.dumps({"metric": "kernel_tune",
+                              "tpu_unreachable": True}))
+            raise SystemExit(1)
+        tune_kernels()
     else:
         main(profile="--profile" in sys.argv,
              all_configs="--all" in sys.argv)

@@ -15,8 +15,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _interpret():
-    return all(d.platform == "cpu" for d in jax.devices())
+from .autotune import interpret_mode as _interpret
 
 
 def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref):

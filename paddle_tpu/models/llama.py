@@ -93,6 +93,26 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
         k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
         v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
+        if (cache is None and attn_mask is None
+                and cfg.kv_heads == cfg.num_attention_heads
+                and rope_cos is not None and rope_sin is not None):
+            # tune-cache OPT-IN fused rope+attention (rotation applied
+            # inside the attention kernel's q/k load — no rotated
+            # copies in HBM); with no measured entry for this shape the
+            # unfused path below runs unchanged
+            from ..kernels.fused_rope_attention import (
+                rope_attention_apply,
+                rope_attention_select,
+            )
+
+            sel = rope_attention_select(B, S, cfg.num_attention_heads,
+                                        cfg.head_dim)
+            if sel is not None:
+                out = rope_attention_apply(
+                    q, k, v, rope_cos, rope_sin, causal=True,
+                    block_q=sel["block_q"],
+                )
+                return self.o_proj(out.reshape([B, S, -1]))
         pos_ids = None
         if cache is not None:
             p0 = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
@@ -216,9 +236,12 @@ class LlamaModel(nn.Layer):
         )
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None,
+                apply_final_norm=True):
         """``caches``: list of per-layer (k_cache, v_cache) for decode
-        (returns (hidden, new_caches)); None for the training path."""
+        (returns (hidden, new_caches)); None for the training path.
+        ``apply_final_norm=False`` returns the pre-norm hidden state so
+        a fused norm+matmul head can absorb ``self.norm``."""
         cfg = self.config
         S = int(input_ids.shape[1])
         from ..kernels.rope import build_rope_cache
@@ -242,13 +265,13 @@ class LlamaModel(nn.Layer):
                 h, c2 = layer(h, cos_t, sin_t, attn_mask,
                               cache=cache, pos=pos)
                 new_caches.append(c2)
-            return self.norm(h), new_caches
+            return (self.norm(h) if apply_final_norm else h), new_caches
         cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
         cos_t, sin_t = Tensor(cos), Tensor(sin)
         h = self.embed_tokens(input_ids)
         for layer in self.layers:
             h = layer(h, cos_t, sin_t, attn_mask)
-        return self.norm(h)
+        return self.norm(h) if apply_final_norm else h
 
 
 class LlamaFlopsMixin:
@@ -280,17 +303,46 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
                 config.hidden_size, config.vocab_size, bias_attr=False
             )
 
+    def _head_fusion(self, n_rows):
+        """Tune-cache OPT-IN fused rms_norm+lm_head config (None keeps
+        the unfused norm -> linear path byte-identical)."""
+        if self.lm_head is None:
+            return None
+        from ..kernels.fused_norm_matmul import head_fusion_select
+
+        return head_fusion_select(
+            n_rows, self.config.hidden_size, self.config.vocab_size
+        )
+
+    def _fused_head(self, h, sel):
+        from ..kernels.fused_norm_matmul import rms_norm_matmul_apply
+
+        return rms_norm_matmul_apply(
+            h, self.model.norm.weight, self.lm_head.weight,
+            eps=self.config.rms_norm_eps,
+            block_rows=sel["block_rows"], block_cols=sel["block_cols"],
+        )
+
     def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+        B, S = int(input_ids.shape[0]), int(input_ids.shape[1])
+        sel = self._head_fusion(B * S)
         if caches is not None:
             h, new_caches = self.model(
-                input_ids, attn_mask, caches=caches, pos=pos
+                input_ids, attn_mask, caches=caches, pos=pos,
+                apply_final_norm=sel is None,
             )
-            logits = (
-                F.linear(h, self.model.embed_tokens.weight.t())
-                if self.lm_head is None else self.lm_head(h)
-            )
+            if sel is not None:
+                logits = self._fused_head(h, sel)
+            else:
+                logits = (
+                    F.linear(h, self.model.embed_tokens.weight.t())
+                    if self.lm_head is None else self.lm_head(h)
+                )
             return logits, new_caches
-        h = self.model(input_ids, attn_mask)
+        h = self.model(input_ids, attn_mask,
+                       apply_final_norm=sel is None)
+        if sel is not None:
+            return self._fused_head(h, sel)
         if self.lm_head is None:
             return F.linear(h, self.model.embed_tokens.weight.t())
         return self.lm_head(h)

@@ -26,6 +26,23 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def force_tpu(monkeypatch):
+    """Make flash-attention selection see a fake TPU backend with an
+    importable pallas kernel (the selection tests run on CPU; the real
+    kernels are exercised on the chip)."""
+    import paddle_tpu.kernels.flash_attention as fa
+
+    class _FakeTpu:
+        platform = "tpu"
+
+    monkeypatch.setattr(fa.jax, "devices", lambda: [_FakeTpu()])
+    monkeypatch.setattr(fa, "_pallas_fa", lambda: object())
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -6,17 +6,9 @@ otherwise. These tests pin the decision logic and the v5e block
 clamping on CPU (the kernels themselves are exercised on the chip).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu.kernels.flash_attention as fa
-
-
-class _FakeTpu:
-    platform = "tpu"
-
-
-def _force_tpu(monkeypatch):
-    monkeypatch.setattr(fa.jax, "devices", lambda: [_FakeTpu()])
-    monkeypatch.setattr(fa, "_pallas_fa", lambda: object())
 
 
 def _qkv(b, s, h, d):
@@ -24,8 +16,7 @@ def _qkv(b, s, h, d):
     return x, x, x
 
 
-def test_selection_causal_threshold(monkeypatch):
-    _force_tpu(monkeypatch)
+def test_selection_causal_threshold(force_tpu):
     q, k, v = _qkv(4, 1024, 16, 128)
     assert not fa._pallas_ok(q, k, v, causal=True)  # flagship stays composed
     q, k, v = _qkv(4, 2048, 16, 128)
@@ -33,26 +24,44 @@ def test_selection_causal_threshold(monkeypatch):
     assert not fa._pallas_ok(q, k, v, causal=False)  # no triangle to skip
 
 
-def test_selection_memory_threshold_non_causal(monkeypatch):
-    _force_tpu(monkeypatch)
+def test_selection_memory_threshold_non_causal(force_tpu):
     # 4*B*H*S^2 > 2 GiB -> pallas even without causality
     q, k, v = _qkv(8, 8192, 16, 128)
     assert fa._pallas_ok(q, k, v, causal=False)
 
 
-def test_selection_shape_constraints(monkeypatch):
-    _force_tpu(monkeypatch)
+def test_selection_shape_constraints(force_tpu):
     q, k, v = _qkv(4, 2048 + 2, 16, 128)  # not a lane multiple
     assert not fa._pallas_ok(q, k, v, causal=True)
     q, k, v = _qkv(4, 2048, 16, 96)  # unsupported head_dim
     assert not fa._pallas_ok(q, k, v, causal=True)
-    # divisible by 128 but NOT by the tuned blocks (2176 = 17*128): the
-    # kernel would assert on block_q=512 — must fall back to composed
-    q, k, v = _qkv(4, 2176, 16, 128)
-    assert not fa._pallas_ok(q, k, v, causal=True)
     # multiples of the tuned blocks are accepted (3072 = 6*512 = 3*1024)
     q, k, v = _qkv(4, 3072, 16, 128)
     assert fa._pallas_ok(q, k, v, causal=True)
+
+
+def test_indivisible_seed_two_regime_policy(force_tpu):
+    """2176 = 17*128 fails the seeded blocks' modulo checks. In the
+    time regime an unmeasured generated config is NOT trusted
+    (BENCH_NOTES measured small-block pallas up to 2.5x slower than
+    composed): composed is kept and the shape is SIGNALLED for tuning
+    instead of silently losing (the pre-autotuner failure mode). In
+    the memory regime (>2 GiB fp32 scores) the divisibility-aware
+    generator's legal config is used — any legal pallas config beats
+    materializing the O(S^2) scores."""
+    from paddle_tpu.kernels import autotune
+
+    autotune.reset_warned()
+    q, k, v = _qkv(4, 2176, 16, 128)  # score matrix ~1.2 GiB: time regime
+    with pytest.warns(RuntimeWarning, match="untuned-config"):
+        ok, cfg, reason = fa._select(q, k, v, causal=True)
+    assert not ok and reason == "fallback:untuned-config"
+    q, k, v = _qkv(8, 2176, 32, 128)  # ~4.5 GiB scores: memory regime
+    ok, cfg, reason = fa._select(q, k, v, causal=True)
+    assert ok and reason == "pallas:generated"
+    assert autotune.flash_config_legal(2176, 2176, cfg)
+    bs = fa._tuned_block_sizes(2176, 2176, config=cfg)
+    assert 2176 % bs.block_q == 0 and 2176 % bs.block_k_major == 0
 
 
 def test_selection_off_on_cpu():
@@ -66,3 +75,23 @@ def test_tuned_blocks_clamp_short_seqs():
     bs = fa._tuned_block_sizes(4096, 4096)
     assert (bs.block_q, bs.block_k_major, bs.block_k) == (512, 1024, 512)
     assert bs.block_q_dkv == 512 and bs.block_k_major_dq == 1024
+
+
+def test_tuned_blocks_prefer_cache_entry(tmp_path, monkeypatch):
+    """Acceptance pin: with no cache entry _tuned_block_sizes is the
+    seeded v5e default (byte-identical selection); with an entry it
+    returns the cached config."""
+    from paddle_tpu.kernels import autotune
+
+    monkeypatch.setenv(autotune.ENV_CACHE,
+                       str(tmp_path / "tune_cache.json"))
+    autotune.reset_cache()
+    bs = fa._tuned_block_sizes(2048, 2048, b=4, h=16, d=128)
+    assert (bs.block_q, bs.block_k_major, bs.block_k) == (512, 1024, 512)
+    autotune.get_cache().record(
+        "flash_attention", autotune.flash_sig(4, 2048, 2048, 16, 128, True),
+        {"block_q": 256, "block_k_major": 512, "block_k": 256},
+    )
+    bs = fa._tuned_block_sizes(2048, 2048, b=4, h=16, d=128)
+    assert (bs.block_q, bs.block_k_major, bs.block_k) == (256, 512, 256)
+    autotune.reset_cache()

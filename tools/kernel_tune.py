@@ -1,0 +1,438 @@
+"""kernel_tune — measured-search block-config tuning for the Pallas kernels.
+
+Drives ``paddle_tpu.kernels.autotune`` over the shapes that matter in
+production — the flagship train step's attention/head geometry and the
+serving decode head — and records the winners in the persistent tune
+cache (``tools/kernel_tune_cache.json`` by default, checked in for v5e
+like the lint baseline; ``PADDLE_TPU_TUNE_CACHE`` overrides).
+
+    python tools/kernel_tune.py              # tune this device's standard shapes
+    python tools/kernel_tune.py --json       # machine-readable report
+    python tools/kernel_tune.py --smoke      # CPU-safe machinery gate (CI)
+    python tools/kernel_tune.py --cache P    # explicit cache file
+
+Methodology (BENCH_NOTES r5, the hand ablation this generalizes): every
+candidate — including the composed-reference baseline — is timed
+fwd+bwd in interleaved round-robin windows and compared by
+median-of-windows, so one contended window cannot poison a single
+candidate. A shape with a cache entry is a HIT: zero measurements, the
+entry is reported as-is (re-tune by deleting the entry or pointing
+``--cache`` elsewhere).
+
+``--smoke`` is the ``make tune-smoke`` gate: tiny shapes, CPU-safe (the
+fusion kernels run in pallas interpret mode; the stock flash kernel
+needs a chip and is skipped), a throwaway cache file. It asserts
+candidate-generator legality, a cache write/read round trip, a
+100%-cache-hit re-run with zero re-measurements, and fused-vs-composed
+parity for both fusion kernels.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _on_tpu():
+    from paddle_tpu.kernels import autotune
+
+    return not autotune.interpret_mode()
+
+
+# --------------------------------------------------------- shape catalogs
+
+
+def standard_specs(on_tpu):
+    """(kernel, spec) list for this backend. TPU: the flagship
+    llama-748M geometry (B=4, H=16, D=128, hidden 2048, vocab 32k) at
+    the train S and the long-context S values BENCH_NOTES measured,
+    plus the serving decode head. CPU: tiny interpret-mode shapes (a
+    smoke of the machinery, not a performance measurement)."""
+    if on_tpu:
+        return [
+            ("flash_attention",
+             {"b": 4, "s": 2048, "h": 16, "d": 128, "causal": True}),
+            ("flash_attention",
+             {"b": 4, "s": 4096, "h": 16, "d": 128, "causal": True}),
+            ("rope_attention", {"b": 4, "s": 1024, "h": 16, "d": 128}),
+            ("rope_attention", {"b": 4, "s": 2048, "h": 16, "d": 128}),
+            # flagship train head: B*S rows x hidden -> vocab
+            ("rms_norm_matmul",
+             {"rows": 4096, "hidden": 2048, "n_out": 32000}),
+            # serving decode head: one token per resident slot
+            ("rms_norm_matmul",
+             {"rows": 8, "hidden": 2048, "n_out": 32000}),
+        ]
+    return [
+        ("rope_attention", {"b": 2, "s": 64, "h": 2, "d": 16}),
+        ("rms_norm_matmul", {"rows": 16, "hidden": 64, "n_out": 256}),
+    ]
+
+
+# ------------------------------------------------------------ tune drivers
+
+
+def _sig_and_candidates(kernel, spec):
+    from paddle_tpu.kernels import autotune
+
+    if kernel == "flash_attention":
+        sig = autotune.flash_sig(spec["b"], spec["s"], spec["s"],
+                                 spec["h"], spec["d"], spec["causal"])
+        cands = autotune.flash_block_candidates(spec["s"], spec["s"])
+    elif kernel == "rope_attention":
+        sig = autotune.rope_attention_sig(spec["b"], spec["s"],
+                                          spec["h"], spec["d"])
+        cands = autotune.rope_attention_candidates(spec["s"])
+    elif kernel == "rms_norm_matmul":
+        sig = autotune.norm_matmul_sig(spec["rows"], spec["hidden"],
+                                       spec["n_out"])
+        cands = autotune.norm_matmul_candidates(spec["rows"],
+                                                spec["n_out"])
+    else:
+        raise ValueError(f"unknown kernel {kernel!r}")
+    return sig, cands
+
+
+def _build_factory(kernel, spec):
+    """build(config) -> zero-arg fwd+bwd runnable for the candidate.
+    ``{"path": "composed"}`` builds the composed-reference baseline."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    dtype = jnp.bfloat16 if _on_tpu() else jnp.float32
+
+    if kernel in ("flash_attention", "rope_attention"):
+        b, s, h, d = spec["b"], spec["s"], spec["h"], spec["d"]
+        causal = spec.get("causal", True)
+        q = jnp.asarray(rng.randn(b, s, h, d), dtype)
+        k = jnp.asarray(rng.randn(b, s, h, d), dtype)
+        v = jnp.asarray(rng.randn(b, s, h, d), dtype)
+        if kernel == "flash_attention":
+            from paddle_tpu.kernels import flash_attention as fa
+
+            def build(config):
+                if config.get("path") == "composed":
+                    def f(qv, kv, vv):
+                        return fa._composed(
+                            qv, kv, vv, causal=causal,
+                            scale=1.0 / float(np.sqrt(d)),
+                        ).astype(jnp.float32).sum()
+                else:
+                    pallas_fa = fa._pallas_fa()
+                    bs = fa._tuned_block_sizes(s, s, config=config)
+
+                    def f(qv, kv, vv):
+                        out = pallas_fa(
+                            jnp.swapaxes(qv, 1, 2),
+                            jnp.swapaxes(kv, 1, 2),
+                            jnp.swapaxes(vv, 1, 2),
+                            causal=causal,
+                            sm_scale=1.0 / float(np.sqrt(d)),
+                            block_sizes=bs,
+                        )
+                        return out.astype(jnp.float32).sum()
+
+                step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+                return lambda: step(q, k, v)
+
+            return build
+
+        from paddle_tpu.kernels import flash_attention as fa
+        from paddle_tpu.kernels import fused_rope_attention as fra
+        from paddle_tpu.kernels.rope import build_rope_cache, rope_fused
+
+        cos, sin = build_rope_cache(s, d)
+
+        def build(config):
+            if config.get("path") == "composed":
+                # the baseline is today's PRODUCTION unfused path —
+                # rope kernel + flash_attention_fwd (which selects the
+                # tuned pallas flash kernel where eligible), not bare
+                # composed attention: the fused_beats_composed verdict
+                # gates replacing this exact path in llama.py, so
+                # beating a slower strawman must not count as a win
+                def f(qv, kv, vv):
+                    qr = rope_fused(qv, cos, sin)
+                    kr = rope_fused(kv, cos, sin)
+                    return fa.flash_attention_fwd(
+                        qr, kr, vv, causal=causal
+                    ).astype(jnp.float32).sum()
+            else:
+                bq = config["block_q"]
+
+                def f(qv, kv, vv):
+                    return fra.rope_attention_fused(
+                        qv, kv, vv, cos, sin, causal=causal, block_q=bq
+                    ).astype(jnp.float32).sum()
+
+            step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            return lambda: step(q, k, v)
+
+        return build
+
+    if kernel == "rms_norm_matmul":
+        from paddle_tpu.kernels import fused_norm_matmul as fnm
+
+        rows, hidden, n_out = spec["rows"], spec["hidden"], spec["n_out"]
+        x = jnp.asarray(rng.randn(rows, hidden), dtype)
+        w = jnp.asarray(rng.randn(hidden), jnp.float32)
+        wm = jnp.asarray(rng.randn(hidden, n_out), dtype)
+
+        def build(config):
+            if config.get("path") == "composed":
+                def f(xv, wv, mv):
+                    return fnm.rms_norm_matmul_composed(
+                        xv, wv, mv
+                    ).astype(jnp.float32).sum()
+            else:
+                br, bc = config["block_rows"], config["block_cols"]
+
+                def f(xv, wv, mv):
+                    return fnm.rms_norm_matmul(
+                        xv, wv, mv, block_rows=br, block_cols=bc
+                    ).astype(jnp.float32).sum()
+
+            step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+            return lambda: step(x, w, wm)
+
+        return build
+
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def tune_shape(kernel, spec, cache, *, iters=3, windows=3,
+               max_candidates=24, clock=None, sync=None):
+    """Cache-or-measure one (kernel, spec). Returns a report row."""
+    from paddle_tpu.kernels import autotune
+
+    sig, cands = _sig_and_candidates(kernel, spec)
+    row = {"kernel": kernel, "sig": sig, "spec": spec}
+    hit = cache.lookup(kernel, sig)
+    if hit is not None:
+        row.update(cache_hit=True, config=hit, measured=0)
+        return row
+    if not cands:
+        row.update(cache_hit=False, config=None, measured=0,
+                   reason="no-legal-candidates")
+        return row
+    if kernel == "flash_attention":
+        from paddle_tpu.kernels import flash_attention as _fa
+
+        if not _on_tpu() or _fa._pallas_fa() is None:
+            # the stock pallas flash kernel has no interpret path —
+            # tuning it needs a chip (+ the jax tpu ops lib); the
+            # fusion kernels cover the CPU smoke
+            row.update(cache_hit=False, config=None, measured=0,
+                       reason="requires-tpu")
+            return row
+    if len(cands) > max_candidates:
+        row["truncated_candidates"] = len(cands) - max_candidates
+        cands = cands[:max_candidates]
+    cands = [{"path": "composed"}] + cands
+    build = _build_factory(kernel, spec)
+    best, table = autotune.measured_search(
+        cands, build, iters=iters, windows=windows, clock=clock,
+        sync=sync,
+    )
+    pallas_rows = [r for r in table
+                   if r["config"].get("path") != "composed"]
+    composed = next((r for r in table
+                     if r["config"].get("path") == "composed"), None)
+    winner = pallas_rows[0]["config"] if pallas_rows else None
+    fused_wins = (composed is not None and bool(pallas_rows)
+                  and pallas_rows[0]["median_s"] < composed["median_s"])
+    if winner is not None:
+        # record the best fused config EITHER WAY (so a re-run is a
+        # cache hit, not a re-measurement), but store the measured
+        # fused-vs-composed verdict with it: the selection paths
+        # (rope_attention_select / head_fusion_select / flash _select)
+        # refuse to activate a fused kernel whose entry says
+        # fused_beats_composed is False — the tuner must never install
+        # a measured performance regression.
+        timings = {json.dumps(r["config"], sort_keys=True):
+                   round(r["median_s"] * 1e3, 4) for r in table}
+        cache.record(kernel, sig, winner, timings_ms=timings,
+                     extra={"fused_beats_composed": fused_wins})
+    row.update(
+        cache_hit=False, config=winner, measured=len(table),
+        table=[{"config": r["config"],
+                "median_ms": round(r["median_s"] * 1e3, 4)}
+               for r in table],
+        composed_median_ms=(round(composed["median_s"] * 1e3, 4)
+                            if composed else None),
+        fused_beats_composed=fused_wins,
+    )
+    return row
+
+
+def run_tune(cache_path=None, specs=None, *, iters=3, windows=3,
+             clock=None, sync=None):
+    """Tune every spec (default: this backend's standard catalog);
+    returns the self-describing record bench.py --tune emits."""
+    import jax
+
+    from paddle_tpu.kernels import autotune
+
+    cache = (autotune.TuneCache(cache_path) if cache_path
+             else autotune.get_cache())
+    redirected = False
+    if (not cache_path and not _on_tpu()
+            and cache.path == autotune.DEFAULT_CACHE_PATH):
+        # a chipless dev-box run must NOT dirty the checked-in v5e
+        # baseline artifact: divert default-path writes to a per-user
+        # scratch file (still persistent, so a CPU re-run is a cache
+        # hit). An explicit --cache / PADDLE_TPU_TUNE_CACHE wins.
+        uid = getattr(os, "getuid", lambda: 0)()
+        cache = autotune.TuneCache(os.path.join(
+            tempfile.gettempdir(),
+            f"paddle_tpu_kernel_tune_cpu_{uid}.json"))
+        redirected = True
+    specs = specs if specs is not None else standard_specs(_on_tpu())
+    rows = [tune_shape(kernel, spec, cache, iters=iters, windows=windows,
+                       clock=clock, sync=sync)
+            for kernel, spec in specs]
+    measured = sum(1 for r in rows if r["measured"])
+    hits = sum(1 for r in rows if r.get("cache_hit"))
+    d = jax.devices()[0]
+    return {
+        "metric": "kernel_tune",
+        "device": autotune.device_kind(),
+        "platform": d.platform,
+        "cache_path": cache.path,
+        "cache_redirected_from": (autotune.DEFAULT_CACHE_PATH
+                                  if redirected else None),
+        "iters_per_window": iters,
+        "windows": windows,
+        "shapes": len(rows),
+        "shapes_measured": measured,
+        "cache_hits": hits,
+        "cache_hit_rate": round(hits / len(rows), 4) if rows else None,
+        "results": rows,
+    }
+
+
+# ------------------------------------------------------------------- smoke
+
+
+def smoke():
+    """CPU-safe machinery gate (``make tune-smoke``)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.kernels import autotune
+    from paddle_tpu.kernels import fused_norm_matmul as fnm
+    from paddle_tpu.kernels import fused_rope_attention as fra
+    from paddle_tpu.kernels.rope import build_rope_cache
+
+    # 1. candidate generators: every emitted config is legal; shapes
+    # with no MXU-friendly divisor yield empty (-> signalled fallback)
+    for cfg in autotune.flash_block_candidates(2048, 2048):
+        assert autotune.flash_config_legal(2048, 2048, cfg), cfg
+    for cfg in autotune.flash_block_candidates(2176, 2176):
+        assert autotune.flash_config_legal(2176, 2176, cfg), cfg
+    assert autotune.flash_block_candidates(2050, 2050) == []
+    for cfg in autotune.rope_attention_candidates(96):
+        assert autotune.rope_attention_config_legal(96, cfg), cfg
+    for cfg in autotune.norm_matmul_candidates(16, 256):
+        assert autotune.norm_matmul_config_legal(16, 256, cfg), cfg
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "tune_cache.json")
+        # 2. measured search over the tiny CPU specs writes the cache
+        # (catalog pinned to the CPU one so the step-3 verification
+        # below matches even when the smoke runs on a TPU host)
+        smoke_specs = standard_specs(False)
+        rec = run_tune(cache_path=path, specs=smoke_specs,
+                       iters=1, windows=1)
+        assert rec["shapes_measured"] == rec["shapes"] > 0, rec
+        assert os.path.exists(path), "cache file not written"
+
+        # 3. a FRESH cache object reads the entries back; every config
+        # is legal for its shape
+        cache = autotune.TuneCache(path)
+        keys = cache.keys()
+        assert len(keys) == rec["shapes"], (keys, rec["shapes"])
+        for kernel, spec in smoke_specs:
+            sig, _ = _sig_and_candidates(kernel, spec)
+            cfg = cache.lookup(kernel, sig, count=False)
+            assert cfg is not None, f"no entry for {kernel}|{sig}"
+            if kernel == "rope_attention":
+                assert autotune.rope_attention_config_legal(
+                    spec["s"], cfg), cfg
+            else:
+                assert autotune.norm_matmul_config_legal(
+                    spec["rows"], spec["n_out"], cfg), cfg
+
+        # 4. second run: 100% cache hits, zero re-measurements
+        rec2 = run_tune(cache_path=path, specs=smoke_specs,
+                        iters=1, windows=1)
+        assert rec2["cache_hits"] == rec2["shapes"], rec2
+        assert rec2["shapes_measured"] == 0, rec2
+
+    # 5. parity: fused == composed (jitted, bit-exact) for both kernels
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 64, 2, 16), jnp.float32)
+    cos, sin = build_rope_cache(64, 16)
+    f = jax.jit(lambda a: fra.rope_attention_fused(a, a, a, cos, sin,
+                                                   block_q=16))(q)
+    c = jax.jit(lambda a: fra.rope_attention_composed(a, a, a, cos,
+                                                      sin))(q)
+    assert (np.asarray(f) == np.asarray(c)).all(), "rope_attention parity"
+    x = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    w = jnp.asarray(rng.randn(64), jnp.float32)
+    wm = jnp.asarray(rng.randn(64, 256), jnp.float32)
+    f2 = jax.jit(lambda a: fnm.rms_norm_matmul(a, w, wm, block_rows=8,
+                                               block_cols=128))(x)
+    c2 = jax.jit(lambda a: fnm.rms_norm_matmul_composed(a, w, wm))(x)
+    assert (np.asarray(f2) == np.asarray(c2)).all(), "norm_matmul parity"
+    print("tune-smoke OK: generators legal, cache round-trips, "
+          "re-run is 100% hits with 0 measurements, parity holds")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CPU-safe machinery gate (make tune-smoke)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--cache", default=None,
+                    help="cache file (default: PADDLE_TPU_TUNE_CACHE or "
+                         "tools/kernel_tune_cache.json)")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--windows", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    rec = run_tune(cache_path=args.cache, iters=args.iters,
+                   windows=args.windows)
+    if args.json:
+        print(json.dumps(rec, indent=1))
+    else:
+        for row in rec["results"]:
+            state = ("HIT " if row.get("cache_hit")
+                     else "SKIP" if row["config"] is None else "TUNE")
+            extra = ""
+            if row.get("composed_median_ms") is not None:
+                extra = (f"  composed={row['composed_median_ms']}ms "
+                         f"fused_wins={row['fused_beats_composed']}")
+            print(f"{state} {row['kernel']}|{row['sig']} -> "
+                  f"{row['config']}{extra}")
+        print(f"{rec['shapes']} shape(s): {rec['cache_hits']} cache "
+              f"hit(s), {rec['shapes_measured']} measured "
+              f"(cache: {rec['cache_path']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
