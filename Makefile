@@ -5,7 +5,7 @@ PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
-	tune-smoke
+	tune-smoke serve-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -47,6 +47,14 @@ ckpt-smoke:
 # kernels hold bit-exact parity vs their composed references.
 tune-smoke:
 	$(ENV) $(PY) tools/kernel_tune.py --smoke
+
+# Serving gate: HTTP/SSE front-end on an ephemeral port over the paged
+# engine — N concurrent streams must be token-exact vs net.generate,
+# the page pool must drain to zero, shed requests must end their open
+# streams with a terminal error event, and /metrics must parse with
+# nonzero wire-TTFT series.
+serve-smoke:
+	$(ENV) $(PY) tools/serve_smoke.py
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

@@ -248,7 +248,14 @@ class Predictor:
         backpressure/deadlines/metrics and runs them in batches of the
         artifact's fixed batch size. (A saved program is one
         shape-specialized whole-decode computation, so true continuous
-        batching needs the live net — ``serving.ServingEngine``.)"""
+        batching needs the live net — ``serving.ServingEngine``.)
+
+        ``paged=True`` accounts the artifact's KV residency through the
+        serving page pool (claim while a batch is in flight, zero-leak
+        when idle — same surface as ``PagedServingEngine``) and, via
+        the per-token streaming callbacks every engine now carries,
+        lets saved artifacts sit behind the HTTP/SSE front-end without
+        code changes."""
         from ..serving import StaticBatchEngine
 
         return StaticBatchEngine(self, **kwargs)

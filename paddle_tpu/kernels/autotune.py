@@ -90,6 +90,12 @@ def norm_matmul_sig(rows, hidden, n_out):
     return f"r{rows}_h{hidden}_n{n_out}"
 
 
+def paged_attention_sig(b, pages, page_size, h, kvh, d):
+    """Paged decode attention: B decode rows, a [B, pages] page table
+    over page_size-token pages, H query heads over kvh KV heads."""
+    return f"b{b}_p{pages}_ps{page_size}_h{h}_kv{kvh}_d{d}"
+
+
 def cache_key(kernel, sig, device=None):
     return f"{kernel}|{sig}|{device or device_kind()}"
 
@@ -406,10 +412,30 @@ def norm_matmul_config_legal(rows, n_out, config):
     return (br >= 1 and bc >= 1 and rows % br == 0 and n_out % bc == 0)
 
 
+def paged_attention_candidates(kv_heads):
+    """``block_kvh`` candidates for the paged decode attention kernel:
+    KV heads handled per grid step. Larger blocks amortize the per-page
+    table-indexed loads across more heads; smaller blocks bound the
+    per-step VMEM footprint (the gathered V scratch is
+    ``[block_kvh * group, S_virtual, D]`` fp32). Only divisors of the
+    model's KV-head count are legal."""
+    return [{"block_kvh": b}
+            for b in _divisors(kv_heads, (8, 4, 2, 1))]
+
+
+def paged_attention_config_legal(kv_heads, config):
+    try:
+        bk = int(config["block_kvh"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    return bk >= 1 and kv_heads % bk == 0
+
+
 CANDIDATE_GENERATORS = {
     "flash_attention": flash_block_candidates,
     "rope_attention": rope_attention_candidates,
     "rms_norm_matmul": norm_matmul_candidates,
+    "paged_attention": paged_attention_candidates,
 }
 
 

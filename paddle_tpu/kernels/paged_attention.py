@@ -1,0 +1,317 @@
+"""Paged decode attention — Pallas TPU kernel over a block/page KV pool.
+
+The serving engine's paged decode keeps K/V in a page arena
+(``[num_pages, page_size, kvH, D]`` per layer) and addresses each
+request's cache through a per-row page table (``[B, pages]`` int32,
+page id 0 = the reserved garbage page for unallocated tail entries).
+The composed path materializes the gathered cache
+(``k_pages[page_table]`` -> ``[B, pages * page_size, kvH, D]``) in HBM
+every decode step; this kernel gathers page blocks straight into VMEM
+through a scalar-prefetched page table (the classic paged-attention
+structure: the table is available before the kernel body runs, so the
+BlockSpec index_map can pull the right page per grid step).
+
+Shape contract: q is ``[B, 1, H, D]`` (one decode token per row),
+k_pages/v_pages ``[N, page_size, kvH, D]``, page_table ``[B, P]``
+int32, pos ``[B]`` int32 (tokens already cached per row; the row
+attends cache slots ``[0, pos]`` inclusive — the slot written this
+step included).
+
+Bit-exactness discipline (the PR 6 fusion-kernel contract): the kernel
+assembles the FULL score row and the FULL gathered V in VMEM scratch
+page by page — each score element is one dot over D, and the output is
+ONE dot over the assembled S_virtual — the exact-softmax structure
+(never online-rescaled), so its math is the composed order: score dot
+-> +mask -> fp32 softmax -> value dot. Two reference functions:
+
+- :func:`paged_attention_reference` mirrors the kernel's blocked dots
+  op-for-op (pure jnp) and is pinned EXACTLY EQUAL to the kernel in CI
+  (the PR 6 parity discipline; the kernel is also invariant in its
+  ``block_kvh`` knob).
+- :func:`paged_attention_composed` is the gather+SDPA formulation the
+  serving engine's DEFAULT paged path runs (op order of ``_sdpa_ref``,
+  which the slab engine also decodes through — that identity is what
+  keeps default paged token streams exact-equal to ``net.generate``).
+  Kernel vs composed agree to float rounding (XLA picks different
+  dot microkernels for the two shapes; the parity test bounds it at
+  fp32 epsilon), which is why kernel activation stays a measured,
+  opt-in decision rather than a default.
+
+Selection is tune-cache OPT-IN (:func:`paged_attention_select`): with
+no measured entry for the exact (shape, device) signature the engine
+keeps the composed gather path byte-identical; ``tools/kernel_tune.py``
+measures and records entries. The tunable is ``block_kvh`` — KV heads
+per grid step (``autotune.paged_attention_candidates``).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .autotune import interpret_mode as _interpret
+
+
+def gather_pages(pages, page_table):
+    """``[N, ps, kvH, D]`` arena + ``[B, P]`` table ->
+    ``[B, P * ps, kvH, D]`` logical cache (HBM-materializing composed
+    gather; the kernel's whole reason to exist is skipping this copy)."""
+    b, p = page_table.shape
+    n, ps, kvh, d = pages.shape
+    return pages[page_table].reshape(b, p * ps, kvh, d)
+
+
+def _softmax_rows(s):
+    """fp32 row softmax, op-for-op ``jax.nn.softmax`` (max-subtract,
+    exp, sum-normalize) — masked -inf columns contribute exactly 0."""
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+def paged_attention_composed(q, k_pages, v_pages, page_table, pos,
+                             scale=None):
+    """Composed reference: gather the paged cache and attend — the same
+    op order ``nn.functional.scaled_dot_product_attention``'s composed
+    body (``_sdpa_ref``) runs for the slab engine, so the paged engine's
+    default path and the slab engine round identically.
+
+    q ``[B, 1, H, D]``; returns ``[B, 1, H, D]`` in q's dtype."""
+    b, sq, h, d = (int(x) for x in q.shape)
+    kvh = int(k_pages.shape[2])
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    kk = gather_pages(k_pages, page_table)   # [B, S_virt, kvH, D]
+    vv = gather_pages(v_pages, page_table)
+    if kvh != h:
+        rep = h // kvh
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    s_virt = int(kk.shape[1])
+    # [B, H, sq, S_virt] score + position mask, then _sdpa_ref's order
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(kk, 1, 2)
+    vt = jnp.swapaxes(vv, 1, 2)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    valid = jnp.arange(s_virt)[None, None, None, :] \
+        <= pos[:, None, None, None]
+    s = s + jnp.where(valid, 0.0, -jnp.inf)
+    p = _softmax_rows(s.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, pos,
+                              scale=None):
+    """Pure-jnp mirror of the kernel's blocked math (per-row, per
+    kv-head, per-page dots assembled into a full score row + gathered V,
+    ONE softmax, ONE value dot). Pinned bit-identical to
+    :func:`paged_attention_fused` in CI. Loop-based — a verification
+    reference, not a serving path."""
+    b, sq, h, d = (int(x) for x in q.shape)
+    kvh = int(k_pages.shape[2])
+    ps = int(k_pages.shape[1])
+    pages = int(page_table.shape[1])
+    group = h // kvh
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s_virt = pages * ps
+    rows = []
+    for bi in range(b):
+        heads = []
+        for j in range(kvh):
+            qg = q[bi, 0].reshape(kvh, group, d)[j].astype(jnp.float32)
+            srow, vrow = [], []
+            for p in range(pages):
+                kpage = k_pages[page_table[bi, p], :, j]
+                kg = jnp.repeat(
+                    kpage[:, None, :].astype(jnp.float32), group, axis=1
+                )
+                s = jax.lax.dot_general(
+                    qg, jnp.swapaxes(kg, 0, 1),
+                    (((1,), (2,)), ((0,), (0,))),
+                ) * scale
+                srow.append(s)
+                vpage = jnp.repeat(
+                    v_pages[page_table[bi, p], :, j][:, None, :]
+                    .astype(jnp.float32), group, axis=1,
+                )
+                vrow.append(vpage.reshape(ps, -1))
+            sfull = jnp.concatenate(srow, axis=1)         # [G, S_virt]
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (group, s_virt), 1
+            )
+            sm = sfull + jnp.where(cols <= pos[bi], 0.0, -jnp.inf)
+            prob = _softmax_rows(sm).astype(q.dtype).astype(jnp.float32)
+            vall = jnp.concatenate(vrow, axis=0).reshape(s_virt, group,
+                                                         d)
+            o = jax.lax.dot_general(
+                prob, jnp.swapaxes(vall, 0, 1),
+                (((1,), (1,)), ((0,), (0,))),
+            )
+            heads.append(o)
+        rows.append(jnp.concatenate(heads, axis=0))
+    return jnp.stack(rows)[:, None].astype(q.dtype)
+
+
+def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  s_scratch, v_scratch, *, scale, page_size, pages,
+                  group, out_dtype):
+    """Grid (B, kvH / block_kvh, P): step p assembles page p's score
+    columns and V rows into scratch; the LAST page step softmaxes the
+    full row and emits the output block.
+
+    q_ref ``[1, G, D]`` (G = block_kvh * group query heads),
+    k_ref/v_ref ``[1, ps, bkvh, D]`` — one table-indexed page block."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                 # [G, D]
+    bkvh = k_ref.shape[2]
+    # GQA: repeat the page's KV heads up to the query-head group, in
+    # kv-head-major order to match jnp.repeat(kk, rep, axis=2)
+    k = k_ref[0].astype(jnp.float32)                    # [ps, bkvh, D]
+    k = jnp.repeat(k, group, axis=1)                    # [ps, G, D]
+    v = v_ref[0].astype(jnp.float32)
+    v = jnp.repeat(v, group, axis=1)
+    # score columns for this page: one dot over D per element — the
+    # same dot_general contraction the composed einsum lowers to
+    s = jax.lax.dot_general(
+        q, jnp.swapaxes(k, 0, 1),                       # [G, ps, D]
+        (((1,), (2,)), ((0,), (0,))),                   # d-with-d, G batched
+    ) * scale                                           # [G, ps]
+    s_scratch[:, pl.ds(p * page_size, page_size)] = s
+    v_scratch[pl.ds(p * page_size, page_size), :] = \
+        v.reshape(page_size, -1)                        # [ps, G*D]
+
+    @pl.when(p == pages - 1)
+    def _emit():
+        s_virt = pages * page_size
+        g = q.shape[0]
+        d = q.shape[1]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (g, s_virt), 1)
+        mask = jnp.where(cols <= pos_ref[b], 0.0, -jnp.inf)
+        sm = s_scratch[...] + mask
+        prob = _softmax_rows(sm).astype(out_dtype).astype(jnp.float32)
+        # ONE dot over the assembled S_virt — same reduction the
+        # composed value einsum performs
+        vall = v_scratch[...].reshape(s_virt, g, d)     # [S, G, D]
+        out = jax.lax.dot_general(
+            prob, jnp.swapaxes(vall, 0, 1),             # [G, S, D]
+            (((1,), (1,)), ((0,), (0,))),
+        )                                               # [G, D]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def paged_attention_fused(q, k_pages, v_pages, page_table, pos,
+                          scale=None, block_kvh=1):
+    """Pallas paged decode attention. Shapes per the module docstring;
+    ``block_kvh`` KV heads are processed per grid step (tuned knob)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = (int(x) for x in q.shape)
+    if sq != 1:
+        raise ValueError(
+            f"paged attention is the decode step: one token per row "
+            f"(q [B, 1, H, D]), got S={sq}"
+        )
+    n, ps, kvh, dk = (int(x) for x in k_pages.shape)
+    if dk != d:
+        raise ValueError(f"head_dim mismatch: q D={d}, pages D={dk}")
+    if h % kvh:
+        raise ValueError(f"H={h} not a multiple of kvH={kvh}")
+    if kvh % int(block_kvh):
+        raise ValueError(f"block_kvh={block_kvh} does not divide "
+                         f"kvH={kvh}")
+    pages = int(page_table.shape[1])
+    group = h // kvh
+    bkvh = int(block_kvh)
+    g = bkvh * group                 # query heads per grid step
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s_virt = pages * ps
+    # q in kv-head-major layout so a kv-head block's query heads are
+    # contiguous: [B, kvH, group, D] -> [B, kvH/bkvh, g, D]
+    qh = q.reshape(b, 1, kvh, group, d)[:, 0].reshape(b, kvh // bkvh,
+                                                      g, d)
+    table = page_table.astype(jnp.int32)
+    posv = pos.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # (page_table, pos)
+        grid=(b, kvh // bkvh, pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda i, j, p, tbl, ps_: (i, j, 0, 0)),
+            pl.BlockSpec((1, ps, bkvh, d),
+                         lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j, 0)),
+            pl.BlockSpec((1, ps, bkvh, d),
+                         lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda i, j, p, tbl, ps_: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, s_virt), jnp.float32),
+            pltpu.VMEM((s_virt, g * d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=float(scale), page_size=ps,
+            pages=pages, group=group, out_dtype=q.dtype,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh // bkvh, g, d), q.dtype),
+        interpret=_interpret(),
+    )(table, posv, qh, k_pages, v_pages)
+    # [B, kvH/bkvh, g, D] -> [B, 1, H, D]
+    return out.reshape(b, 1, h, d)
+
+
+def paged_attention_select(b, pages, page_size, h, kvh, d):
+    """Tune-cache OPT-IN selection: the kernel's config when a measured
+    entry exists for this exact shape on this device, else None (the
+    engine keeps the composed gather path byte-identical). Stale cached
+    configs are counted, one-shot-warned fallbacks; a measured
+    composed-wins verdict is honored as a policy decision."""
+    from . import autotune
+
+    sig = autotune.paged_attention_sig(b, pages, page_size, h, kvh, d)
+    entry = autotune.lookup_entry("paged_attention", sig)
+    if entry is None:
+        return None
+    cfg = dict(entry["config"])
+    if not autotune.paged_attention_config_legal(kvh, cfg):
+        autotune.note_fallback("paged_attention", sig, "stale-config",
+                               detail=f"cached {cfg} illegal for "
+                                      f"kvH={kvh}")
+        return None
+    if entry.get("fused_beats_composed") is False:
+        autotune.note_selection("paged_attention", "composed:measured")
+        return None
+    autotune.note_selection("paged_attention", "fused:cached")
+    return cfg
+
+
+def _apply_fn(qv, kv, vv, tbl, posv, *, scale, block_kvh):
+    return paged_attention_fused(qv, kv, vv, tbl, posv, scale=scale,
+                                 block_kvh=block_kvh)
+
+
+def paged_attention_apply(q, k_pages, v_pages, page_table, pos, *,
+                          config, scale=None):
+    """Tensor-level entry for model code (decode is a no-grad path, so
+    no VJP is registered — ``nondiff`` keeps the tape clean)."""
+    from ..core import dispatch
+
+    return dispatch.apply(
+        "paged_attention", _apply_fn,
+        (q, k_pages, v_pages, page_table, pos),
+        {"scale": scale,
+         "block_kvh": int(config.get("block_kvh", 1))},
+        nondiff=True,
+    )
+
+

@@ -97,16 +97,21 @@ def prefill(net, ids, caches, length=None):
     return row, caches
 
 
-def decode_step(net, tok, caches, pos):
+def decode_step(net, tok, caches, pos, page_table=None):
     """One KV-cache decode step — the reusable hot-loop body shared by
     the whole-decode scan below and ``serving.ServingEngine``'s compiled
     step program. ``tok`` [B, 1] int32; ``pos`` is a scalar (whole-batch
     decode) or an int32 [B] vector (continuous batching: every row sits
-    at its own depth). Cache-dtype-aware: writes cast to the cache's
-    dtype, reads upcast at the matmul. Returns (logits [B, V], caches).
-    """
+    at its own depth). With ``page_table`` ([B, P] int32) the caches are
+    per-layer PAGE ARENAS and attention runs through the table — the
+    paged serving engine's step. Cache-dtype-aware: writes cast to the
+    cache's dtype, reads upcast at the matmul. Returns
+    (logits [B, V], caches)."""
+    # only forward the kwarg when paging: other causal LMs served
+    # through generate() (gpt_moe etc.) don't take page_table
+    kw = {} if page_table is None else {"page_table": page_table}
     with tape.trace_scope(), tape.no_grad():
-        logits, caches = net(Tensor(tok), caches=caches, pos=pos)
+        logits, caches = net(Tensor(tok), caches=caches, pos=pos, **kw)
     return logits.value[:, -1, :], caches
 
 

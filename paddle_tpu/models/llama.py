@@ -80,16 +80,26 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(config.num_attention_heads * d, h, bias_attr=False)
 
     def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None,
-                cache=None, pos=None):
+                cache=None, pos=None, page_table=None):
         """Training/eval path unchanged when ``cache is None``. With a
         ``cache=(k_cache, v_cache)`` pair ([B, S_max, kvH, D] jnp arrays)
         and a scalar ``pos`` (number of tokens already cached), the new
         keys/values are written at [pos, pos+S) and attention runs over
         the whole static cache with a position mask — the TPU decode
         pattern (static shapes, no growing tensors). Returns
-        (out, new_cache) in cache mode."""
+        (out, new_cache) in cache mode.
+
+        With ``page_table`` ([B, P] int32) the cache pair is a PAGE
+        ARENA ([num_pages, page_size, kvH, D] x2) shared by every row:
+        the step's k/v is scattered at each row's (page, offset) and
+        attention runs over the table-gathered logical cache (S must be
+        1 — the paged decode step). Page id 0 is the reserved garbage
+        page; a tuned Pallas paged-attention kernel replaces the
+        HBM-materializing gather when the tune cache selects one."""
         cfg = self.cfg
         B, S = int(x.shape[0]), int(x.shape[1])
+        if page_table is not None and cache is None:
+            raise ValueError("page_table requires a page-arena cache")
         q = self.q_proj(x).reshape([B, S, cfg.num_attention_heads, cfg.head_dim])
         k = self.k_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
         v = self.v_proj(x).reshape([B, S, cfg.kv_heads, cfg.head_dim])
@@ -122,6 +132,81 @@ class LlamaAttention(nn.Layer):
             q, k, None, sin=rope_sin, cos=rope_cos,
             position_ids=pos_ids, rotary_emb_base=cfg.rope_theta,
         )
+        if cache is not None and page_table is not None:
+            if S != 1:
+                raise ValueError(
+                    f"paged decode feeds one token per row (S == 1), "
+                    f"got S={S}"
+                )
+            from ..kernels import autotune
+            from ..kernels.paged_attention import (
+                gather_pages,
+                paged_attention_apply,
+                paged_attention_select,
+            )
+
+            k_pages, v_pages = cache
+            tbl = jnp.asarray(
+                page_table.value if hasattr(page_table, "value")
+                else page_table
+            )
+            ps = int(k_pages.shape[1])
+            P = int(tbl.shape[1])
+            p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
+            # scatter this step's k/v at each row's (page, offset);
+            # free rows land on the reserved garbage page 0
+            pp = jnp.take_along_axis(tbl, (p // ps)[:, None],
+                                     axis=1)[:, 0]
+            po = p % ps
+            k_pages = k_pages.at[pp, po].set(
+                k.value[:, 0].astype(k_pages.dtype)
+            )
+            v_pages = v_pages.at[pp, po].set(
+                v.value[:, 0].astype(v_pages.dtype)
+            )
+            # the fused kernel bakes in pure positional masking — an
+            # explicit attn_mask must decode through the composed path
+            sel = None if attn_mask is not None else (
+                paged_attention_select(
+                    B, P, ps, cfg.num_attention_heads, cfg.kv_heads,
+                    cfg.head_dim,
+                )
+            )
+            if sel is not None:
+                out = paged_attention_apply(
+                    q, k_pages, v_pages, tbl, p, config=sel
+                )
+                return (
+                    self.o_proj(out.reshape([B, S, -1])),
+                    (k_pages, v_pages),
+                )
+            # default: composed gather + the SAME masked-SDPA the slab
+            # per-row branch below decodes through — token streams stay
+            # bit-identical to the slab engine and net.generate (extra
+            # masked columns contribute exact zeros)
+            autotune.note_selection("paged_attention", "composed:gather")
+            kk = Tensor(gather_pages(k_pages, tbl))
+            vv = Tensor(gather_pages(v_pages, tbl))
+            S_virt = P * ps
+            if cfg.kv_heads != cfg.num_attention_heads:
+                rep = cfg.num_attention_heads // cfg.kv_heads
+                kk = kk.repeat_interleave(rep, axis=2)
+                vv = vv.repeat_interleave(rep, axis=2)
+            cols = p[:, None] + jnp.arange(S)[None, :]
+            valid = jnp.arange(S_virt)[None, None, :] <= cols[:, :, None]
+            mask = jnp.where(valid, 0.0, -jnp.inf)[:, None, :, :]
+            if attn_mask is not None:
+                am = (attn_mask.value if hasattr(attn_mask, "value")
+                      else jnp.asarray(attn_mask))
+                mask = mask + am
+            out = F.scaled_dot_product_attention(
+                q, kk, vv, attn_mask=Tensor(mask), is_causal=False,
+                training=False,
+            )
+            return (
+                self.o_proj(out.reshape([B, S, -1])),
+                (k_pages, v_pages),
+            )
         if cache is not None:
             k_cache, v_cache = cache
             S_max = k_cache.shape[1]
@@ -212,11 +297,11 @@ class LlamaDecoderLayer(nn.Layer):
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, rope_cos=None, rope_sin=None, attn_mask=None,
-                cache=None, pos=None):
+                cache=None, pos=None, page_table=None):
         if cache is not None:
             a, new_cache = self.self_attn(
                 self.input_layernorm(x), rope_cos, rope_sin, attn_mask,
-                cache=cache, pos=pos,
+                cache=cache, pos=pos, page_table=page_table,
             )
             h = x + a
             return h + self.mlp(self.post_attention_layernorm(h)), new_cache
@@ -237,9 +322,12 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, attn_mask=None, caches=None, pos=None,
-                apply_final_norm=True):
+                apply_final_norm=True, page_table=None):
         """``caches``: list of per-layer (k_cache, v_cache) for decode
         (returns (hidden, new_caches)); None for the training path.
+        With ``page_table`` the caches are per-layer page arenas
+        ([num_pages, page_size, kvH, D] x2) and decode attention runs
+        through the table (serving's paged KV pool).
         ``apply_final_norm=False`` returns the pre-norm hidden state so
         a fused norm+matmul head can absorb ``self.norm``."""
         cfg = self.config
@@ -247,7 +335,13 @@ class LlamaModel(nn.Layer):
         from ..kernels.rope import build_rope_cache
 
         if caches is not None:
-            S_max = caches[0][0].shape[1]
+            if page_table is not None:
+                # logical capacity: the rope table must cover every
+                # addressable position, pages * page_size
+                S_max = (int(page_table.shape[1])
+                         * int(caches[0][0].shape[1]))
+            else:
+                S_max = caches[0][0].shape[1]
             cos, sin = build_rope_cache(
                 S_max, cfg.head_dim, base=cfg.rope_theta
             )
@@ -263,7 +357,8 @@ class LlamaModel(nn.Layer):
             new_caches = []
             for layer, cache in zip(self.layers, caches):
                 h, c2 = layer(h, cos_t, sin_t, attn_mask,
-                              cache=cache, pos=pos)
+                              cache=cache, pos=pos,
+                              page_table=page_table)
                 new_caches.append(c2)
             return (self.norm(h) if apply_final_norm else h), new_caches
         cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
@@ -323,13 +418,14 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
             block_rows=sel["block_rows"], block_cols=sel["block_cols"],
         )
 
-    def forward(self, input_ids, attn_mask=None, caches=None, pos=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, pos=None,
+                page_table=None):
         B, S = int(input_ids.shape[0]), int(input_ids.shape[1])
         sel = self._head_fusion(B * S)
         if caches is not None:
             h, new_caches = self.model(
                 input_ids, attn_mask, caches=caches, pos=pos,
-                apply_final_norm=sel is None,
+                apply_final_norm=sel is None, page_table=page_table,
             )
             if sel is not None:
                 logits = self._fused_head(h, sel)

@@ -8,12 +8,33 @@ priority+FIFO admission with backpressure and deadlines
 (``scheduler.Scheduler``), and serving metrics exported through
 ``paddle_tpu.profiler`` (``metrics.ServingMetrics``). Saved
 ``jit.save`` decode artifacts serve through the same request surface
-via ``inference.Predictor.into_engine()``. Everything is pure
-Python + JAX and CPU-testable; ``tools/serve_bench.py`` replays a
-synthetic Poisson trace offline and reports throughput/latency
-percentiles.
+via ``inference.Predictor.into_engine()``.
+
+The paged runtime (``paged_pool.PagedKVPool`` +
+``paged_engine.PagedServingEngine``) replaces the decode slab with a
+page arena: a request claims ``ceil(total_tokens / page_size)`` pages
+through a per-row page table, so resident KV HBM scales with actual
+lengths and a mixed-length workload admits strictly more concurrent
+requests at equal budget. Every engine carries per-token streaming
+callbacks (``submit(..., on_token=, on_event=)``, terminal event
+exactly once), and ``http_frontend.ServingFrontend`` puts any engine
+on a port as a stdlib-only HTTP/SSE server (POST submit -> SSE token
+stream, backpressure as HTTP status, wire-level TTFT/ITL metrics).
+
+Everything is pure Python + JAX and CPU-testable;
+``tools/serve_bench.py`` replays a synthetic Poisson trace offline
+(``--http`` drives real SSE streams over localhost) and reports
+throughput/latency percentiles; ``make serve-smoke`` gates the HTTP
+round-trip end to end.
 """
 from .engine import ServingEngine, StaticBatchEngine  # noqa: F401
+from .http_frontend import (  # noqa: F401
+    FrontendMetrics,
+    HTTPRejected,
+    ServingFrontend,
+    read_sse_events,
+    stream_generate,
+)
 from .kv_pool import (  # noqa: F401
     KVBlock,
     KVCachePool,
@@ -21,6 +42,8 @@ from .kv_pool import (  # noqa: F401
     bucket_for,
 )
 from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
+from .paged_engine import PagedServingEngine  # noqa: F401
+from .paged_pool import PagedKVPool, PagesExhausted  # noqa: F401
 from .scheduler import (  # noqa: F401
     REASON_ENGINE_CLOSED,
     REASON_QUEUE_FULL,

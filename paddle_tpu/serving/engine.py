@@ -128,13 +128,7 @@ class ServingEngine:
         self._params = {k: p.value for k, p in net.named_parameters()}
         self._buffers = {k: b.value for k, b in net.named_buffers()}
         self._was_training = net.training
-        # resident decode slab ([N, S_max] rows claimed per request)
-        self._flat = _flatten(
-            self.pool.alloc_slab_arrays(self.max_batch_size,
-                                        self.max_seq_len)
-        )
-        self._slab = self.pool.register_slab(self.max_batch_size,
-                                             self.max_seq_len)
+        self._init_kv_backend()
         self._seqs = [None] * self.max_batch_size
         self._key = jax.random.PRNGKey(seed)
         self.step_count = 0
@@ -174,6 +168,17 @@ class ServingEngine:
         self.trace_guard = TraceGuard(max_compiles=recompile_guard_max)
         self.trace_guard.on_fire(self._on_guard_fire)
         self.trace_guard.watch("serving::decode_step", self._decode_fn)
+
+    def _init_kv_backend(self):
+        """Allocate the resident decode KV state — the slab here
+        ([N, S_max] rows claimed per request); the paged engine
+        overrides with a page arena + per-row page tables."""
+        self._flat = _flatten(
+            self.pool.alloc_slab_arrays(self.max_batch_size,
+                                        self.max_seq_len)
+        )
+        self._slab = self.pool.register_slab(self.max_batch_size,
+                                             self.max_seq_len)
 
     def _on_guard_fire(self, finding):
         """A recompile storm at runtime: emit a lint-guard span so the
@@ -265,35 +270,49 @@ class ServingEngine:
         return sub
 
     # ---------------------------------------------------------- requests
+    def _too_long(self, req):
+        """Reject-at-submit gate: a request no amount of draining could
+        ever admit. Subclasses extend it with their backend's own hard
+        ceiling (e.g. the whole page arena)."""
+        return req.total_tokens > self.max_seq_len or (
+            self.max_tokens_in_flight is not None
+            and req.total_tokens > self.max_tokens_in_flight
+        )
+
     def submit(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
-               priority=0, deadline_s=None):
+               priority=0, deadline_s=None, on_token=None, on_event=None):
         """Enqueue one request; always returns a RequestHandle (status
         REJECTED with ``.reason`` set on backpressure — submit never
-        blocks and never throws for load reasons)."""
+        blocks and never throws for load reasons).
+
+        ``on_token(tok, handle)`` streams each emitted token as the
+        engine produces it; ``on_event(handle)`` fires exactly once at
+        the terminal transition (including submit-time rejects — a
+        stream consumer always gets an ending)."""
         req = Request(
             input_ids, max_new_tokens, eos_token_id=eos_token_id,
             priority=priority, deadline_s=deadline_s,
         )
         self.metrics.submitted.inc()
         if self._closed:
-            h = RequestHandle(req)
+            h = RequestHandle(req, on_token=on_token, on_event=on_event)
             h.submit_time = h.finish_time = self.clock()
             h.status = REJECTED
             h.reason = REASON_ENGINE_CLOSED
             self.metrics.rejected.inc(label=REASON_ENGINE_CLOSED)
+            h._fire_terminal()
             return h
-        if req.total_tokens > self.max_seq_len or (
-            self.max_tokens_in_flight is not None
-            and req.total_tokens > self.max_tokens_in_flight
-        ):
-            h = RequestHandle(req)
+        if self._too_long(req):
+            h = RequestHandle(req, on_token=on_token, on_event=on_event)
             h.submit_time = h.finish_time = self.clock()
             h.status = REJECTED
             h.reason = REASON_TOO_LONG
             self.metrics.rejected.inc(label=REASON_TOO_LONG)
+            h._fire_terminal()
             return h
         try:
-            return self.scheduler.submit(req)
+            return self.scheduler.submit(req, on_token=on_token,
+                                         on_event=on_event)
         except RejectedError as e:
             self.metrics.rejected.inc(label=e.reason)
             return e.handle
@@ -309,6 +328,11 @@ class ServingEngine:
             for s in self._seqs if s is not None
         )
 
+    def _release_slot(self, slot):
+        """Return slot ``slot``'s KV residency to the pool (slab row
+        here; row + claimed pages in the paged engine)."""
+        self._slab.release(slot)
+
     def _finish(self, slot, status, reason=None):
         seq = self._seqs[slot]
         h = seq.handle
@@ -323,7 +347,8 @@ class ServingEngine:
             self.metrics.timeouts.inc()
         self.metrics.e2e.observe(now - h.submit_time)
         self._seqs[slot] = None
-        self._slab.release(slot)
+        self._release_slot(slot)
+        h._fire_terminal()
 
     def _append(self, slot, tok):
         seq = self._seqs[slot]
@@ -332,6 +357,7 @@ class ServingEngine:
         seq.last_tok = int(tok)
         seq.emitted += 1
         self.metrics.tokens_out.inc()
+        h._fire_token(tok)
         req = h.request
         if req.eos_token_id is not None and int(tok) == req.eos_token_id:
             self._finish(slot, DONE)
@@ -387,11 +413,32 @@ class ServingEngine:
         self._seqs[slot] = _Seq(handle, t0)
         self._append(slot, t0)
 
+    def _decode_extra(self):
+        """Extra positional decode-step inputs between the KV state and
+        ``pos`` (the paged engine passes its page tables here)."""
+        return ()
+
+    def _has_capacity(self):
+        return self._slab.free_slots > 0
+
+    def _admission_budget(self):
+        """Token budget the next admission must fit (None = no cap).
+        The paged engine folds free-page capacity in here too."""
+        if self.max_tokens_in_flight is None:
+            return None
+        return self.max_tokens_in_flight - self._tokens_in_flight()
+
+    def _max_admissions_per_step(self):
+        """Prefills allowed per engine step. Unbounded for the slab
+        engine (its historical behavior); the paged engine caps it —
+        the prefill/decode disaggregation lever."""
+        return None
+
     def step(self):
         """One engine iteration: retire expired, admit into free slots,
-        run one decode step over the whole slab."""
+        run one decode step over the whole resident KV state."""
         if self._closed:
-            raise RuntimeError("ServingEngine is closed")
+            raise RuntimeError(f"{type(self).__name__} is closed")
         now = self.clock()
         # running sequences past their deadline free their slot NOW
         for i, seq in enumerate(self._seqs):
@@ -402,14 +449,12 @@ class ServingEngine:
                 self._finish(i, TIMEOUT, reason=REASON_TIMEOUT)
         # queued requests whose deadline passed never run at all
         self.scheduler.sweep_expired()
-        # admission: fill free slots in priority-FIFO order under the
-        # in-flight token cap
-        while self._slab.free_slots > 0:
-            budget = None
-            if self.max_tokens_in_flight is not None:
-                budget = (self.max_tokens_in_flight
-                          - self._tokens_in_flight())
-            handle = self.scheduler.pop_next(budget)
+        # admission: fill free capacity in priority-FIFO order under the
+        # in-flight token cap (and the per-step prefill cap, when set)
+        cap = self._max_admissions_per_step()
+        admitted = 0
+        while self._has_capacity() and (cap is None or admitted < cap):
+            handle = self.scheduler.pop_next(self._admission_budget())
             if handle is None:
                 break
             try:
@@ -422,41 +467,47 @@ class ServingEngine:
                 handle.reason = f"admission_error:{type(e).__name__}"
                 handle.finish_time = self.clock()
                 self.metrics.rejected.inc(label="admission_error")
+                handle._fire_terminal()
                 raise
+            admitted += 1
         # single metrics channel for queued-expiry, whether the sweep or
         # a lazy pop_next expired the request (a deadline can pass
         # mid-step while a prefill compiles)
         for _ in self.scheduler.drain_timed_out():
             self.metrics.timeouts.inc()
-        # one fused decode step over every row (free rows are masked
-        # garbage; their writes land on slots adoption overwrites)
-        active = [i for i, s in enumerate(self._seqs) if s is not None]
-        if active:
-            tok = np.zeros((self.max_batch_size,), np.int32)
-            pos = np.zeros((self.max_batch_size,), np.int32)
-            for i in active:
-                tok[i] = self._seqs[i].last_tok
-                pos[i] = self._seqs[i].pos
-            t0 = self.clock()
-            with profiler.RecordEvent("serving::decode_step"):
-                nxt, self._flat = self._run(
-                    ("decode",), self._decode_fn,
-                    self._params, self._buffers, jnp.asarray(tok),
-                    self._flat, jnp.asarray(pos),
-                    jnp.float32(self.temperature), self._next_key(),
-                )
-                nxt = np.asarray(nxt)
-            dt = self.clock() - t0
-            for i in active:
-                if self._seqs[i] is None:
-                    continue  # finished by an earlier row this step
-                self.metrics.itl.observe(dt)
-                self._append(i, nxt[i])
+        self._decode_once()
         self.step_count += 1
         # poll jit-internal compile caches (decode shape drift is
         # invisible to the bucket maps above); fires _on_guard_fire
         self.trace_guard.check()
         self.metrics.observe_step(self.scheduler.depth, self.active_slots)
+
+    def _decode_once(self):
+        """One fused decode step over every row (free rows are masked
+        garbage; their writes land on slots adoption overwrites)."""
+        active = [i for i, s in enumerate(self._seqs) if s is not None]
+        if not active:
+            return
+        tok = np.zeros((self.max_batch_size,), np.int32)
+        pos = np.zeros((self.max_batch_size,), np.int32)
+        for i in active:
+            tok[i] = self._seqs[i].last_tok
+            pos[i] = self._seqs[i].pos
+        t0 = self.clock()
+        with profiler.RecordEvent("serving::decode_step"):
+            nxt, self._flat = self._run(
+                ("decode",), self._decode_fn,
+                self._params, self._buffers, jnp.asarray(tok),
+                self._flat, *self._decode_extra(), jnp.asarray(pos),
+                jnp.float32(self.temperature), self._next_key(),
+            )
+            nxt = np.asarray(nxt)
+        dt = self.clock() - t0
+        for i in active:
+            if self._seqs[i] is None:
+                continue  # finished by an earlier row this step
+            self.metrics.itl.observe(dt)
+            self._append(i, nxt[i])
 
     def run_until_idle(self, max_steps=100_000):
         """Drive ``step()`` until queue and slab are empty."""
@@ -495,6 +546,7 @@ class ServingEngine:
             h.status = CANCELLED
             h.reason = REASON_ENGINE_CLOSED
             h.finish_time = self.clock()
+            h._fire_terminal()
         for _ in self.scheduler.drain_timed_out():
             self.metrics.timeouts.inc()
         for i, seq in enumerate(self._seqs):
@@ -506,7 +558,8 @@ class ServingEngine:
             h.finish_time = self.clock()
             h.finished_step = self.step_count
             self._seqs[i] = None
-            self._slab.release(i)
+            self._release_slot(i)
+            h._fire_terminal()
         self._flat = None
         self._decode_fn = None
         # the guard's watch entry holds the jitted callable too — drop
@@ -526,7 +579,8 @@ class StaticBatchEngine:
     same metrics. Built by ``Predictor.into_engine()``."""
 
     def __init__(self, predictor, *, max_queue_size=64, scheduler=None,
-                 metrics=None, clock=time.monotonic):
+                 metrics=None, clock=time.monotonic, paged=False,
+                 page_size=16):
         specs = getattr(predictor, "_input_specs", None)
         if not specs:
             raise ValueError(
@@ -546,20 +600,32 @@ class StaticBatchEngine:
             max_queue_size=max_queue_size, clock=clock
         )
         self.metrics = metrics or ServingMetrics()
+        # paged residency accounting: the saved program's internal KV
+        # span ([B, S_total]) flows through the same page-pool surface
+        # the live paged engine uses (claim while a batch is in flight,
+        # zero-leak when idle). The pool is sized on the first run — the
+        # artifact only reveals S_total through its output shape.
+        self._paged = bool(paged)
+        self._page_size = int(page_size)
+        self.page_pool = None
+        self._total_len = None
 
-    def submit(self, input_ids, *, priority=0, deadline_s=None):
+    def submit(self, input_ids, *, priority=0, deadline_s=None,
+               on_token=None, on_event=None):
         req = Request(input_ids, 1, priority=priority,
                       deadline_s=deadline_s)
         self.metrics.submitted.inc()
         if req.prompt_len != self.prompt_len:
-            h = RequestHandle(req)
+            h = RequestHandle(req, on_token=on_token, on_event=on_event)
             h.submit_time = h.finish_time = self.clock()
             h.status = REJECTED
             h.reason = REASON_SHAPE_MISMATCH
             self.metrics.rejected.inc(label=REASON_SHAPE_MISMATCH)
+            h._fire_terminal()
             return h
         try:
-            return self.scheduler.submit(req)
+            return self.scheduler.submit(req, on_token=on_token,
+                                         on_event=on_event)
         except RejectedError as e:
             self.metrics.rejected.inc(label=e.reason)
             return e.handle
@@ -583,14 +649,39 @@ class StaticBatchEngine:
                  for i in range(self.batch_size)]
             ).astype(np.int32)
             t0 = self.clock()
+            claim = None
+            if self._paged and self.page_pool is not None:
+                claim = self.page_pool.claim(
+                    self.batch_size
+                    * self.page_pool.pages_for(self._total_len)
+                )
             self.predictor.get_input_handle(name).copy_from_cpu(ids)
-            self.predictor.run()
-            out = self.predictor.get_output_handle(
-                self.predictor.get_output_names()[0]
-            ).copy_to_cpu()
+            try:
+                self.predictor.run()
+                out = self.predictor.get_output_handle(
+                    self.predictor.get_output_names()[0]
+                ).copy_to_cpu()
+            finally:
+                if claim is not None:
+                    self.page_pool.release(claim)
             dt = self.clock() - t0
             now = self.clock()
             new = out.shape[1] - self.prompt_len
+            if self._paged and self.page_pool is None:
+                # first run revealed S_total: size the pool to the
+                # artifact's exact KV span and account this run's claim
+                # retroactively (claims/releases counters still tally)
+                from .paged_pool import PagedKVPool
+
+                self._total_len = int(out.shape[1])
+                pool = PagedKVPool(
+                    None, page_size=self._page_size,
+                    num_pages=self.batch_size
+                    * -(-self._total_len // self._page_size),
+                    max_seq_len=self._total_len,
+                )
+                pool.release(pool.claim(pool.num_pages))
+                self.page_pool = pool
             for i, h in enumerate(batch):
                 h.tokens = [int(t) for t in out[i, self.prompt_len:]]
                 h.status = DONE
@@ -606,6 +697,9 @@ class StaticBatchEngine:
                 if new > 1:
                     self.metrics.itl.observe(dt / new)
                 self.metrics.e2e.observe(now - h.submit_time)
+                for t in h.tokens:
+                    h._fire_token(t)
+                h._fire_terminal()
             self.metrics.observe_step(self.scheduler.depth, len(batch))
         for _ in self.scheduler.drain_timed_out():
             self.metrics.timeouts.inc()

@@ -1,0 +1,523 @@
+"""Streaming HTTP/SSE front-end over a serving engine — stdlib only.
+
+The network surface the serving stack was missing: POST a request, get
+the tokens back as a Server-Sent-Events stream while the engine
+decodes. Built on the same ``http.server`` seam as
+``observability.exporter.MetricsServer`` — no third-party server, one
+import to put a model on a port.
+
+Endpoints:
+
+- ``POST /v1/generate`` — body ``{"input_ids": [...],
+  "max_new_tokens": N, "eos_token_id"?, "priority"?, "deadline_s"?,
+  "stream"? (default true)}``. Streaming responses are
+  ``text/event-stream``::
+
+      event: token
+      data: {"index": 0, "token": 17}
+
+      event: done
+      data: {"status": "DONE", "tokens": [...], ...}
+
+  A request that ends any other way — queue-bound deadline, engine
+  close, slow consumer — ends the stream with a TERMINAL ``event:
+  error`` carrying the machine-readable reason (never a silent hang;
+  ``paddle_serving_stream_aborts_total{reason}`` counts each).
+  Backpressure surfaces as HTTP status BEFORE the stream opens:
+  429 queue_full, 413 too_long, 400 malformed/shape_mismatch,
+  503 engine_closed. ``"stream": false`` blocks and returns one JSON
+  body instead.
+- ``GET /metrics`` — the process Prometheus exposition (wire-level
+  TTFT/ITL land here as ``paddle_serving_wire_{ttft,itl}_seconds``,
+  measured at write() time — queueing, serialization and socket
+  included, the latency a user actually sees).
+- ``GET /healthz`` — engine/pool/queue stats as JSON.
+
+Threading model: the engine is NOT thread-safe, so exactly one driver
+thread steps it; HTTP handler threads only (a) submit under the
+frontend lock and (b) consume their request's event queue, which the
+engine's per-token callbacks feed from the driver thread. A slow or
+disconnected client therefore can never stall the decode loop — its
+stream is aborted and counted instead.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import math
+import queue
+import threading
+import time
+
+from ..observability import get_registry
+from ..observability.exporter import prometheus_text
+from .metrics import Counter, Histogram
+
+# terminal abort reasons surfaced on streams (engine REASON_* strings
+# pass through verbatim; these are the frontend-originated ones)
+ABORT_CLIENT_DISCONNECT = "client_disconnect"
+ABORT_STREAM_STALL = "stream_stall"
+ABORT_FRONTEND_STOPPED = "frontend_stopped"
+
+_STATUS_FOR_REASON = {
+    "queue_full": 429,
+    "too_long": 413,
+    "shape_mismatch": 400,
+    "engine_closed": 503,
+}
+
+
+class FrontendMetrics:
+    """Wire-level series, one instance per frontend (replace-on-register
+    in the process registry, like ServingMetrics)."""
+
+    def __init__(self, registry=None, namespace="paddle_serving"):
+        ns = namespace
+        self.wire_ttft = Histogram(
+            "wire_ttft", prom_name=f"{ns}_wire_ttft_seconds",
+            help="request-received to first token byte written")
+        self.wire_itl = Histogram(
+            "wire_itl", prom_name=f"{ns}_wire_itl_seconds",
+            help="gap between consecutive token writes on one stream")
+        self.stream_aborts = Counter(
+            "stream_aborts", labelname="reason",
+            prom_name=f"{ns}_stream_aborts_total",
+            help="streams ended by a terminal error event, by reason")
+        self.http_requests = Counter(
+            "http_requests", labelname="code",
+            prom_name=f"{ns}_http_requests_total",
+            help="front-end HTTP responses, by status code")
+        reg = registry or get_registry()
+        reg.register_all([
+            self.wire_ttft, self.wire_itl, self.stream_aborts,
+            self.http_requests,
+        ])
+
+
+class ServingFrontend:
+    """HTTP/SSE front-end driving one engine on a background thread.
+
+    ``port=0`` binds an ephemeral port (read ``.port`` back). Works with
+    :class:`~.engine.ServingEngine`, :class:`~.paged_engine.
+    PagedServingEngine` and :class:`~.engine.StaticBatchEngine` — any
+    engine with the submit/streaming-callback surface. The driver
+    thread steps live engines; a StaticBatchEngine (batch-at-once saved
+    artifact) is driven through ``run_until_idle`` per drained queue.
+    """
+
+    def __init__(self, engine, host="127.0.0.1", port=0, registry=None,
+                 stream_timeout_s=120.0):
+        self.engine = engine
+        self.host = host
+        self.port = int(port)
+        self.metrics = FrontendMetrics(registry=registry)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._httpd = None
+        self._http_thread = None
+        self._driver_thread = None
+        # (time, repr) of swallowed step errors — bounded so a
+        # persistently failing step cannot grow memory without limit.
+        self.driver_errors = collections.deque(maxlen=256)
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        import http.server
+
+        fe = self
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True  # SSE handlers must not pin shutdown
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                fe._handle_get(self)
+
+            def do_POST(self):
+                fe._handle_post(self)
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-serve-http",
+            daemon=True,
+        )
+        self._http_thread.start()
+        self._driver_thread = threading.Thread(
+            target=self._drive, name="paddle-serve-driver", daemon=True,
+        )
+        self._driver_thread.start()
+        return self
+
+    def stop(self, close_engine=False):
+        """Stop serving. Open streams get a terminal
+        ``frontend_stopped``/engine-close error event rather than a
+        hang (``close_engine=True`` cancels in-flight requests, which
+        fires their terminal callbacks)."""
+        self._stop.set()
+        if close_engine:
+            with self._lock:
+                try:
+                    self.engine.close()
+                except Exception:
+                    pass
+        if self._driver_thread is not None:
+            self._driver_thread.join(timeout=10)
+            self._driver_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10)
+            self._http_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------- driver
+    def _engine_busy(self):
+        depth = getattr(self.engine.scheduler, "depth", 0)
+        active = getattr(self.engine, "active_slots", 0)
+        return bool(depth or active)
+
+    def _drive(self):
+        stepper = getattr(self.engine, "step", None)
+        while not self._stop.is_set():
+            busy = False
+            errored = False
+            with self._lock:
+                if self._engine_busy() and not getattr(
+                    self.engine, "_closed", False
+                ):
+                    busy = True
+                    try:
+                        if stepper is not None:
+                            stepper()
+                        else:  # StaticBatchEngine: batch-at-once
+                            self.engine.run_until_idle()
+                    except Exception as e:  # a failed admission already
+                        # resolved its handle; the loop must survive
+                        errored = True
+                        self.driver_errors.append(
+                            (time.monotonic(), repr(e))
+                        )
+            if errored:
+                # Back off: a persistently failing step() must not spin
+                # a core at full speed while it keeps failing.
+                time.sleep(0.005)
+            elif not busy:
+                time.sleep(0.001)
+
+    # ----------------------------------------------------------- handlers
+    def _send_json(self, h, code, obj):
+        data = json.dumps(obj, default=str).encode("utf-8")
+        h.send_response(code)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)
+        self.metrics.http_requests.inc(label=str(code))
+
+    def _handle_get(self, h):
+        path = h.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = prometheus_text().encode("utf-8")
+                h.send_response(200)
+                h.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+                self.metrics.http_requests.inc(label="200")
+            elif path == "/healthz":
+                self._send_json(h, 200, self.health())
+            else:
+                self._send_json(h, 404, {"error": "not found"})
+        except Exception as e:
+            try:
+                self._send_json(h, 500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def health(self):
+        eng = self.engine
+        out = {
+            "queue_depth": getattr(eng.scheduler, "depth", 0),
+            "active": getattr(eng, "active_slots", 0),
+            "closed": bool(getattr(eng, "_closed", False)),
+            "engine": type(eng).__name__,
+        }
+        pool = getattr(eng, "pool", None)
+        if pool is not None:
+            out["pool"] = pool.stats()
+        page_pool = getattr(eng, "page_pool", None)
+        if page_pool is not None:
+            out["page_pool"] = page_pool.stats()
+        return out
+
+    def _handle_post(self, h):
+        path = h.path.split("?", 1)[0]
+        if path != "/v1/generate":
+            self._send_json(h, 404, {"error": "not found"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(n) or b"{}")
+            ids = body["input_ids"]
+            if not isinstance(ids, list) or not ids or not all(
+                isinstance(t, int) for t in ids
+            ):
+                raise ValueError(
+                    "input_ids must be a non-empty list of ints"
+                )
+            # Every optional field is coerced HERE so a malformed value
+            # is a 400 on this request — a raw string deadline_s reaching
+            # the scheduler heap would poison sweep_expired for everyone.
+            kwargs = {}
+            for k in ("eos_token_id", "priority"):
+                if body.get(k) is not None:
+                    kwargs[k] = int(body[k])
+            if body.get("deadline_s") is not None:
+                deadline_s = float(body["deadline_s"])
+                if not math.isfinite(deadline_s) or deadline_s < 0:
+                    raise ValueError(
+                        "deadline_s must be a non-negative finite number"
+                    )
+                kwargs["deadline_s"] = deadline_s
+            max_new = None
+            if body.get("max_new_tokens") is not None:
+                max_new = int(body["max_new_tokens"])
+                if max_new < 1:
+                    raise ValueError("max_new_tokens must be >= 1")
+        except Exception as e:
+            self._send_json(h, 400, {"error": f"bad request: {e}"})
+            return
+        stream = bool(body.get("stream", True))
+        events = queue.Queue()  # bounded by max_new_tokens + 1
+
+        def on_token(tok, handle):
+            events.put(("token", tok))
+
+        def on_event(handle):
+            events.put(("end", handle))
+
+        submit_args = ([[int(t) for t in ids]],)
+        if max_new is not None and hasattr(self.engine, "max_seq_len"):
+            submit_args = submit_args + (max_new,)
+        t_recv = time.monotonic()
+        try:
+            with self._lock:
+                handle = self.engine.submit(
+                    *submit_args, on_token=on_token, on_event=on_event,
+                    **kwargs,
+                )
+        except TypeError as e:
+            # a field the wrapped engine doesn't take (StaticBatchEngine
+            # has no eos_token_id) is the client's problem — 400, never
+            # a dropped connection
+            self._send_json(h, 400, {"error": f"bad request: {e}"})
+            return
+        except Exception as e:
+            self._send_json(h, 500, {"error": repr(e)})
+            return
+        if handle.status == "REJECTED":
+            code = _STATUS_FOR_REASON.get(handle.reason, 400)
+            self._send_json(
+                h, code,
+                {"error": "rejected", "reason": handle.reason},
+            )
+            return
+        if stream:
+            self._stream_response(h, handle, events, t_recv)
+        else:
+            self._blocking_response(h, handle, events)
+
+    def _terminal_payload(self, handle):
+        return {
+            "status": handle.status,
+            "reason": handle.reason,
+            "tokens": list(handle.tokens),
+            "prompt_len": handle.request.prompt_len,
+            "ttft_s": handle.ttft,
+        }
+
+    def _blocking_response(self, h, handle, events):
+        deadline = time.monotonic() + self.stream_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                kind, payload = events.get(timeout=1.0)
+            except queue.Empty:
+                if self._stop.is_set():
+                    break
+                continue
+            if kind == "end":
+                p = self._terminal_payload(handle)
+                code = 200 if handle.status == "DONE" else (
+                    _STATUS_FOR_REASON.get(handle.reason, 500)
+                )
+                # no stream_aborts sample here: stream_aborts counts SSE
+                # streams ended by a terminal error event, and a
+                # "stream": false request never opened one — the outcome
+                # is fully visible in the HTTP status
+                self._send_json(h, code, p)
+                return
+        reason = (ABORT_FRONTEND_STOPPED if self._stop.is_set()
+                  else ABORT_STREAM_STALL)
+        self._send_json(h, 504, {"error": reason})
+
+    def _stream_response(self, h, handle, events, t_recv):
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")
+        h.end_headers()
+        self.metrics.http_requests.inc(label="200")
+
+        def write_event(event, payload):
+            h.wfile.write(
+                f"event: {event}\ndata: {json.dumps(payload)}\n\n"
+                .encode("utf-8")
+            )
+            h.wfile.flush()
+
+        idx = 0
+        last_write = None
+        counted_abort = False
+        # poll in short slices so frontend stop() ends open streams
+        # promptly instead of after a full stream_timeout_s of silence
+        stall_at = time.monotonic() + self.stream_timeout_s
+        try:
+            while True:
+                try:
+                    kind, payload = events.get(timeout=0.25)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        reason = ABORT_FRONTEND_STOPPED
+                    elif time.monotonic() >= stall_at:
+                        reason = ABORT_STREAM_STALL
+                    else:
+                        continue
+                    counted_abort = True
+                    self.metrics.stream_aborts.inc(label=reason)
+                    write_event("error", {"reason": reason,
+                                          "status": handle.status})
+                    return
+                stall_at = time.monotonic() + self.stream_timeout_s
+                if kind == "token":
+                    write_event("token", {"index": idx,
+                                          "token": int(payload)})
+                    now = time.monotonic()
+                    if idx == 0:
+                        self.metrics.wire_ttft.observe(now - t_recv)
+                    elif last_write is not None:
+                        self.metrics.wire_itl.observe(now - last_write)
+                    last_write = now
+                    idx += 1
+                else:  # terminal — exactly once by the handle contract
+                    p = self._terminal_payload(handle)
+                    if handle.status == "DONE":
+                        write_event("done", p)
+                    else:
+                        # the satellite fix: shed/expired requests END
+                        # the open stream with the reject reason instead
+                        # of hanging it
+                        counted_abort = True
+                        self.metrics.stream_aborts.inc(
+                            label=handle.reason
+                            or handle.status.lower()
+                        )
+                        write_event("error", p)
+                    return
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # an abort counted just before its error-event write failed
+            # must not produce a second client_disconnect sample
+            if not counted_abort:
+                self.metrics.stream_aborts.inc(
+                    label=ABORT_CLIENT_DISCONNECT
+                )
+
+
+# --------------------------------------------------------- client helpers
+def read_sse_events(fp):
+    """Parse an SSE byte stream (a ``http.client`` response file) into
+    ``(event, data_dict)`` pairs — the client half the bench, the smoke
+    gate and the tests share."""
+    event, data = None, []
+    for raw in fp:
+        line = raw.decode("utf-8").rstrip("\n")
+        if not line:
+            if event is not None:
+                yield event, json.loads("\n".join(data) or "null")
+            event, data = None, []
+            continue
+        if line.startswith(":"):
+            continue  # comment/keepalive
+        if line.startswith("event:"):
+            event = line[6:].strip()
+        elif line.startswith("data:"):
+            data.append(line[5:].strip())
+    if event is not None and data:
+        yield event, json.loads("\n".join(data))
+
+
+def stream_generate(host, port, payload, timeout=300.0):
+    """POST ``payload`` to ``/v1/generate`` and consume the SSE stream.
+
+    Returns ``(events, timings)`` where ``events`` is the parsed
+    ``(event, data)`` list and ``timings`` carries client-measured
+    ``ttft_s`` / per-gap ``itl_s`` (wire latency as the CLIENT sees it —
+    serve_bench reports these next to the engine's in-process numbers).
+    Raises ``HTTPRejected`` with ``.code``/``.body`` on a non-200."""
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    t0 = time.monotonic()
+    conn.request(
+        "POST", "/v1/generate", body=json.dumps(payload),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    if resp.status != 200:
+        body = resp.read().decode("utf-8", "replace")
+        conn.close()
+        err = HTTPRejected(f"HTTP {resp.status}: {body}")
+        err.code = resp.status
+        try:
+            err.body = json.loads(body)
+        except Exception:
+            err.body = {"raw": body}
+        raise err
+    events, itl, ttft, last = [], [], None, None
+    for event, data in read_sse_events(resp):
+        now = time.monotonic()
+        if event == "token":
+            if ttft is None:
+                ttft = now - t0
+            elif last is not None:
+                itl.append(now - last)
+            last = now
+        events.append((event, data))
+        if event in ("done", "error"):
+            break
+    conn.close()
+    return events, {"ttft_s": ttft, "itl_s": itl}
+
+
+class HTTPRejected(RuntimeError):
+    """Non-200 response from the front-end; ``.code`` and ``.body``."""

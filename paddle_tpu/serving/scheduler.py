@@ -79,9 +79,18 @@ class Request:
 
 class RequestHandle:
     """The caller's view of a submitted request: status, tokens, and
-    per-request timing, filled in as the engine progresses."""
+    per-request timing, filled in as the engine progresses.
 
-    def __init__(self, request):
+    Streaming surface: ``on_token(tok, handle)`` fires per emitted
+    token, ``on_event(handle)`` fires EXACTLY ONCE when the handle
+    reaches a terminal state (DONE/REJECTED/TIMEOUT/CANCELLED) — from
+    wherever the transition happens: decode, submit-time reject, lazy
+    queue expiry, or ``engine.close()``. That single-fire guarantee is
+    what lets an SSE stream end with a terminal event instead of a
+    silent hang when its request is shed. Callback exceptions are
+    swallowed (a broken consumer must never wedge the engine loop)."""
+
+    def __init__(self, request, on_token=None, on_event=None):
         self.request = request
         self.status = QUEUED
         self.reason = None          # set for REJECTED / TIMEOUT
@@ -92,10 +101,32 @@ class RequestHandle:
         self.first_token_time = None
         self.admitted_step = None   # engine step index at admission
         self.finished_step = None
+        self.on_token = on_token
+        self.on_event = on_event
+        self._terminal_fired = False
 
     @property
     def finished(self):
         return self.status in (DONE, REJECTED, TIMEOUT, CANCELLED)
+
+    def _fire_token(self, tok):
+        if self.on_token is not None:
+            try:
+                self.on_token(int(tok), self)
+            except Exception:
+                pass
+
+    def _fire_terminal(self):
+        """Notify the terminal transition exactly once (idempotent —
+        every status-setting site calls this defensively)."""
+        if self._terminal_fired:
+            return
+        self._terminal_fired = True
+        if self.on_event is not None:
+            try:
+                self.on_event(self)
+            except Exception:
+                pass
 
     @property
     def output_ids(self):
@@ -142,15 +173,19 @@ class Scheduler:
     def depth(self):
         return len(self._heap)
 
-    def submit(self, request):
+    def submit(self, request, on_token=None, on_event=None):
         """Enqueue; returns a RequestHandle. Raises RejectedError when
-        the queue is full (bounded-queue backpressure)."""
-        handle = RequestHandle(request)
+        the queue is full (bounded-queue backpressure). Callbacks are
+        attached BEFORE the bound check so a queue-full reject still
+        fires the terminal event (no silent SSE hang)."""
+        handle = RequestHandle(request, on_token=on_token,
+                               on_event=on_event)
         handle.submit_time = self.clock()
         if len(self._heap) >= self.max_queue_size:
             handle.status = REJECTED
             handle.reason = REASON_QUEUE_FULL
             handle.finish_time = handle.submit_time
+            handle._fire_terminal()
             err = RejectedError(
                 REASON_QUEUE_FULL,
                 f"queue holds {len(self._heap)}/{self.max_queue_size}",
@@ -166,6 +201,7 @@ class Scheduler:
         handle.status = TIMEOUT
         handle.reason = REASON_TIMEOUT
         handle.finish_time = now
+        handle._fire_terminal()
         self._timed_out.append(handle)
 
     def drain_timed_out(self):

@@ -493,3 +493,176 @@ def test_run_tune_second_run_is_all_hits(tmp_cache):
                     windows=1)
     assert rec2["shapes_measured"] == 0 and rec2["cache_hits"] == 1
     assert rec2["cache_hit_rate"] == 1.0
+
+
+# ---------------------------------------------------- paged decode attention
+
+
+def _paged_fixture(dtype=jnp.float32, kvh=2, h=4):
+    from paddle_tpu.kernels import paged_attention as pa  # noqa: F401
+
+    rng = np.random.RandomState(5)
+    b, pages, ps, d = 2, 4, 8, 16
+    n = b * pages + 1
+    q = jnp.asarray(rng.randn(b, 1, h, d), dtype)
+    kp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
+    vp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
+    tbl = jnp.asarray(1 + np.arange(b * pages).reshape(b, pages),
+                      jnp.int32)
+    pos = jnp.asarray([13, 27], jnp.int32)
+    return q, kp, vp, tbl, pos
+
+
+def test_paged_candidates_legal_and_sig():
+    for cfg in at.paged_attention_candidates(8):
+        assert at.paged_attention_config_legal(8, cfg), cfg
+    assert {c["block_kvh"] for c in at.paged_attention_candidates(8)} \
+        == {8, 4, 2, 1}
+    assert not at.paged_attention_config_legal(8, {"block_kvh": 3})
+    assert not at.paged_attention_config_legal(8, {})
+    s = at.paged_attention_sig(2, 4, 8, 4, 2, 16)
+    assert s == "b2_p4_ps8_h4_kv2_d16"
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_paged_kernel_bitexact_vs_reference(dtype):
+    """The kernel contract: bit-identical to its blocked reference
+    under jit, and invariant in the block_kvh tuning knob (GQA group
+    repeat included)."""
+    from paddle_tpu.kernels import paged_attention as pa
+
+    q, kp, vp, tbl, pos = _paged_fixture(dtype)
+    ref = pa.paged_attention_reference(q, kp, vp, tbl, pos)
+    outs = [
+        jax.jit(lambda a, k_, v_: pa.paged_attention_fused(
+            a, k_, v_, tbl, pos, block_kvh=bk))(q, kp, vp)
+        for bk in (1, 2)
+    ]
+    for out in outs:
+        assert out.dtype == q.dtype
+        assert (np.asarray(out, np.float32)
+                == np.asarray(ref, np.float32)).all()
+    # composed gather formulation agrees to float rounding (different
+    # dot shapes -> different XLA microkernels; why engine activation
+    # is opt-in, not default)
+    comp = pa.paged_attention_composed(q, kp, vp, tbl, pos)
+    np.testing.assert_allclose(
+        np.asarray(comp, np.float32), np.asarray(ref, np.float32),
+        rtol=3e-2 if dtype == jnp.bfloat16 else 1e-5,
+        atol=3e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_paged_selection_cache_optin(tmp_cache):
+    """No entry -> composed (counted); entry -> fused config; measured
+    composed-win -> refused; stale/illegal entry -> signalled
+    fallback."""
+    from paddle_tpu.kernels import paged_attention as pa
+
+    sig = at.paged_attention_sig(2, 4, 8, 4, 2, 16)
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16) is None
+
+    at.get_cache().record("paged_attention", sig, {"block_kvh": 2},
+                          save=False)
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16) == {
+        "block_kvh": 2}
+    sel = at.selection_counter().series()
+    assert sel.get((("kernel", "paged_attention"),
+                    ("path", "fused:cached")), 0) >= 1
+
+    at.get_cache().record(
+        "paged_attention", sig, {"block_kvh": 2},
+        extra={"fused_beats_composed": False}, save=False,
+    )
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16) is None
+    sel = at.selection_counter().series()
+    assert sel.get((("kernel", "paged_attention"),
+                    ("path", "composed:measured")), 0) >= 1
+
+    at.get_cache().record("paged_attention", sig, {"block_kvh": 3},
+                          save=False)  # illegal for kvh=2
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16) is None
+    fb = at.fallback_counter().series()
+    assert any(
+        dict(k).get("kernel") == "paged_attention"
+        and dict(k).get("reason") == "stale-config"
+        for k in fb
+    )
+
+
+def test_paged_entry_activates_llama_decode_path(tmp_cache):
+    """Model-level: with a tune-cache entry for the engine's exact
+    decode shape, the llama paged branch routes through the Pallas
+    kernel (selection counted) and the decode logits stay numerically
+    equivalent to the composed gather path."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import tape
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import alloc_kv_caches, prefill
+
+    paddle.seed(3)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    rng = np.random.RandomState(1)
+    B, L, ps, P = 2, 6, 8, 4
+    ids = rng.randint(0, 64, (B, L)).astype(np.int32)
+    N = B * P + 1
+    arena = [
+        (jnp.zeros((N, ps, cfg.kv_heads, cfg.head_dim), jnp.bfloat16),
+         jnp.zeros((N, ps, cfg.kv_heads, cfg.head_dim), jnp.bfloat16))
+        for _ in range(cfg.num_hidden_layers)
+    ]
+    tbl = jnp.asarray(1 + np.arange(B * P).reshape(B, P), jnp.int32)
+    for r in range(B):
+        blk = alloc_kv_caches(cfg, 1, P * ps, "bfloat16")
+        _, blk = prefill(net, jnp.asarray(ids[r:r + 1]), blk)
+        for li, (kb, vb) in enumerate(blk):
+            ka, va = arena[li]
+            rows = np.asarray(tbl[r])
+            ka = ka.at[rows].set(
+                kb[0].reshape(P, ps, cfg.kv_heads, cfg.head_dim))
+            va = va.at[rows].set(
+                vb[0].reshape(P, ps, cfg.kv_heads, cfg.head_dim))
+            arena[li] = (ka, va)
+    tok = jnp.asarray(ids[:, -1])
+    pos = jnp.full((B,), L, jnp.int32)
+
+    def decode(caches):
+        with tape.trace_scope(), tape.no_grad():
+            lg, caches = net(Tensor(tok[:, None]), caches=caches,
+                             pos=pos, page_table=tbl)
+        return np.asarray(lg.value[:, -1, :], np.float32), caches
+
+    base, _ = decode(arena)  # no entry: composed gather path
+    at.get_cache().record(
+        "paged_attention",
+        at.paged_attention_sig(B, P, ps, cfg.num_attention_heads,
+                               cfg.kv_heads, cfg.head_dim),
+        {"block_kvh": 1}, save=False,
+    )
+    sel_before = at.selection_counter().series()
+    fused, _ = decode(arena)
+    sel_after = at.selection_counter().series()
+    k = (("kernel", "paged_attention"), ("path", "fused:cached"))
+    assert sel_after.get(k, 0) - sel_before.get(k, 0) >= 1
+
+    # an explicit attn_mask must bypass the fused kernel (it bakes in
+    # pure positional masking) and take the composed path — with a
+    # zeros mask the logits stay equal to the no-entry baseline
+    def decode_masked(caches):
+        am = jnp.zeros((B, 1, 1, P * ps), jnp.float32)
+        with tape.trace_scope(), tape.no_grad():
+            lg, caches = net(Tensor(tok[:, None]), attn_mask=Tensor(am),
+                             caches=caches, pos=pos, page_table=tbl)
+        return np.asarray(lg.value[:, -1, :], np.float32)
+
+    sel_before = at.selection_counter().series()
+    masked = decode_masked(arena)
+    sel_after = at.selection_counter().series()
+    assert sel_after.get(k, 0) == sel_before.get(k, 0)  # no fused pick
+    np.testing.assert_array_equal(masked, base)
+    np.testing.assert_allclose(fused, base, rtol=2e-4, atol=2e-4)

@@ -19,6 +19,9 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.serving import (
     KVCachePool,
+    PagedKVPool,
+    PagedServingEngine,
+    PagesExhausted,
     REASON_QUEUE_FULL,
     REASON_SHAPE_MISMATCH,
     REASON_TIMEOUT,
@@ -26,8 +29,10 @@ from paddle_tpu.serving import (
     Request,
     Scheduler,
     ServingEngine,
+    ServingFrontend,
     ServingMetrics,
     bucket_for,
+    stream_generate,
 )
 
 RNG = np.random.RandomState(7)
@@ -391,3 +396,370 @@ def test_engine_close_cancels_and_releases(net):
     assert h3.status == "REJECTED" and h3.reason == "engine_closed"
     with pytest.raises(RuntimeError, match="closed"):
         eng.step()
+
+
+# ----------------------------------------------------------- paged pool
+def test_paged_pool_claim_release_accounting(net):
+    pool = PagedKVPool(net.config, page_size=8, num_pages=6,
+                       max_seq_len=48)
+    assert pool.pages_for(1) == 1
+    assert pool.pages_for(8) == 1
+    assert pool.pages_for(9) == 2
+    assert pool.table_width() == 6
+    a = pool.claim(2)
+    b = pool.claim(3)
+    assert 0 not in a + b  # page 0 is the reserved garbage page
+    assert pool.pages_in_use == 5 and pool.free_pages == 1
+    with pytest.raises(PagesExhausted):
+        pool.claim(2)
+    assert pool.exhausted_events == 1
+    assert pool.pages_in_use == 5  # failed claim claims nothing
+    pool.release(a)
+    with pytest.raises(ValueError, match="double release|not claimed"):
+        pool.release(a)
+    pool.release(b)
+    assert pool.pages_in_use == 0
+    s = pool.stats()
+    assert s["claims"] == 5 and s["releases"] == 5
+    assert s["page_bytes"] > 0
+    assert s["arena_bytes"] == 7 * s["page_bytes"]  # +1 garbage page
+    with pytest.raises(ValueError, match="power of two"):
+        PagedKVPool(net.config, page_size=6, num_pages=4)
+
+
+# ---------------------------------------------------------- paged engine
+def test_paged_engine_exact_vs_slab_and_generate(net):
+    """The tentpole pin: paged continuous batching (2 rows, 4 staggered
+    requests, pages claimed per-length) produces token streams
+    exact-equal to BOTH the slab engine and standalone net.generate —
+    on the CPU 8-device virtual mesh, like every serving test."""
+    import jax
+
+    assert jax.device_count() == 8  # the virtual mesh conftest forces
+    prompts = [RNG.randint(0, 64, (1, L)) for L in (6, 5, 7, 9)]
+    max_news = [3, 9, 6, 8]
+
+    slab = ServingEngine(net, max_batch_size=2, max_seq_len=64,
+                         min_bucket=8)
+    hs = [slab.submit(p, m) for p, m in zip(prompts, max_news)]
+    slab.run_until_idle()
+
+    paged = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                               min_bucket=8, page_size=8)
+    hp = [paged.submit(p, m) for p, m in zip(prompts, max_news)]
+    paged.run_until_idle()
+
+    for h_s, h_p, p, m in zip(hs, hp, prompts, max_news):
+        assert h_s.status == "DONE" and h_p.status == "DONE"
+        want = np.asarray(net.generate(
+            Tensor(jnp.asarray(p)), max_new_tokens=m).numpy())[0]
+        np.testing.assert_array_equal(h_p.output_ids, want)
+        np.testing.assert_array_equal(h_p.output_ids, h_s.output_ids)
+    # continuous batching happened on the paged engine too
+    steps = [h.admitted_step for h in hp]
+    assert steps[2] > 0 and steps[3] > steps[2]
+    # drained: zero leaked pages, zero leaked blocks
+    assert paged.page_pool.pages_in_use == 0
+    assert paged.pool.occupancy == 0
+    st = paged.page_pool.stats()
+    assert st["claims"] == st["releases"] > 0
+
+
+def test_paged_more_concurrency_than_slab_at_equal_hbm(net):
+    """The acceptance pin: at EQUAL resident KV HBM, the paged engine
+    admits strictly more concurrent requests for a mixed-length
+    workload, because a request claims ceil(total/page) pages instead
+    of a full S_max slab row."""
+    S_max, ps = 64, 8
+    slab = ServingEngine(net, max_batch_size=2, max_seq_len=S_max,
+                         min_bucket=8)
+    # equal budget: slab = 2 rows x 64 slots = 128 token-slots; paged
+    # arena = 16 pages x 8 = 128 token-slots INCLUDING the garbage page
+    # (15 usable) — the comparison gives paged no extra bytes
+    paged = PagedServingEngine(
+        net, max_batch_size=8, max_seq_len=S_max, min_bucket=8,
+        page_size=ps, num_pages=15, max_prefills_per_step=None,
+    )
+    slab_bytes = slab.pool._bytes(S_max, rows=2)
+    assert paged.page_pool.arena_bytes() == slab_bytes
+    # mixed-length workload: total 24 tokens/request -> 3 pages each
+    prompts = [RNG.randint(0, 64, (1, 20)) for _ in range(6)]
+    hs = [slab.submit(p, 4) for p in prompts]
+    hp = [paged.submit(p, 4) for p in prompts]
+    slab.step()
+    paged.step()
+    slab_conc = slab.active_slots
+    paged_conc = paged.active_slots
+    assert slab_conc == 2          # a row each, rest queued
+    assert paged_conc == 5         # floor(15 pages / 3) concurrent
+    assert paged_conc > slab_conc  # the acceptance inequality
+    # per-admitted-request resident bytes: paged strictly smaller
+    per_req_slab = slab.pool._bytes(S_max)           # full row, always
+    per_req_paged = paged.page_pool.request_resident_bytes(24)
+    assert per_req_paged < per_req_slab
+    assert per_req_paged == 3 * paged.page_pool.page_bytes()
+    # and the speedup is not an accuracy trade: drain + exact streams
+    slab.run_until_idle()
+    paged.run_until_idle()
+    for h_s, h_p, p in zip(hs, hp, prompts):
+        want = np.asarray(net.generate(
+            Tensor(jnp.asarray(p)), max_new_tokens=4).numpy())[0]
+        np.testing.assert_array_equal(h_s.output_ids, want)
+        np.testing.assert_array_equal(h_p.output_ids, want)
+    assert paged.page_pool.pages_in_use == 0
+
+
+def test_paged_zero_leak_after_mixed_churn(net):
+    """finish + deadline-timeout + close-cancel churn: every page goes
+    back (claims == releases, in_use == 0) and the block pool drains."""
+    t = [0.0]
+    eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             clock=lambda: t[0])
+    h_done = eng.submit(RNG.randint(0, 64, (1, 6)), 2)
+    h_run = eng.submit(RNG.randint(0, 64, (1, 5)), 20)
+    h_dead = eng.submit(RNG.randint(0, 64, (1, 7)), 4, deadline_s=5.0)
+    eng.step()   # h_done admitted + finished (2 tokens in one step)
+    eng.step()   # h_run takes the row; h_dead stays queued behind it
+    eng.step()
+    assert h_done.status == "DONE"
+    t[0] = 10.0  # h_dead expires QUEUED (the single row is occupied)
+    eng.step()
+    assert h_dead.status == "TIMEOUT" and h_dead.tokens == []
+    assert h_run.status == "RUNNING"
+    eng.close()  # cancels h_run in flight
+    assert h_run.status == "CANCELLED" and h_run.tokens
+    st = eng.page_pool.stats()
+    assert st["pages_in_use"] == 0
+    assert st["claims"] == st["releases"] > 0
+    assert eng.pool.occupancy == 0
+
+
+def test_paged_prefill_decode_disaggregation(net):
+    """max_prefills_per_step=1 (default): a backlog of prompts admits
+    ONE prefill per step, and in-flight sequences keep decoding a token
+    every step — long-prompt bursts never stall the decode batch."""
+    eng = PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
+                             min_bucket=8, page_size=8)
+    handles = [eng.submit(RNG.randint(0, 64, (1, 6)), 8)
+               for _ in range(3)]
+    eng.step()
+    assert [h.status for h in handles] == ["RUNNING", "QUEUED", "QUEUED"]
+    n0 = len(handles[0].tokens)
+    eng.step()  # admits #2; #1 must STILL gain a decode token
+    assert handles[1].status == "RUNNING"
+    assert len(handles[0].tokens) == n0 + 1
+    eng.step()
+    assert handles[2].status == "RUNNING"
+    assert [h.admitted_step for h in handles] == [0, 1, 2]
+    eng.run_until_idle()
+    for h in handles:
+        assert h.status == "DONE" and len(h.tokens) == 8
+    assert eng.page_pool.pages_in_use == 0
+
+
+def test_paged_geometry_validation(net):
+    with pytest.raises(ValueError, match="power of two"):
+        PagedServingEngine(net, page_size=6, min_bucket=8,
+                           max_seq_len=48)
+    with pytest.raises(ValueError, match="min_bucket"):
+        PagedServingEngine(net, page_size=16, min_bucket=8,
+                           max_seq_len=64)
+    with pytest.raises(ValueError, match="multiple"):
+        PagedServingEngine(net, page_size=8, min_bucket=8,
+                           max_seq_len=60)
+    # page_size <= min_bucket is not enough: 8 < 12 but the bucket
+    # ladder 12/24/48 is not page-aligned — must fail at construction,
+    # not at the first adoption's reshape.
+    with pytest.raises(ValueError, match="min_bucket"):
+        PagedServingEngine(net, page_size=8, min_bucket=12,
+                           max_seq_len=48)
+
+
+def test_paged_oversized_request_rejected_at_submit(net):
+    """A request needing more pages than the whole arena can never be
+    admitted — it must be REJECTED too_long at submit, not left at the
+    head of the FIFO queue blocking every later request forever."""
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8, num_pages=4)
+    big = eng.submit(RNG.randint(0, 64, (1, 26)), 10)  # 36 tok > 32
+    assert big.status == "REJECTED"
+    assert big.reason == REASON_TOO_LONG
+    assert eng.scheduler.depth == 0      # never entered the queue
+    fits = eng.submit(RNG.randint(0, 64, (1, 20)), 12)  # 32 tok == 32
+    assert fits.status == "QUEUED"
+    eng.close()
+
+
+def test_paged_sampling_reproducible(net):
+    prompt = RNG.randint(0, 64, (1, 5))
+
+    def run():
+        eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
+                                 min_bucket=8, page_size=8,
+                                 do_sample=True, temperature=0.8,
+                                 top_k=8, seed=11)
+        h = eng.submit(prompt, 6)
+        eng.run_until_idle()
+        return h.tokens
+
+    assert run() == run()
+
+
+# ----------------------------------------------------- streaming callbacks
+def test_streaming_callbacks_token_order_and_single_terminal(net):
+    eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
+                             min_bucket=8, page_size=8)
+    seen, ends = [], []
+    h = eng.submit(RNG.randint(0, 64, (1, 6)), 5,
+                   on_token=lambda t, hd: seen.append(t),
+                   on_event=lambda hd: ends.append(hd.status))
+    eng.run_until_idle()
+    assert h.status == "DONE"
+    assert seen == h.tokens          # every token, in order
+    assert ends == ["DONE"]          # terminal fires exactly once
+
+
+def test_terminal_event_fires_on_every_shed_path(net):
+    """The satellite contract: rejects and queue-expiry NEVER leave a
+    stream consumer hanging — on_event fires at submit-reject,
+    deadline-expiry and close-cancel."""
+    t = [0.0]
+    eng = ServingEngine(net, max_batch_size=1, max_seq_len=32,
+                        min_bucket=8, max_queue_size=1,
+                        clock=lambda: t[0])
+    ends = {}
+
+    def ender(key):
+        return lambda hd: ends.setdefault(key, []).append(
+            (hd.status, hd.reason)
+        )
+
+    # submit-time reject (too long)
+    h1 = eng.submit(RNG.randint(0, 64, (1, 30)), 8,
+                    on_event=ender("too_long"))
+    assert h1.status == "REJECTED"
+    assert ends["too_long"] == [("REJECTED", REASON_TOO_LONG)]
+    # queue-full reject
+    eng.submit(RNG.randint(0, 64, (1, 5)), 4)  # fills the queue
+    h2 = eng.submit(RNG.randint(0, 64, (1, 5)), 4,
+                    on_event=ender("full"))
+    assert ends["full"] == [("REJECTED", REASON_QUEUE_FULL)]
+    # deadline expiry while queued
+    eng2 = ServingEngine(net, max_batch_size=1, max_seq_len=64,
+                         min_bucket=8, clock=lambda: t[0])
+    eng2.submit(RNG.randint(0, 64, (1, 6)), 8)
+    h3 = eng2.submit(RNG.randint(0, 64, (1, 6)), 4, deadline_s=5.0,
+                     on_event=ender("dead"))
+    eng2.step()
+    t[0] = 10.0
+    eng2.step()
+    assert h3.status == "TIMEOUT"
+    assert ends["dead"] == [("TIMEOUT", REASON_TIMEOUT)]
+    # close-cancel of an in-flight request
+    h4 = eng2.scheduler.pop_next()  # none queued; submit + run one
+    eng3 = ServingEngine(net, max_batch_size=1, max_seq_len=64,
+                         min_bucket=8)
+    h5 = eng3.submit(RNG.randint(0, 64, (1, 5)), 8,
+                     on_event=ender("closed"))
+    eng3.step()
+    eng3.close()
+    assert h5.status == "CANCELLED"
+    assert ends["closed"] == [("CANCELLED", "engine_closed")]
+    assert h4 is None
+
+
+# ------------------------------------------------------- HTTP/SSE frontend
+@pytest.fixture(scope="module")
+def frontend(net):
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8)
+    fe = ServingFrontend(eng).start()
+    yield fe
+    fe.stop(close_engine=True)
+
+
+def test_http_sse_stream_exact(net, frontend):
+    """POST -> SSE stream: token events in order, terminal done event,
+    tokens exact-equal net.generate, wire metrics recorded."""
+    p = RNG.randint(0, 64, (1, 6))
+    events, tm = stream_generate(
+        "127.0.0.1", frontend.port,
+        {"input_ids": [int(t) for t in p[0]], "max_new_tokens": 5},
+    )
+    toks = [d["token"] for e, d in events if e == "token"]
+    want = np.asarray(net.generate(
+        Tensor(jnp.asarray(p)), max_new_tokens=5).numpy())[0][6:]
+    assert toks == [int(t) for t in want]
+    kind, data = events[-1]
+    assert kind == "done" and data["status"] == "DONE"
+    assert data["tokens"] == toks
+    assert [d["index"] for e, d in events if e == "token"] == list(
+        range(5)
+    )
+    assert tm["ttft_s"] > 0
+    assert frontend.metrics.wire_ttft.count >= 1
+
+
+def test_http_reject_statuses_and_health(net, frontend):
+    from paddle_tpu.serving import HTTPRejected
+
+    # too-long -> 413 with machine-readable reason, no stream opened
+    with pytest.raises(HTTPRejected) as ei:
+        stream_generate("127.0.0.1", frontend.port,
+                        {"input_ids": [1] * 60, "max_new_tokens": 30})
+    assert ei.value.code == 413
+    assert ei.value.body["reason"] == REASON_TOO_LONG
+    # malformed body -> 400
+    with pytest.raises(HTTPRejected) as ei:
+        stream_generate("127.0.0.1", frontend.port,
+                        {"input_ids": "nope"})
+    assert ei.value.code == 400
+    # malformed OPTIONAL fields are 400s too — a raw string deadline_s
+    # reaching the scheduler heap would poison sweep_expired for every
+    # later request (the engine would never decode again).
+    for bad in ({"deadline_s": "soon"}, {"deadline_s": -1},
+                {"max_new_tokens": 0}, {"priority": [1]}):
+        with pytest.raises(HTTPRejected) as ei:
+            stream_generate(
+                "127.0.0.1", frontend.port,
+                {"input_ids": [1, 2, 3], "max_new_tokens": 2, **bad},
+            )
+        assert ei.value.code == 400, bad
+    # and the engine still serves a well-formed request afterwards
+    p = RNG.randint(0, 64, (1, 4))
+    events, _ = stream_generate(
+        "127.0.0.1", frontend.port,
+        {"input_ids": [int(t) for t in p[0]], "max_new_tokens": 3},
+    )
+    assert events[-1][0] == "done" and events[-1][1]["status"] == "DONE"
+    # healthz reports pool state
+    import http.client
+    import json as _json
+
+    conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                      timeout=60)
+    conn.request("GET", "/healthz")
+    hz = _json.loads(conn.getresponse().read())
+    conn.close()
+    assert hz["engine"] == "PagedServingEngine"
+    assert hz["page_pool"]["pages_in_use"] == 0
+
+
+def test_http_expired_stream_gets_terminal_error_event(net, frontend):
+    """A queued request whose deadline passes while its SSE stream is
+    open ends with `event: error` carrying the reject reason — and the
+    abort counter gains a {reason=timeout} sample."""
+    before = frontend.metrics.stream_aborts.by_label().get("timeout", 0)
+    p = RNG.randint(0, 64, (1, 6))
+    events, _ = stream_generate(
+        "127.0.0.1", frontend.port,
+        {"input_ids": [int(t) for t in p[0]], "max_new_tokens": 4,
+         "deadline_s": 0.0},
+    )
+    kind, data = events[-1]
+    assert kind == "error"
+    assert data["reason"] == REASON_TIMEOUT
+    assert data["status"] == "TIMEOUT"
+    after = frontend.metrics.stream_aborts.by_label().get("timeout", 0)
+    assert after == before + 1

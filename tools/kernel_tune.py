@@ -67,10 +67,18 @@ def standard_specs(on_tpu):
             # serving decode head: one token per resident slot
             ("rms_norm_matmul",
              {"rows": 8, "hidden": 2048, "n_out": 32000}),
+            # paged serving decode: 32 rows over an S=2048 logical
+            # window of 16-token pages (flagship head geometry)
+            ("paged_attention",
+             {"b": 32, "pages": 128, "page_size": 16, "h": 16,
+              "kvh": 16, "d": 128}),
         ]
     return [
         ("rope_attention", {"b": 2, "s": 64, "h": 2, "d": 16}),
         ("rms_norm_matmul", {"rows": 16, "hidden": 64, "n_out": 256}),
+        ("paged_attention",
+         {"b": 2, "pages": 4, "page_size": 8, "h": 4, "kvh": 2,
+          "d": 16}),
     ]
 
 
@@ -93,6 +101,11 @@ def _sig_and_candidates(kernel, spec):
                                        spec["n_out"])
         cands = autotune.norm_matmul_candidates(spec["rows"],
                                                 spec["n_out"])
+    elif kernel == "paged_attention":
+        sig = autotune.paged_attention_sig(
+            spec["b"], spec["pages"], spec["page_size"], spec["h"],
+            spec["kvh"], spec["d"])
+        cands = autotune.paged_attention_candidates(spec["kvh"])
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
     return sig, cands
@@ -202,6 +215,41 @@ def _build_factory(kernel, spec):
 
             step = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
             return lambda: step(x, w, wm)
+
+        return build
+
+    if kernel == "paged_attention":
+        from paddle_tpu.kernels import paged_attention as pa
+
+        b, pages, ps = spec["b"], spec["pages"], spec["page_size"]
+        h, kvh, d = spec["h"], spec["kvh"], spec["d"]
+        n = b * pages + 1  # full coverage + garbage page 0
+        q = jnp.asarray(rng.randn(b, 1, h, d), dtype)
+        kp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
+        vp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
+        # disjoint per-row tables (the serving layout), rows near full
+        tbl = jnp.asarray(
+            1 + np.arange(b * pages).reshape(b, pages), jnp.int32
+        )
+        pos = jnp.full((b,), pages * ps - 1, jnp.int32)
+
+        def build(config):
+            # decode is a no-grad path: time the forward only
+            if config.get("path") == "composed":
+                def f(qv, kv, vv):
+                    return pa.paged_attention_composed(
+                        qv, kv, vv, tbl, pos
+                    ).astype(jnp.float32).sum()
+            else:
+                bk = config["block_kvh"]
+
+                def f(qv, kv, vv):
+                    return pa.paged_attention_fused(
+                        qv, kv, vv, tbl, pos, block_kvh=bk
+                    ).astype(jnp.float32).sum()
+
+            step = jax.jit(f)
+            return lambda: step(q, kp, vp)
 
         return build
 
@@ -345,6 +393,8 @@ def smoke():
         assert autotune.rope_attention_config_legal(96, cfg), cfg
     for cfg in autotune.norm_matmul_candidates(16, 256):
         assert autotune.norm_matmul_config_legal(16, 256, cfg), cfg
+    for cfg in autotune.paged_attention_candidates(8):
+        assert autotune.paged_attention_config_legal(8, cfg), cfg
 
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "tune_cache.json")
@@ -369,6 +419,9 @@ def smoke():
             if kernel == "rope_attention":
                 assert autotune.rope_attention_config_legal(
                     spec["s"], cfg), cfg
+            elif kernel == "paged_attention":
+                assert autotune.paged_attention_config_legal(
+                    spec["kvh"], cfg), cfg
             else:
                 assert autotune.norm_matmul_config_legal(
                     spec["rows"], spec["n_out"], cfg), cfg
@@ -395,6 +448,21 @@ def smoke():
                                                block_cols=128))(x)
     c2 = jax.jit(lambda a: fnm.rms_norm_matmul_composed(a, w, wm))(x)
     assert (np.asarray(f2) == np.asarray(c2)).all(), "norm_matmul parity"
+    # paged decode attention: kernel bit-exact vs its blocked reference
+    # (the kernel's contract; vs composed gather it agrees to rounding,
+    # which is why engine activation stays tune-cache opt-in)
+    from paddle_tpu.kernels import paged_attention as pa
+
+    qp = jnp.asarray(rng.randn(2, 1, 4, 16), jnp.float32)
+    kp = jnp.asarray(rng.randn(9, 8, 2, 16), jnp.float32)
+    vp = jnp.asarray(rng.randn(9, 8, 2, 16), jnp.float32)
+    tbl = jnp.asarray(1 + np.arange(8).reshape(2, 4), jnp.int32)
+    pos = jnp.asarray([13, 27], jnp.int32)
+    fp = jax.jit(lambda a: pa.paged_attention_fused(
+        a, kp, vp, tbl, pos, block_kvh=1))(qp)
+    rp = pa.paged_attention_reference(qp, kp, vp, tbl, pos)
+    assert (np.asarray(fp) == np.asarray(rp)).all(), \
+        "paged_attention parity"
     print("tune-smoke OK: generators legal, cache round-trips, "
           "re-run is 100% hits with 0 measurements, parity holds")
     return 0

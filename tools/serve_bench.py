@@ -1,15 +1,23 @@
 """Offline serving benchmark: replay a synthetic Poisson trace.
 
-Drives ``paddle_tpu.serving.ServingEngine`` with a reproducible
-open-loop request trace (exponential inter-arrivals at ``--rate`` req/s,
-uniform prompt/decode lengths) against a tiny CPU Llama by default, and
-reports throughput plus latency percentiles from the engine's own
-metrics. The point is to exercise the ENGINE — admission under load,
-slot churn, backpressure — end to end without hardware; point
+Drives ``paddle_tpu.serving.ServingEngine`` (or, with ``--paged``, the
+page-pool ``PagedServingEngine``) with a reproducible open-loop request
+trace (exponential inter-arrivals at ``--rate`` req/s, uniform
+prompt/decode lengths) against a tiny CPU Llama by default, and reports
+throughput plus latency percentiles from the engine's own metrics. The
+point is to exercise the ENGINE — admission under load, slot churn,
+backpressure — end to end without hardware; point
 ``--hidden/--layers/--heads`` at a real config on a chip for actual
 numbers.
 
     python tools/serve_bench.py --requests 32 --rate 50 --max-batch 4
+    python tools/serve_bench.py --paged --page-size 8 --http
+
+``--http`` replays the SAME trace through the streaming HTTP/SSE
+front-end over localhost — every request is a real POST + SSE stream on
+its own thread, so the JSON record carries WIRE-level TTFT/ITL (client-
+measured, socket included) next to the engine's in-process numbers,
+plus the page-pool occupancy/exhaustion counters.
 
 Open-loop means arrivals do not wait for completions: when the engine
 falls behind, the queue grows and (past ``--max-queue``) requests are
@@ -43,12 +51,28 @@ def build_trace(n, rate, seed, vocab, prompt_lo, prompt_hi, new_lo,
     return trace
 
 
+def make_engine(args, net):
+    from paddle_tpu.serving import PagedServingEngine, ServingEngine
+
+    if args.paged:
+        return PagedServingEngine(
+            net, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
+            cache_dtype=args.cache_dtype, min_bucket=args.min_bucket,
+            max_queue_size=args.max_queue, page_size=args.page_size,
+            num_pages=args.num_pages,
+        )
+    return ServingEngine(
+        net, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
+        cache_dtype=args.cache_dtype, min_bucket=args.min_bucket,
+        max_queue_size=args.max_queue,
+    )
+
+
 def run_bench(args):
     import numpy as np  # noqa: F401
 
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.serving import ServingEngine
 
     paddle.seed(args.seed)
     cfg = LlamaConfig.tiny(
@@ -58,11 +82,7 @@ def run_bench(args):
     )
     net = LlamaForCausalLM(cfg)
     net.eval()
-    engine = ServingEngine(
-        net, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
-        cache_dtype=args.cache_dtype, min_bucket=args.min_bucket,
-        max_queue_size=args.max_queue,
-    )
+    engine = make_engine(args, net)
     trace = build_trace(
         args.requests, args.rate, args.seed, args.vocab,
         args.prompt_min, args.prompt_max, args.new_min, args.new_max,
@@ -92,25 +112,31 @@ def run_bench(args):
         # warmup tokens must not pollute the report
         engine.metrics = type(engine.metrics)()
 
-    t0 = time.monotonic()
-    pending = list(trace)
-    handles = []
-    while pending or engine.scheduler.depth or engine.active_slots:
-        now = time.monotonic() - t0
-        while pending and pending[0][0] <= now:
-            _, ids, m = pending.pop(0)
-            handles.append(engine.submit(ids, m))
-        if engine.scheduler.depth or engine.active_slots:
-            engine.step()
-        elif pending:
-            time.sleep(min(0.001, pending[0][0] - now))
-    wall = time.monotonic() - t0
+    if args.http:
+        handles, wall, wire = run_http_trace(engine, trace)
+    else:
+        wire = None
+        t0 = time.monotonic()
+        pending = list(trace)
+        handles = []
+        while pending or engine.scheduler.depth or engine.active_slots:
+            now = time.monotonic() - t0
+            while pending and pending[0][0] <= now:
+                _, ids, m = pending.pop(0)
+                handles.append(engine.submit(ids, m))
+            if engine.scheduler.depth or engine.active_slots:
+                engine.step()
+            elif pending:
+                time.sleep(min(0.001, pending[0][0] - now))
+        wall = time.monotonic() - t0
 
     rep = engine.metrics.report()
     done = sum(1 for h in handles if h.status == "DONE")
     out = {
         "requests": args.requests,
         "rate_req_s": args.rate,
+        "mode": "http" if args.http else "in-process",
+        "engine": type(engine).__name__,
         "wall_s": round(wall, 3),
         "completed": done,
         "rejected": rep["counters"]["rejected"],
@@ -123,7 +149,106 @@ def run_bench(args):
         "pool": engine.pool.stats(),
         "metrics": rep,
     }
+    page_pool = getattr(engine, "page_pool", None)
+    if page_pool is not None:
+        # occupancy / exhaustion counters in the record (the paged
+        # pool's claims/releases/exhausted_events + peak residency)
+        out["page_pool"] = page_pool.stats()
+    if wire is not None:
+        out["wire"] = wire
     return engine, handles, out
+
+
+class _HTTPHandle:
+    """Duck-typed result row for the HTTP replay (matches the `.status`
+    surface the report counts)."""
+
+    def __init__(self, status, reason=None, tokens=()):
+        self.status = status
+        self.reason = reason
+        self.tokens = list(tokens)
+
+
+def _pctl(xs):
+    import numpy as np
+
+    if not xs:
+        return {"count": 0}
+    a = np.asarray(xs, float)
+    return {
+        "count": int(a.size),
+        "mean": float(a.mean()),
+        "p50": float(np.percentile(a, 50)),
+        "p90": float(np.percentile(a, 90)),
+        "p99": float(np.percentile(a, 99)),
+        "max": float(a.max()),
+    }
+
+
+def run_http_trace(engine, trace):
+    """Replay the trace through the HTTP/SSE front-end on localhost —
+    one thread per request, arrivals honored, every token crossing a
+    real socket. Returns (handles, wall_s, wire-stats dict)."""
+    import threading
+
+    from paddle_tpu.serving import (
+        HTTPRejected,
+        ServingFrontend,
+        stream_generate,
+    )
+
+    fe = ServingFrontend(engine).start()
+    results = [None] * len(trace)
+    ttfts, itls, rejects = [], [], {}
+    lock = threading.Lock()
+
+    def one(i, ids, max_new):
+        try:
+            events, tm = stream_generate(
+                "127.0.0.1", fe.port,
+                {"input_ids": [int(t) for t in ids[0]],
+                 "max_new_tokens": int(max_new)},
+            )
+        except HTTPRejected as e:
+            with lock:
+                reason = (e.body or {}).get("reason", f"http_{e.code}")
+                rejects[reason] = rejects.get(reason, 0) + 1
+                results[i] = _HTTPHandle("REJECTED", reason)
+            return
+        toks = [d["token"] for ev, d in events if ev == "token"]
+        last = events[-1] if events else ("error", {})
+        status = (last[1] or {}).get("status", "ERROR") \
+            if last[0] in ("done", "error") else "ERROR"
+        with lock:
+            results[i] = _HTTPHandle(status, (last[1] or {}).get(
+                "reason"), toks)
+            if tm["ttft_s"] is not None:
+                ttfts.append(tm["ttft_s"])
+            itls.extend(tm["itl_s"])
+
+    t0 = time.monotonic()
+    threads = []
+    try:
+        for i, (arrival, ids, max_new) in enumerate(trace):
+            dt = arrival - (time.monotonic() - t0)
+            if dt > 0:
+                time.sleep(dt)
+            th = threading.Thread(target=one, args=(i, ids, max_new),
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.monotonic() - t0
+    finally:
+        fe.stop()
+    wire = {
+        "ttft": _pctl(ttfts),
+        "itl": _pctl(itls),
+        "rejected_by_reason": rejects,
+        "stream_aborts": fe.metrics.stream_aborts.by_label(),
+    }
+    return [r or _HTTPHandle("ERROR") for r in results], wall, wire
 
 
 def main(argv=None):
@@ -145,6 +270,17 @@ def main(argv=None):
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--paged", action="store_true",
+                    help="serve through PagedServingEngine (page-pool "
+                         "KV residency) instead of the decode slab")
+    ap.add_argument("--page-size", type=int, default=8,
+                    help="KV page size in tokens (paged engine)")
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="usable page count (default: full coverage)")
+    ap.add_argument("--http", action="store_true",
+                    help="replay through the HTTP/SSE front-end over "
+                         "localhost; records wire-level TTFT/ITL next "
+                         "to the in-process numbers")
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON report only")
