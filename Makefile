@@ -5,7 +5,7 @@ PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
-	tune-smoke serve-smoke
+	tune-smoke serve-smoke quant-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -55,6 +55,14 @@ tune-smoke:
 # nonzero wire-TTFT series.
 serve-smoke:
 	$(ENV) $(PY) tools/serve_smoke.py
+
+# Quantized-execution gate: PTQ the tiny llama -> quantize_for_serving
+# (int8 weights, asserted idempotent) -> jit.save/predictor round trip
+# exact -> one HTTP/SSE request over int8 weights + int8 KV pages; the
+# stream must match the fp32 reference within the pinned agreement
+# budget and the page pool must drain to zero.
+quant-smoke:
+	$(ENV) $(PY) tools/quant_smoke.py
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

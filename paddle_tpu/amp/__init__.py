@@ -2,3 +2,4 @@
 from .auto_cast import amp_guard, auto_cast, decorate, white_list  # noqa: F401
 from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
 from . import debugging  # noqa: F401
+from . import fp8  # noqa: F401

@@ -7,6 +7,13 @@ white-listed ops (matmul/conv — the MXU ops) to the low dtype, leaving
 numerically sensitive ops (softmax/norm/loss reductions) in float32 —
 the same O1 insertion point as the reference's generated dygraph functions.
 O2 additionally keeps master weights via ``decorate``.
+
+O3 (``CompiledTrainStep(amp_level="O3")``) goes one level further: the
+matmuls themselves run with fp8 operands (e4m3 forward / e5m2 backward,
+per-tensor delayed scaling — see ``paddle_tpu.amp.fp8``) while this
+module's O1 white/black lists keep handling every other op. The fp8
+routing needs carried scaling state, so it lives in the compiled train
+step rather than in this stateless dispatch hook.
 """
 from __future__ import annotations
 

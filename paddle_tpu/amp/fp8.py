@@ -1,0 +1,224 @@
+"""AMP "O3": fp8 train-step matmuls with per-tensor delayed scaling.
+
+One level past O2: the big train-step matmuls (every ``F.linear`` —
+the q/k/v/o projections, the SwiGLU gemms, the lm_head; the MXU FLOP
+carriers) run with **e4m3 operands** in the forward and **e5m2
+gradients** in the backward, fp32 accumulation, while everything else
+keeps the O1 bf16/fp32 split. Weights crossing the HBM bus at 1 byte
+instead of 2 is the win the :class:`~..observability.StepMeter`
+reports analytically (``paddle_training_amp_fp8_matmul_bytes_saved``).
+
+Scaling (the standard fp8 recipe):
+
+- **Forward (delayed)**: each matmul site keeps an amax HISTORY per
+  operand (``[HISTORY_LEN]`` fp32). The quantization scale for step t
+  is derived from the history of steps < t — so the scale is known
+  BEFORE the tensor is produced and quantization adds zero sync. The
+  history is plain jit-carried state: ``CompiledTrainStep`` threads it
+  through the compiled step next to the optimizer state (in/out every
+  step as device arrays — structure discovered once via
+  ``jax.eval_shape``, no extra compile, no host round trip).
+- **Backward (just-in-time)**: incoming gradients quantize to e5m2
+  with a scale from their OWN amax, computed in-trace — gradients are
+  the tensors whose dynamic range moves fastest, and the JIT scale
+  costs nothing extra inside the fused backward.
+
+Saturation: values are clamped into the format's representable range
+before the cast (graceful degradation while a history warms up — the
+first step quantizes with scale 1).
+
+Call sites route here via :func:`active` — the context is armed only
+inside a ``CompiledTrainStep(amp_level="O3")`` trace (or an explicit
+:func:`fp8_autocast`), so eager code and other AMP levels never pay
+for the check beyond one thread-local read.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0          # max finite float8_e4m3fn
+E5M2_MAX = 57344.0        # max finite float8_e5m2
+HISTORY_LEN = 16          # amax history window per tensor site
+_EPS = 1e-12
+
+
+class _Fp8State(threading.local):
+    def __init__(self):
+        self.ctx = None
+
+
+_TL = _Fp8State()
+
+
+class Fp8Context:
+    """Per-trace bookkeeping: serves each matmul site its delayed
+    scale (from the carried history) and collects the updated
+    histories, keyed by deterministic call order — tracing is
+    deterministic, so site k is the same matmul every step."""
+
+    def __init__(self, state):
+        self.state = state or {}
+        self.new_state = {}
+        self._n = 0
+        self.weight_bytes_saved = 0  # analytic, host-side static
+
+    def site(self):
+        k = f"linear{self._n}"
+        self._n += 1
+        return k
+
+    def history(self, site, operand):
+        key = f"{site}/{operand}"
+        h = self.state.get(key)
+        if h is None:
+            h = jnp.zeros((HISTORY_LEN,), jnp.float32)
+        return key, h
+
+
+def active():
+    return _TL.ctx is not None
+
+
+def current():
+    return _TL.ctx
+
+
+@contextlib.contextmanager
+def fp8_autocast(state=None):
+    """Arm fp8 matmul routing for the enclosed (traced) region.
+    ``state``: the carried {site/operand: amax-history} pytree from the
+    previous step (None on discovery). The context's ``new_state``
+    holds the updated histories to carry forward."""
+    prev = _TL.ctx
+    ctx = Fp8Context(state)
+    _TL.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _TL.ctx = prev
+
+
+def _delayed_scale(history, fmax):
+    """Scale from the amax HISTORY (delayed scaling): amax/fmax with a
+    margin-free floor — an empty history (all zeros) yields scale 1."""
+    amax = jnp.max(history)
+    return jnp.where(amax > 0, jnp.maximum(amax, _EPS) / fmax, 1.0)
+
+
+def _roll_in(history, amax):
+    """Newest amax enters at slot 0; the window slides."""
+    return jnp.roll(history, 1).at[0].set(amax.astype(jnp.float32))
+
+
+def _quantize(x, scale, dtype, fmax):
+    """Scale, saturate into the format's range, cast. The cast IS the
+    rounding step (round-to-nearest-even into fp8)."""
+    y = x.astype(jnp.float32) / scale
+    return jnp.clip(y, -fmax, fmax).astype(dtype)  # tpu-lint: quant
+
+
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fp8_dot(x_dtype, w_dtype, x2d, w, sx, sw):
+    """[M, K] @ [K, N] with e4m3 operands / fp32 accumulate; scales are
+    applied outside the dot (the epilogue rescale). ``x_dtype`` /
+    ``w_dtype`` are the primal dtype NAMES (static) so the backward can
+    emit cotangents in the right width."""
+    qx = _quantize(x2d, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quantize(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    out = jax.lax.dot_general(
+        qx, qw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return out * (sx * sw)
+
+
+def _fp8_dot_fwd(x_dtype, w_dtype, x2d, w, sx, sw):
+    qx = _quantize(x2d, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quantize(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    out = jax.lax.dot_general(
+        qx, qw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (sx * sw)
+    # residuals are the fp8 tensors — the memory the backward holds
+    # per matmul drops 2-4x vs bf16/fp32 residuals
+    return out, (qx, qw, sx, sw)
+
+
+def _fp8_dot_bwd(x_dtype, w_dtype, res, g):
+    qx, qw, sx, sw = res
+    # e5m2 gradient with just-in-time per-tensor scale
+    ga = jnp.max(jnp.abs(g))
+    sg = jnp.where(ga > 0, jnp.maximum(ga, _EPS) / E5M2_MAX, 1.0)
+    qg = _quantize(g, sg, jnp.float8_e5m2, E5M2_MAX)
+    dx = jax.lax.dot_general(
+        qg, qw, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (sg * sw)
+    dw = jax.lax.dot_general(
+        qx, qg, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * (sx * sg)
+    # cotangent dtypes must match the primals'; scales came from
+    # stop-gradient'd history state -> zero cotangents
+    return (dx.astype(x_dtype), dw.astype(w_dtype),
+            jnp.zeros_like(sx), jnp.zeros_like(sw))
+
+
+_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def fp8_linear_value(x, w, b):
+    """The O3 body of ``F.linear`` (raw jax values, called inside the
+    traced step): e4m3 x/w with delayed scales, output back in the
+    compute dtype, bias added outside the fp8 path."""
+    ctx = _TL.ctx
+    site = ctx.site()
+    kx, hx = ctx.history(site, "x")
+    kw, hw = ctx.history(site, "w")
+    sx = jax.lax.stop_gradient(_delayed_scale(hx, E4M3_MAX))
+    sw = jax.lax.stop_gradient(_delayed_scale(hw, E4M3_MAX))
+    shape = x.shape
+    k = shape[-1]
+    x2d = x.reshape(-1, k)
+    out = _fp8_dot(jnp.dtype(x.dtype).name, jnp.dtype(w.dtype).name,
+                   x2d, w, sx, sw).astype(x.dtype)
+    out = out.reshape(tuple(shape[:-1]) + (w.shape[-1],))
+    # update the carried histories with THIS step's amaxes (used from
+    # the next step on — that is what makes the scaling "delayed")
+    ctx.new_state[kx] = _roll_in(
+        hx, jax.lax.stop_gradient(jnp.max(jnp.abs(
+            x2d.astype(jnp.float32))))
+    )
+    ctx.new_state[kw] = _roll_in(
+        hw, jax.lax.stop_gradient(jnp.max(jnp.abs(
+            w.astype(jnp.float32))))
+    )
+    # analytic HBM delta: this matmul's weight crosses the bus as fp8
+    # (1 byte) instead of its stored width
+    try:
+        ctx.weight_bytes_saved += int(w.size) * max(
+            jnp.dtype(w.dtype).itemsize - 1, 0
+        )
+    except Exception:
+        pass
+    if b is not None:
+        out = out + b
+    return out
+
+
+def note_selection_once():
+    """Publish the O3 routing decision into the kernels selection
+    series (telemetry only — never fails a step)."""
+    try:
+        from ..kernels import autotune
+
+        autotune.note_selection("fp8_matmul", "fp8:o3")
+    except Exception:
+        pass

@@ -18,7 +18,12 @@ them):
                      to one convert; A->B->A is pure waste) and
                      bulk narrow->wide upcasts above a byte threshold
                      (silent hot-path promotion, the flash-attention
-                     mixed q/kv failure mode).
+                     mixed q/kv failure mode). INTENTIONAL int8/fp8
+                     quant-dequant pairs are whitelisted when tagged —
+                     issuing function name matching quant/dequant/fp8/
+                     int8, or a ``# tpu-lint: quant`` marker on the
+                     source line — so real narrow-dtype execution lands
+                     with zero baseline growth.
 - ``host-transfer``  host callbacks (``pure_callback``/``io_callback``/
                      ``debug_callback``) and ``device_put`` inside the
                      compiled region — each is a device stall.
@@ -34,6 +39,7 @@ them):
 from __future__ import annotations
 
 import dataclasses
+import re as _re
 
 import numpy as np
 
@@ -116,6 +122,58 @@ def _nbytes(aval):
         ).itemsize
     except Exception:
         return 0
+
+
+# quantization dtypes: a convert chain that passes through one of these
+# is (when tagged) an intentional quant/dequant pair, not churn
+_QUANT_DTYPES = ("int8", "uint8", "float8_e4m3fn", "float8_e5m2",
+                 "float8_e4m3b11fnuz", "float8_e4m3fnuz",
+                 "float8_e5m2fnuz")
+
+# op-name pattern: converts issued from a function whose name says it
+# quantizes are intentional by construction
+_QUANT_FN_RE = _re.compile(r"quant|dequant|fp8|int8", _re.IGNORECASE)
+
+_QUANT_MARKER = "# tpu-lint: quant"
+
+_SRC_LINE_CACHE: dict = {}
+
+
+def _source_line(where):
+    """The source text at a ``file:line (function)`` provenance string
+    (cached per file; empty on any miss)."""
+    try:
+        path, rest = where.split(":", 1)
+        line_no = int(rest.split(" ", 1)[0])
+    except (ValueError, AttributeError):
+        return ""
+    lines = _SRC_LINE_CACHE.get(path)
+    if lines is None:
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            lines = []
+        _SRC_LINE_CACHE[path] = lines
+    if 1 <= line_no <= len(lines):
+        return lines[line_no - 1]
+    return ""
+
+
+def _quant_tagged(where, dtypes):
+    """True when a convert chain is an INTENTIONAL int8/fp8
+    quant-dequant pair: one of the chain's dtypes is a quant dtype AND
+    the site is tagged — either the issuing function's name matches the
+    quant pattern (quantize_kv, _fp8_dot, dequantize, ...) or the source
+    line carries an explicit ``# tpu-lint: quant`` marker. Untagged
+    chains through wide dtypes keep firing (real churn)."""
+    if not any(np.dtype(d).name in _QUANT_DTYPES for d in dtypes):
+        return False
+    if "(" in (where or ""):
+        fn_name = where.rsplit("(", 1)[1].rstrip(")")
+        if _QUANT_FN_RE.search(fn_name):
+            return True
+    return _QUANT_MARKER in _source_line(where)
 
 
 def _axis_names_of(eqn):
@@ -230,16 +288,24 @@ def lint_closed_jaxpr(closed, *, graph="", donated=None, config=None):
                 key = (path, _src(eqn))
                 if key not in churn_seen:
                     churn_seen.add(key)
-                    roundtrip = first_dt == dst_dt
-                    rep.add(Finding(
-                        rule="dtype-churn", severity=Severity.WARNING,
-                        message=(
-                            f"chained convert {path} "
-                            + ("is a round trip (pure waste)" if roundtrip
-                               else "collapses to one convert")
-                        ),
-                        graph=graph, where=_src(eqn), detail=path,
-                    ))
+                    if _quant_tagged(_src(eqn),
+                                     (first_dt, src_dt, dst_dt)):
+                        # tagged int8/fp8 quant-dequant pair:
+                        # intentional narrow-dtype execution, not churn
+                        pass
+                    else:
+                        roundtrip = first_dt == dst_dt
+                        rep.add(Finding(
+                            rule="dtype-churn",
+                            severity=Severity.WARNING,
+                            message=(
+                                f"chained convert {path} "
+                                + ("is a round trip (pure waste)"
+                                   if roundtrip
+                                   else "collapses to one convert")
+                            ),
+                            graph=graph, where=_src(eqn), detail=path,
+                        ))
             # bulk narrow->wide float promotion accounting
             sw, dw = _WIDTH.get(src_dt.name), _WIDTH.get(dst_dt.name)
             if sw and dw and dw > sw:

@@ -78,15 +78,49 @@ class CompiledTrainStep:
         self._step_fn = None
         self._param_names = [k for k, _ in network.named_parameters()]
         self._checkpoint = None
+        # AMP O3 (fp8 matmuls): per-tensor delayed-scaling amax
+        # histories, carried through the compiled step next to the
+        # optimizer state (structure discovered on the first call)
+        self._fp8_state = None
+        self._fp8_bytes_saved = 0
 
     def attach_checkpoint(self, manager):
         """Wire a ``checkpoint.CheckpointManager`` into the step loop:
         after each optimizer step the manager's policy decides whether
         to kick off an async save. The manager is bound to this
-        trainer's network/optimizer if it was constructed bare."""
+        trainer's network/optimizer if it was constructed bare.
+
+        AMP O3 caveat: the manager snapshots network + optimizer state
+        only — the fp8 delayed-scaling amax histories are NOT part of
+        either. A crash-resume therefore restarts them from zeros
+        (scale 1, exactly the cold-start behavior of step 1; the
+        window refills within ``fp8.HISTORY_LEN`` steps). Callers who
+        need the identical numerical trajectory across a resume can
+        persist :meth:`fp8_state_dict` alongside the checkpoint and
+        :meth:`load_fp8_state` it after restore."""
         manager.bind(self.network, self.optimizer)
         self._checkpoint = manager
         return manager
+
+    def fp8_state_dict(self):
+        """The AMP O3 delayed-scaling state as host numpy arrays
+        ({site/operand: amax history}), for persisting next to a
+        checkpoint. Empty dict when O3 is off or not yet discovered."""
+        import numpy as _np
+
+        if self._fp8_state is None:
+            return {}
+        return {k: _np.asarray(v) for k, v in self._fp8_state.items()}
+
+    def load_fp8_state(self, state):
+        """Restore delayed-scaling histories saved by
+        :meth:`fp8_state_dict` (keys must match the model's matmul
+        sites — same architecture, same call order)."""
+        if not state:
+            return
+        self._fp8_state = {
+            k: jnp.asarray(v, jnp.float32) for k, v in state.items()
+        }
 
     @staticmethod
     def _normalize_scaler(scaler):
@@ -191,24 +225,52 @@ class CompiledTrainStep:
         elif kind is opt_mod.Momentum:
             hyper = dict(mu=opt._momentum, nesterov=opt._nesterov)
 
-        def loss_of(params, buffers, rng, inputs, labels):
+        def loss_of(params, buffers, rng, inputs, labels,
+                    fp8_state=None):
             network.load_functional_state(params, buffers)
-            if amp_level in ("O1", "O2"):
+            if amp_level in ("O1", "O2", "O3"):
                 from ..amp import auto_cast
 
-                cm = auto_cast(True, level=amp_level, dtype=amp_dtype)
+                # O3 keeps O1's bf16/fp32 op split for everything that
+                # is NOT a matmul; the matmuls themselves are routed to
+                # fp8 by the context below
+                cm = auto_cast(
+                    True, level="O1" if amp_level == "O3" else amp_level,
+                    dtype=amp_dtype,
+                )
             else:
                 import contextlib
 
                 cm = contextlib.nullcontext()
-            with tape.trace_scope(), tape.no_grad(), random_mod.key_scope(rng), cm:
+            if amp_level == "O3":
+                from ..amp import fp8 as fp8_mod
+
+                fp8_cm = fp8_mod.fp8_autocast(fp8_state)
+            else:
+                import contextlib
+
+                fp8_cm = contextlib.nullcontext()
+            with tape.trace_scope(), tape.no_grad(), \
+                    random_mod.key_scope(rng), cm, fp8_cm as fp8_ctx:
                 network.train()
                 out = self._forward_traced(inputs)
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 loss = loss_fn(*(list(outs) + [Tensor(v) for v in labels]))
             new_buffers = {k: b.value for k, b in network.named_buffers()}
             out_vals = tuple(o.value for o in outs)
-            return loss.value.astype(jnp.float32), (new_buffers, out_vals)
+            if fp8_ctx is not None:
+                # delayed-scaling histories ride the step like buffers:
+                # in as carried state, out updated with this step's
+                # amaxes (device arrays end to end — no host sync)
+                self._fp8_bytes_saved = fp8_ctx.weight_bytes_saved
+                new_fp8 = fp8_ctx.new_state
+            else:
+                new_fp8 = None
+            return loss.value.astype(jnp.float32), (
+                new_buffers, out_vals, new_fp8,
+            )
+
+        self._loss_of = loss_of
 
         # ZeRO stage-2/3 (group_sharded): constrain grads to the sharded
         # layout; XLA realizes the reduce-scatter + sharded-update pattern
@@ -217,15 +279,15 @@ class CompiledTrainStep:
         scaler = self.scaler
 
         def step(params, opt_state, buffers, lr, t, rng, inputs, labels,
-                 scale=None, good=None, bad=None):
+                 scale=None, good=None, bad=None, fp8_state=None):
             if scaler is not None:
                 def scaled_loss_of(params, buffers, rng, inputs, labels):
                     loss, aux = loss_of(params, buffers, rng, inputs,
-                                        labels)
+                                        labels, fp8_state=fp8_state)
                     return loss * scale, (aux, loss)
 
                 (
-                    (_, ((new_buffers, out_vals), loss)),
+                    (_, ((new_buffers, out_vals, new_fp8), loss)),
                     grads,
                 ) = jax.value_and_grad(scaled_loss_of, has_aux=True)(
                     params, buffers, rng, inputs, labels
@@ -240,9 +302,11 @@ class CompiledTrainStep:
                     for g in jax.tree_util.tree_leaves(grads)
                 ]))
             else:
-                (loss, (new_buffers, out_vals)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True
-                )(params, buffers, rng, inputs, labels)
+                (loss, (new_buffers, out_vals, new_fp8)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(
+                        params, buffers, rng, inputs, labels,
+                        fp8_state,
+                    )
                 finite = None
 
             if grad_placements:
@@ -353,8 +417,9 @@ class CompiledTrainStep:
                 else:
                     scale2 = scale  # static-scale mode: never adjusted
                 return (new_params, new_state, new_buffers, loss, out_vals,
-                        scale2, good2, bad2, finite)
-            return new_params, new_state, new_buffers, loss, out_vals
+                        new_fp8, scale2, good2, bad2, finite)
+            return (new_params, new_state, new_buffers, loss, out_vals,
+                    new_fp8)
 
         self._step = step
 
@@ -476,6 +541,10 @@ class CompiledTrainStep:
                 dt, examples=examples, tokens=tokens, loss=loss,
                 warmup=warmup,
             )
+            if self.amp_level == "O3" and self._fp8_bytes_saved:
+                # analytic per-step HBM delta of routing the matmul
+                # weights through fp8 (counted at trace time)
+                meter.note_fp8_bytes_saved(self._fp8_bytes_saved)
         except Exception:
             pass
 
@@ -505,13 +574,29 @@ class CompiledTrainStep:
         rng = random_mod.next_key()
         in_vals = tuple(_unwrap(x) for x in inputs)
         lbl_vals = tuple(_unwrap(y) for y in labels)
+        if self.amp_level == "O3" and self._fp8_state is None:
+            # discover the fp8 delayed-scaling state STRUCTURE with an
+            # abstract pass (jax.eval_shape — no compile, no FLOPs), so
+            # the compiled step's signature includes the carried
+            # histories from its one and only trace
+            shapes = jax.eval_shape(
+                lambda p, b, r, i, l: self._loss_of(
+                    p, b, r, i, l, None
+                )[1][2],
+                params, buffers, rng, in_vals, lbl_vals,
+            )
+            self._fp8_state = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes
+            )
+            # eval_shape left abstract tracers in the Layer objects
+            self.network.load_functional_state(params, buffers)
         if self.scaler is not None:
             sc = self.scaler
             (new_params, new_state, new_buffers, loss, out_vals,
-             scale2, good2, bad2, finite) = self._invoke(
+             new_fp8, scale2, good2, bad2, finite) = self._invoke(
                 params, opt_state, buffers, lr, t, rng, in_vals, lbl_vals,
                 jnp.float32(sc._scale), jnp.int32(sc._good_steps),
-                jnp.int32(sc._bad_steps),
+                jnp.int32(sc._bad_steps), self._fp8_state,
             )
             sc._scale = float(scale2)
             sc._good_steps = int(good2)
@@ -522,11 +607,15 @@ class CompiledTrainStep:
                 # advance (reference optimizers see no step either)
                 self.optimizer._step_count -= 1
         else:
-            new_params, new_state, new_buffers, loss, out_vals = \
-                self._invoke(
-                    params, opt_state, buffers, lr, t, rng, in_vals,
-                    lbl_vals,
-                )
+            (new_params, new_state, new_buffers, loss, out_vals,
+             new_fp8) = self._invoke(
+                params, opt_state, buffers, lr, t, rng, in_vals,
+                lbl_vals, None, None, None, self._fp8_state,
+            )
+        if new_fp8 is not None:
+            # device arrays in, device arrays out — the histories never
+            # touch the host (the step stays sync-free)
+            self._fp8_state = new_fp8
         # write back: imperative objects stay the source of truth
         lookup = dict(self.network.named_parameters())
         for k, v in new_params.items():

@@ -16,6 +16,7 @@ from . import flash_attention  # noqa: F401
 from . import fused_adam  # noqa: F401
 from . import fused_norm_matmul  # noqa: F401
 from . import fused_rope_attention  # noqa: F401
+from . import int8_matmul  # noqa: F401
 from . import paged_attention  # noqa: F401
 from . import rms_norm  # noqa: F401
 from . import rope  # noqa: F401

@@ -90,10 +90,26 @@ def norm_matmul_sig(rows, hidden, n_out):
     return f"r{rows}_h{hidden}_n{n_out}"
 
 
-def paged_attention_sig(b, pages, page_size, h, kvh, d):
+def paged_attention_sig(b, pages, page_size, h, kvh, d, quant=False):
     """Paged decode attention: B decode rows, a [B, pages] page table
-    over page_size-token pages, H query heads over kvh KV heads."""
-    return f"b{b}_p{pages}_ps{page_size}_h{h}_kv{kvh}_d{d}"
+    over page_size-token pages, H query heads over kvh KV heads.
+    ``quant=True`` tags the int8-arena flavor (its own tuning entry —
+    int8 page loads + in-VMEM dequant have a different profile)."""
+    base = f"b{b}_p{pages}_ps{page_size}_h{h}_kv{kvh}_d{d}"
+    return base + ("_q8" if quant else "")
+
+
+def int8_matmul_sig(rows, hidden, n_out):
+    """Weight-only int8 matmul (decode projections / lm_head): rows x
+    hidden activations against an int8 [hidden, n_out] weight with
+    per-output-channel scales."""
+    return f"r{rows}_h{hidden}_n{n_out}"
+
+
+def fp8_matmul_sig(m, k, n):
+    """fp8 train matmul (AMP O3): [m, k] x [k, n], e4m3 operands with
+    per-tensor scaling, fp32 accumulate."""
+    return f"m{m}_k{k}_n{n}"
 
 
 def cache_key(kernel, sig, device=None):
@@ -431,11 +447,31 @@ def paged_attention_config_legal(kv_heads, config):
     return bk >= 1 and kv_heads % bk == 0
 
 
+def int8_matmul_candidates(rows, n_out):
+    """(block_rows, block_cols) candidates for the weight-only int8
+    matmul — same output-tiling space as the norm+matmul epilogue
+    kernel (the contraction dim rides whole either way)."""
+    return norm_matmul_candidates(rows, n_out)
+
+
+def int8_matmul_config_legal(rows, n_out, config):
+    return norm_matmul_config_legal(rows, n_out, config)
+
+
+def fp8_matmul_candidates(m=None, k=None, n=None):
+    """The fp8 train-matmul path has no block-size knob (XLA owns the
+    tiling of a plain fp8 dot); the single candidate exists so the
+    tuner can record the measured fp8-vs-bf16 verdict for the shape."""
+    return [{"format": "e4m3"}]
+
+
 CANDIDATE_GENERATORS = {
     "flash_attention": flash_block_candidates,
     "rope_attention": rope_attention_candidates,
     "rms_norm_matmul": norm_matmul_candidates,
     "paged_attention": paged_attention_candidates,
+    "int8_matmul": int8_matmul_candidates,
+    "fp8_matmul": fp8_matmul_candidates,
 }
 
 

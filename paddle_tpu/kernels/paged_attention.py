@@ -64,6 +64,23 @@ def gather_pages(pages, page_table):
     return pages[page_table].reshape(b, p * ps, kvh, d)
 
 
+def gather_pages_dense(pages, page_table, dtype):
+    """Composed gather for either arena flavor. Plain arrays: exactly
+    :func:`gather_pages` (no cast — the bf16 path stays byte-identical;
+    attention upcasts at the matmul). Quantized arenas: gather the int8
+    values and their scales, then dequantize-on-gather to the compute
+    ``dtype`` — the int8 bytes are what crossed HBM."""
+    from ..quantization.kv import dequantize_kv, is_quantized
+
+    if not is_quantized(pages):
+        return gather_pages(pages, page_table)
+    b, p = page_table.shape
+    n, ps, kvh, d = pages.q.shape
+    q = pages.q[page_table].reshape(b, p * ps, kvh, d)
+    s = pages.scale[page_table].reshape(b, p * ps, kvh)
+    return dequantize_kv(q, s, dtype)  # tpu-lint: quant
+
+
 def _softmax_rows(s):
     """fp32 row softmax, op-for-op ``jax.nn.softmax`` (max-subtract,
     exp, sum-normalize) — masked -inf columns contribute exactly 0."""
@@ -79,13 +96,15 @@ def paged_attention_composed(q, k_pages, v_pages, page_table, pos,
     body (``_sdpa_ref``) runs for the slab engine, so the paged engine's
     default path and the slab engine round identically.
 
-    q ``[B, 1, H, D]``; returns ``[B, 1, H, D]`` in q's dtype."""
+    q ``[B, 1, H, D]``; returns ``[B, 1, H, D]`` in q's dtype.
+    Quantized (int8) arenas dequantize-on-gather to q's dtype first —
+    the op order the engine's default int8 paged path runs."""
     b, sq, h, d = (int(x) for x in q.shape)
     kvh = int(k_pages.shape[2])
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    kk = gather_pages(k_pages, page_table)   # [B, S_virt, kvH, D]
-    vv = gather_pages(v_pages, page_table)
+    kk = gather_pages_dense(k_pages, page_table, q.dtype)
+    vv = gather_pages_dense(v_pages, page_table, q.dtype)
     if kvh != h:
         rep = h // kvh
         kk = jnp.repeat(kk, rep, axis=2)
@@ -110,10 +129,24 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, pos,
     kv-head, per-page dots assembled into a full score row + gathered V,
     ONE softmax, ONE value dot). Pinned bit-identical to
     :func:`paged_attention_fused` in CI. Loop-based — a verification
-    reference, not a serving path."""
+    reference, not a serving path. Quantized arenas dequantize each
+    page block to fp32 (value * scale) exactly as the kernel does in
+    VMEM, so the bit-exact pin covers the int8 flavor too."""
+    from ..quantization.kv import is_quantized
+
     b, sq, h, d = (int(x) for x in q.shape)
-    kvh = int(k_pages.shape[2])
-    ps = int(k_pages.shape[1])
+    quant = is_quantized(k_pages)
+
+    def _page(pages_arr, bi, p, j):
+        if is_quantized(pages_arr):
+            return (
+                pages_arr.q[page_table[bi, p], :, j].astype(jnp.float32)
+                * pages_arr.scale[page_table[bi, p], :, j][:, None]
+            )  # tpu-lint: quant
+        return pages_arr[page_table[bi, p], :, j].astype(jnp.float32)
+
+    kvh = int((k_pages.q if quant else k_pages).shape[2])
+    ps = int((k_pages.q if quant else k_pages).shape[1])
     pages = int(page_table.shape[1])
     group = h // kvh
     if scale is None:
@@ -126,18 +159,15 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, pos,
             qg = q[bi, 0].reshape(kvh, group, d)[j].astype(jnp.float32)
             srow, vrow = [], []
             for p in range(pages):
-                kpage = k_pages[page_table[bi, p], :, j]
-                kg = jnp.repeat(
-                    kpage[:, None, :].astype(jnp.float32), group, axis=1
-                )
+                kpage = _page(k_pages, bi, p, j)
+                kg = jnp.repeat(kpage[:, None, :], group, axis=1)
                 s = jax.lax.dot_general(
                     qg, jnp.swapaxes(kg, 0, 1),
                     (((1,), (2,)), ((0,), (0,))),
                 ) * scale
                 srow.append(s)
                 vpage = jnp.repeat(
-                    v_pages[page_table[bi, p], :, j][:, None, :]
-                    .astype(jnp.float32), group, axis=1,
+                    _page(v_pages, bi, p, j)[:, None, :], group, axis=1,
                 )
                 vrow.append(vpage.reshape(ps, -1))
             sfull = jnp.concatenate(srow, axis=1)         # [G, S_virt]
@@ -157,24 +187,24 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, pos,
     return jnp.stack(rows)[:, None].astype(q.dtype)
 
 
-def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  s_scratch, v_scratch, *, scale, page_size, pages,
-                  group, out_dtype):
-    """Grid (B, kvH / block_kvh, P): step p assembles page p's score
-    columns and V rows into scratch; the LAST page step softmaxes the
-    full row and emits the output block.
+def _paged_body(table_ref, pos_ref, q_ref, k, v, o_ref, s_scratch,
+                v_scratch, *, scale, page_size, pages, group,
+                out_dtype):
+    """The SHARED kernel body both arena flavors run after their load
+    epilogue: grid step p assembles page p's score columns and V rows
+    into scratch; the LAST page step softmaxes the full row and emits
+    the output block. ``k``/``v`` arrive as fp32 ``[ps, bkvh, D]`` —
+    already dequantized by the caller — so the masking/softmax/emit
+    math has exactly ONE home and the two flavors can never round
+    apart.
 
-    q_ref ``[1, G, D]`` (G = block_kvh * group query heads),
-    k_ref/v_ref ``[1, ps, bkvh, D]`` — one table-indexed page block."""
+    q_ref ``[1, G, D]`` (G = block_kvh * group query heads)."""
     b = pl.program_id(0)
     p = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                 # [G, D]
-    bkvh = k_ref.shape[2]
     # GQA: repeat the page's KV heads up to the query-head group, in
     # kv-head-major order to match jnp.repeat(kk, rep, axis=2)
-    k = k_ref[0].astype(jnp.float32)                    # [ps, bkvh, D]
     k = jnp.repeat(k, group, axis=1)                    # [ps, G, D]
-    v = v_ref[0].astype(jnp.float32)
     v = jnp.repeat(v, group, axis=1)
     # score columns for this page: one dot over D per element — the
     # same dot_general contraction the composed einsum lowers to
@@ -205,19 +235,60 @@ def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
+def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  s_scratch, v_scratch, *, scale, page_size, pages,
+                  group, out_dtype):
+    """Float-arena flavor: load epilogue is a plain fp32 upcast of the
+    table-indexed page block; everything else is :func:`_paged_body`.
+
+    k_ref/v_ref ``[1, ps, bkvh, D]`` — one table-indexed page block."""
+    _paged_body(
+        table_ref, pos_ref, q_ref,
+        k_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+        o_ref, s_scratch, v_scratch, scale=scale, page_size=page_size,
+        pages=pages, group=group, out_dtype=out_dtype,
+    )
+
+
+def _paged_kernel_quant(table_ref, pos_ref, q_ref, k_ref, ks_ref, v_ref,
+                        vs_ref, o_ref, s_scratch, v_scratch, *, scale,
+                        page_size, pages, group, out_dtype):
+    """Int8-arena flavor: the page block arrives as int8 values +
+    per-(slot, kv-head) fp32 scales and the load epilogue dequantizes
+    in VMEM (value * scale — the exact op order the blocked reference
+    mirrors), so only the narrow bytes ever cross HBM. Everything past
+    the load is the shared :func:`_paged_body`."""
+    # dequant-on-gather, in VMEM: [ps, bkvh, D] fp32  # tpu-lint: quant
+    k = k_ref[0].astype(jnp.float32) * ks_ref[0][..., None]
+    v = v_ref[0].astype(jnp.float32) * vs_ref[0][..., None]
+    _paged_body(
+        table_ref, pos_ref, q_ref, k, v, o_ref, s_scratch, v_scratch,
+        scale=scale, page_size=page_size, pages=pages, group=group,
+        out_dtype=out_dtype,
+    )
+
+
 def paged_attention_fused(q, k_pages, v_pages, page_table, pos,
                           scale=None, block_kvh=1):
     """Pallas paged decode attention. Shapes per the module docstring;
-    ``block_kvh`` KV heads are processed per grid step (tuned knob)."""
+    ``block_kvh`` KV heads are processed per grid step (tuned knob).
+    ``k_pages``/``v_pages`` may be int8 ``QuantizedKV`` arenas — the
+    kernel then streams int8 pages + scales and dequantizes in VMEM."""
     from jax.experimental.pallas import tpu as pltpu
 
+    from ..quantization.kv import is_quantized
+
+    quant = is_quantized(k_pages)
+    if quant != is_quantized(v_pages):
+        raise ValueError("k_pages and v_pages must share quantization")
+    k_arr = k_pages.q if quant else k_pages
     b, sq, h, d = (int(x) for x in q.shape)
     if sq != 1:
         raise ValueError(
             f"paged attention is the decode step: one token per row "
             f"(q [B, 1, H, D]), got S={sq}"
         )
-    n, ps, kvh, dk = (int(x) for x in k_pages.shape)
+    n, ps, kvh, dk = (int(x) for x in k_arr.shape)
     if dk != d:
         raise ValueError(f"head_dim mismatch: q D={d}, pages D={dk}")
     if h % kvh:
@@ -239,17 +310,27 @@ def paged_attention_fused(q, k_pages, v_pages, page_table, pos,
     table = page_table.astype(jnp.int32)
     posv = pos.astype(jnp.int32)
 
+    page_spec = pl.BlockSpec(
+        (1, ps, bkvh, d), lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (1, ps, bkvh), lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j)
+    )
+    q_spec = pl.BlockSpec((1, 1, g, d),
+                          lambda i, j, p, tbl, ps_: (i, j, 0, 0))
+    if quant:
+        in_specs = [q_spec, page_spec, scale_spec, page_spec, scale_spec]
+        operands = (table, posv, qh, k_pages.q, k_pages.scale,
+                    v_pages.q, v_pages.scale)
+        kernel = _paged_kernel_quant
+    else:
+        in_specs = [q_spec, page_spec, page_spec]
+        operands = (table, posv, qh, k_pages, v_pages)
+        kernel = _paged_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,       # (page_table, pos)
         grid=(b, kvh // bkvh, pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d),
-                         lambda i, j, p, tbl, ps_: (i, j, 0, 0)),
-            pl.BlockSpec((1, ps, bkvh, d),
-                         lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j, 0)),
-            pl.BlockSpec((1, ps, bkvh, d),
-                         lambda i, j, p, tbl, ps_: (tbl[i, p], 0, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d),
                                lambda i, j, p, tbl, ps_: (i, j, 0, 0)),
         scratch_shapes=[
@@ -259,26 +340,31 @@ def paged_attention_fused(q, k_pages, v_pages, page_table, pos,
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=float(scale), page_size=ps,
+            kernel, scale=float(scale), page_size=ps,
             pages=pages, group=group, out_dtype=q.dtype,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh // bkvh, g, d), q.dtype),
         interpret=_interpret(),
-    )(table, posv, qh, k_pages, v_pages)
+    )(*operands)
     # [B, kvH/bkvh, g, D] -> [B, 1, H, D]
     return out.reshape(b, 1, h, d)
 
 
-def paged_attention_select(b, pages, page_size, h, kvh, d):
+def paged_attention_select(b, pages, page_size, h, kvh, d,
+                           quantized=False):
     """Tune-cache OPT-IN selection: the kernel's config when a measured
     entry exists for this exact shape on this device, else None (the
     engine keeps the composed gather path byte-identical). Stale cached
     configs are counted, one-shot-warned fallbacks; a measured
-    composed-wins verdict is honored as a policy decision."""
+    composed-wins verdict is honored as a policy decision. Int8 arenas
+    tune under their own signature (``..._q8``) — the int8 kernel's
+    bandwidth/compute profile is different hardware behavior, so a bf16
+    measurement must never activate the quantized kernel untested."""
     from . import autotune
 
-    sig = autotune.paged_attention_sig(b, pages, page_size, h, kvh, d)
+    sig = autotune.paged_attention_sig(b, pages, page_size, h, kvh, d,
+                                       quant=quantized)
     entry = autotune.lookup_entry("paged_attention", sig)
     if entry is None:
         return None

@@ -52,6 +52,34 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
 
 DEFAULT_CACHE_DTYPE = "bfloat16"
 
+# the full set of KV-cache storage dtypes the decode paths implement:
+# fp32 (bit-exact parity with the cacheless forward), bf16 (the serving
+# default), int8 (quantized storage + per-token scales — see
+# quantization/kv.py). Anything else fails HERE, at the API seam, with
+# the allowed set — not deep inside jnp after the cache is allocated.
+ALLOWED_CACHE_DTYPES = ("float32", "bfloat16", "int8")
+
+
+def normalize_cache_dtype(cache_dtype):
+    """Validate a ``cache_dtype`` knob value -> canonical dtype name.
+    ``None`` means the default. Raises ValueError naming the allowed
+    set for anything the cache paths do not implement."""
+    if cache_dtype is None:
+        return DEFAULT_CACHE_DTYPE
+    try:
+        name = jnp.dtype(cache_dtype).name
+    except TypeError:
+        raise ValueError(
+            f"unknown cache_dtype {cache_dtype!r}; allowed: "
+            f"{ALLOWED_CACHE_DTYPES}"
+        ) from None
+    if name not in ALLOWED_CACHE_DTYPES:
+        raise ValueError(
+            f"cache_dtype {cache_dtype!r} is not a supported KV-cache "
+            f"storage dtype; allowed: {ALLOWED_CACHE_DTYPES}"
+        )
+    return name
+
 # monotonic per-net token for trace-guard keys: id(net) would be reused
 # after GC, merging a dead net's compile history (and _fired state) into
 # a new net's
@@ -65,13 +93,23 @@ def alloc_kv_caches(cfg, B, S_max, cache_dtype=None):
     programs here, the serving engine's slot slab, and the bucketed
     ``serving.kv_pool`` blocks all allocate through this (bf16 default —
     halves decode HBM vs the old unconditional fp32; the attention path
-    upcasts to the compute dtype at the matmul)."""
-    dtype = jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE)
+    upcasts to the compute dtype at the matmul). ``"int8"`` allocates
+    quantized storage (int8 values + per-token fp32 scales as one
+    :class:`~..quantization.kv.QuantizedKV` pytree per array — halves
+    resident bytes again; the write paths quantize, the reads
+    dequantize)."""
+    name = normalize_cache_dtype(cache_dtype)
+    shape = (B, S_max, cfg.kv_heads, cfg.head_dim)
+    if name == "int8":
+        from ..quantization.kv import alloc_quantized
+
+        return [
+            (alloc_quantized(shape), alloc_quantized(shape))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+    dtype = jnp.dtype(name)
     return [
-        (
-            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim), dtype),
-            jnp.zeros((B, S_max, cfg.kv_heads, cfg.head_dim), dtype),
-        )
+        (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
         for _ in range(cfg.num_hidden_layers)
     ]
 
@@ -353,7 +391,7 @@ class GreedyDecoder:
             raise ValueError("max_new_tokens must be >= 1")
         self.layer = _make_greedy_mod()(
             net, int(max_new_tokens), eos_token_id, int(num_beams),
-            str(jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE)),
+            normalize_cache_dtype(cache_dtype),
         )
 
     def save(self, path, input_spec):
@@ -392,7 +430,7 @@ def generate(net, input_ids, max_new_tokens=32, do_sample=False,
             "num_beams > 1 is deterministic beam search; combine with "
             "do_sample=False (sampled beam search is not implemented)"
         )
-    cache_dtype = str(jnp.dtype(cache_dtype or DEFAULT_CACHE_DTYPE))
+    cache_dtype = normalize_cache_dtype(cache_dtype)
     cache = net.__dict__.setdefault("_generate_cache", {})
     if num_beams > 1:
         # sampling knobs are ignored by the beam program: normalize them

@@ -140,10 +140,11 @@ class LlamaAttention(nn.Layer):
                 )
             from ..kernels import autotune
             from ..kernels.paged_attention import (
-                gather_pages,
+                gather_pages_dense,
                 paged_attention_apply,
                 paged_attention_select,
             )
+            from ..quantization import kv as qkv
 
             k_pages, v_pages = cache
             tbl = jnp.asarray(
@@ -154,22 +155,20 @@ class LlamaAttention(nn.Layer):
             P = int(tbl.shape[1])
             p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
             # scatter this step's k/v at each row's (page, offset);
-            # free rows land on the reserved garbage page 0
+            # free rows land on the reserved garbage page 0 (an int8
+            # arena quantizes-on-scatter — quantization/kv.py)
             pp = jnp.take_along_axis(tbl, (p // ps)[:, None],
                                      axis=1)[:, 0]
             po = p % ps
-            k_pages = k_pages.at[pp, po].set(
-                k.value[:, 0].astype(k_pages.dtype)
-            )
-            v_pages = v_pages.at[pp, po].set(
-                v.value[:, 0].astype(v_pages.dtype)
-            )
+            k_pages = qkv.write_paged(k_pages, k.value[:, 0], pp, po)
+            v_pages = qkv.write_paged(v_pages, v.value[:, 0], pp, po)
             # the fused kernel bakes in pure positional masking — an
             # explicit attn_mask must decode through the composed path
             sel = None if attn_mask is not None else (
                 paged_attention_select(
                     B, P, ps, cfg.num_attention_heads, cfg.kv_heads,
                     cfg.head_dim,
+                    quantized=qkv.is_quantized(k_pages),
                 )
             )
             if sel is not None:
@@ -183,10 +182,11 @@ class LlamaAttention(nn.Layer):
             # default: composed gather + the SAME masked-SDPA the slab
             # per-row branch below decodes through — token streams stay
             # bit-identical to the slab engine and net.generate (extra
-            # masked columns contribute exact zeros)
+            # masked columns contribute exact zeros; int8 arenas
+            # dequant-on-gather to the compute dtype)
             autotune.note_selection("paged_attention", "composed:gather")
-            kk = Tensor(gather_pages(k_pages, tbl))
-            vv = Tensor(gather_pages(v_pages, tbl))
+            kk = Tensor(gather_pages_dense(k_pages, tbl, q.value.dtype))
+            vv = Tensor(gather_pages_dense(v_pages, tbl, q.value.dtype))
             S_virt = P * ps
             if cfg.kv_heads != cfg.num_attention_heads:
                 rep = cfg.num_attention_heads // cfg.kv_heads
@@ -208,18 +208,15 @@ class LlamaAttention(nn.Layer):
                 (k_pages, v_pages),
             )
         if cache is not None:
+            from ..quantization import kv as qkv
+
             k_cache, v_cache = cache
             S_max = k_cache.shape[1]
             p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
             if p.ndim == 0:
                 # whole-batch position (generate's prefill + scan)
-                z = jnp.zeros((), p.dtype)  # index dtypes must match p's
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.value.astype(k_cache.dtype), (z, p, z, z)
-                )
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.value.astype(v_cache.dtype), (z, p, z, z)
-                )
+                k_cache = qkv.write_at_pos(k_cache, k.value, p)
+                v_cache = qkv.write_at_pos(v_cache, v.value, p)
                 # mask[t, s]: token (p+t) may read cache slot s iff s <= p+t
                 valid = (
                     jnp.arange(S_max)[None, :]
@@ -232,15 +229,14 @@ class LlamaAttention(nn.Layer):
                 # k/v at every row's own offset
                 rows = jnp.arange(B)[:, None]
                 cols = p[:, None] + jnp.arange(S)[None, :]  # [B, S]
-                k_cache = k_cache.at[rows, cols].set(
-                    k.value.astype(k_cache.dtype)
-                )
-                v_cache = v_cache.at[rows, cols].set(
-                    v.value.astype(v_cache.dtype)
-                )
+                k_cache = qkv.write_at_rows(k_cache, k.value, rows, cols)
+                v_cache = qkv.write_at_rows(v_cache, v.value, rows, cols)
                 valid = jnp.arange(S_max)[None, None, :] <= cols[:, :, None]
                 mask = jnp.where(valid, 0.0, -jnp.inf)[:, None, :, :]
-            kk, vv = Tensor(k_cache), Tensor(v_cache)
+            # int8 caches dequantize-on-read to the compute dtype; plain
+            # caches pass through untouched (SDPA upcasts at the matmul)
+            kk = Tensor(qkv.read_dense(k_cache, q.value.dtype))
+            vv = Tensor(qkv.read_dense(v_cache, q.value.dtype))
             if cfg.kv_heads != cfg.num_attention_heads:
                 rep = cfg.num_attention_heads // cfg.kv_heads
                 kk = kk.repeat_interleave(rep, axis=2)
@@ -400,8 +396,13 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
 
     def _head_fusion(self, n_rows):
         """Tune-cache OPT-IN fused rms_norm+lm_head config (None keeps
-        the unfused norm -> linear path byte-identical)."""
-        if self.lm_head is None:
+        the unfused norm -> linear path byte-identical). A quantized
+        head (``quantize_for_serving``: int8 weight + scale buffers, no
+        dense ``.weight``) owns its own fused/composed selection — the
+        float norm+matmul fusion cannot absorb it."""
+        if self.lm_head is None or getattr(
+            self.lm_head, "weight", None
+        ) is None:
             return None
         from ..kernels.fused_norm_matmul import head_fusion_select
 

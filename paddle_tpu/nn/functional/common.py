@@ -15,6 +15,13 @@ from ...ops.manipulation import pad  # re-export, paddle exposes F.pad  # noqa: 
 
 
 def _linear(x, w, b):
+    # AMP O3: inside an armed fp8 context (CompiledTrainStep traces
+    # with amp_level="O3") the matmul runs with e4m3 operands and
+    # delayed per-tensor scaling; one thread-local read otherwise
+    from ...amp import fp8
+
+    if fp8.active():
+        return fp8.fp8_linear_value(x, w, b)
     y = jnp.matmul(x, w)
     if b is not None:
         y = y + b

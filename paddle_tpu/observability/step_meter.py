@@ -240,6 +240,13 @@ class StepMeter:
             prom_name=f"{ns}_batch_tokens",
             help="tokens per step",
         )
+        self.fp8_bytes_saved = Gauge(
+            "amp_fp8_matmul_bytes_saved", unit="bytes",
+            prom_name=f"{ns}_amp_fp8_matmul_bytes_saved",
+            help="analytic HBM bytes per step the AMP O3 fp8 matmul "
+                 "routing avoids moving (weight operands at 1 byte "
+                 "instead of their stored width); 0 when O3 is off",
+        )
         self.device_bytes_in_use = Gauge(
             "device_bytes_in_use", unit="bytes",
             prom_name="paddle_device_bytes_in_use",
@@ -261,6 +268,7 @@ class StepMeter:
             self.examples, self.tokens,
             self.tokens_per_second, self.examples_per_second, self.mfu,
             self.loss, self.grad_norm, self.batch_tokens,
+            self.fp8_bytes_saved,
             self.device_bytes_in_use, self.device_peak_bytes,
             self.device_live_arrays,
         ])
@@ -322,6 +330,12 @@ class StepMeter:
     # idle gaps beyond this are a run break (eval phase, user pause),
     # not a slow step — fall back to the caller's host measurement
     MAX_STEP_GAP_S = 60.0
+
+    def note_fp8_bytes_saved(self, n):
+        """AMP O3 reports the analytic per-step weight-HBM delta of the
+        fp8 matmul routing here (a static trace-time number — no device
+        sync)."""
+        self.fp8_bytes_saved.set(float(n))
 
     def note_blocked(self, seconds):
         """Report a train-loop stall that is NOT step work — checkpoint

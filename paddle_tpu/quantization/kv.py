@@ -1,0 +1,223 @@
+"""Int8 KV-cache storage — real narrow-dtype residency for serving.
+
+The bf16 KV caches already halved decode HBM vs fp32; this module
+halves it again: K/V live as **int8 values + per-(slot, kv-head) fp32
+scales** (symmetric absmax over the head dim), so a resident token
+costs ``kvH * (D + 4)`` bytes instead of ``kvH * D * 2``. At flagship
+head dims (D=128) that is ~1.94x fewer bytes per resident token —
+compounding multiplicatively with the paged pool's per-length claims
+(PR 7) at the millions-of-users concurrency ceiling.
+
+Design contract (every call site shares these invariants):
+
+- :class:`QuantizedKV` is a registered jax pytree, so the engines'
+  flat cache lists, jit carries, scans and donation all work unchanged
+  — a cache entry is simply two leaves (``q`` int8, ``scale`` fp32)
+  instead of one.
+- **Quantize-on-write**: every cache write path (prefill's
+  ``dynamic_update_slice``, the per-row decode scatter, the paged
+  (page, offset) scatter, slab/page adoption) quantizes the incoming
+  tokens with :func:`quantize_kv` — per token, per kv head, absmax/127
+  — so the SAME token quantizes identically in ``net.generate``, the
+  slab engine and the paged engine (quantized token streams stay
+  exact-equal across all three; tier-1-pinned).
+- **Dequant-on-read**: the composed attention paths dequantize the
+  gathered cache to the compute dtype right before the masked SDPA;
+  the tuned paged-attention kernel dequantizes page blocks in VMEM
+  instead (the int8 arrays are what crosses HBM either way).
+- Zero-initialized storage dequantizes to exact zeros (garbage pages /
+  masked columns keep contributing exact 0 through the fp32 softmax —
+  the discipline that makes recycled slots safe without scrubbing).
+
+Accuracy is a *ratcheted budget*, not a vibe: ``tests/test_serving.py``
+pins the greedy-decode agreement length and the prefill-logit
+max-abs-err of int8-KV decode against the bf16 baseline.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# symmetric int8: values in [-127, 127] (the -128 code is unused so the
+# scale maps absmax exactly onto the grid edge)
+QMAX = 127.0
+# absmax floor: an all-zero token must quantize to (0, tiny-scale) and
+# dequantize to exact 0 rather than divide by zero
+_EPS = 1e-8
+
+# dtype names alloc_kv_caches accepts (the models/generation API seam
+# validates against this set — see normalize_cache_dtype there)
+QUANT_CACHE_DTYPES = ("int8",)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedKV:
+    """One quantized cache array: ``q`` int8 ``[..., S, kvH, D]`` plus
+    ``scale`` fp32 ``[..., S, kvH]`` (one scale per stored token per kv
+    head). Behaves as a pytree of its two leaves, so jit carries, scan,
+    flatten and donation treat it like any cache array pair."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # the pool/engine dtype checks read `.dtype` off cache arrays
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def __repr__(self):
+        return (f"QuantizedKV(q={getattr(self.q, 'shape', None)}, "
+                f"scale={getattr(self.scale, 'shape', None)})")
+
+
+def is_quantized(cache):
+    return isinstance(cache, QuantizedKV)
+
+
+def alloc_quantized(shape):
+    """Zeroed int8 storage + zeroed scales for a cache of logical shape
+    ``[..., S, kvH, D]`` (zero scales dequantize to exact zeros)."""
+    return QuantizedKV(
+        jnp.zeros(shape, jnp.int8),
+        jnp.zeros(shape[:-1], jnp.float32),
+    )
+
+
+def quantize_kv(x):
+    """``[..., D]`` float -> (int8 values ``[..., D]``, fp32 scales
+    ``[...]``). Symmetric per-vector absmax: scale = max|x| / 127."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax, _EPS) / QMAX
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -QMAX, QMAX
+    ).astype(jnp.int8)  # tpu-lint: quant
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype):
+    """int8 values + scales -> dense array in the compute ``dtype``."""
+    return (
+        q.astype(jnp.float32) * scale[..., None]
+    ).astype(dtype)  # tpu-lint: quant
+
+
+def kv_token_bytes(kv_heads, head_dim, dtype):
+    """HBM bytes ONE cached token costs per K-or-V array in ``dtype``
+    (int8 counts its fp32 scale overhead — the equal-HBM concurrency
+    comparisons must not flatter quantized pools)."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.int8:
+        return kv_heads * (head_dim * dt.itemsize
+                           + jnp.dtype(jnp.float32).itemsize)
+    return kv_heads * head_dim * dt.itemsize
+
+
+# ------------------------------------------------------------- cache writes
+#
+# Each helper mirrors one existing bf16 write site in models/llama.py /
+# the serving adopt programs, handling both plain cache arrays (exactly
+# today's op sequence — byte-identical behavior) and QuantizedKV.
+
+
+def write_at_pos(cache, val, pos):
+    """Prefill / whole-batch decode write: ``val`` ``[B, S, kvH, D]``
+    lands at positions ``[pos, pos + S)`` (scalar traced ``pos``)."""
+    z = jnp.zeros((), pos.dtype)
+    if is_quantized(cache):
+        q, s = quantize_kv(val)
+        return QuantizedKV(
+            jax.lax.dynamic_update_slice(cache.q, q, (z, pos, z, z)),
+            jax.lax.dynamic_update_slice(cache.scale, s, (z, pos, z)),
+        )
+    return jax.lax.dynamic_update_slice(
+        cache, val.astype(cache.dtype), (z, pos, z, z)
+    )
+
+
+def write_at_rows(cache, val, rows, cols):
+    """Per-row decode write (continuous batching): ``val`` ``[B, S,
+    kvH, D]`` scattered at each row's own depth (``rows``/``cols`` as
+    in the slab decode path)."""
+    if is_quantized(cache):
+        q, s = quantize_kv(val)
+        return QuantizedKV(
+            cache.q.at[rows, cols].set(q),
+            cache.scale.at[rows, cols].set(s),
+        )
+    return cache.at[rows, cols].set(val.astype(cache.dtype))
+
+
+def write_paged(cache, val, page, offset):
+    """Paged decode write: ``val`` ``[B, kvH, D]`` (this step's token
+    per row) scattered at each row's ``(page, offset)``."""
+    if is_quantized(cache):
+        q, s = quantize_kv(val)
+        return QuantizedKV(
+            cache.q.at[page, offset].set(q),
+            cache.scale.at[page, offset].set(s),
+        )
+    return cache.at[page, offset].set(val.astype(cache.dtype))
+
+
+def read_dense(cache, dtype):
+    """The composed attention read: the full cache as a dense array in
+    the compute ``dtype`` (dequant-on-read for int8; pass-through for
+    plain arrays — attention upcasts at the matmul as before)."""
+    if is_quantized(cache):
+        return dequantize_kv(cache.q, cache.scale, dtype)
+    return cache
+
+
+# ----------------------------------------------------------- adopt programs
+
+
+def adopt_into_slab(dst, blk, slot):
+    """One leaf of the slab engine's adopt program: copy a prefilled
+    ``[1, bucket, ...]`` block into decode row ``slot`` (traced)."""
+    z = jnp.zeros((), slot.dtype)
+    if is_quantized(dst):
+        return QuantizedKV(
+            jax.lax.dynamic_update_slice(dst.q, blk.q, (slot, z, z, z)),
+            jax.lax.dynamic_update_slice(dst.scale, blk.scale,
+                                         (slot, z, z)),
+        )
+    return jax.lax.dynamic_update_slice(
+        dst, blk.astype(dst.dtype), (slot, z, z, z)
+    )
+
+
+def adopt_into_pages(arena, blk, page_ids, n_pages, page_size):
+    """One leaf of the paged engine's adopt program: scatter a
+    prefilled ``[1, bucket, ...]`` block into the arena as ``n_pages``
+    whole pages at traced ``page_ids`` (tail ids -> garbage page 0)."""
+    if is_quantized(arena):
+        kvh = blk.q.shape[2]
+        d = blk.q.shape[3]
+        return QuantizedKV(
+            arena.q.at[page_ids].set(
+                blk.q[0].reshape(n_pages, page_size, kvh, d)
+            ),
+            arena.scale.at[page_ids].set(
+                blk.scale[0].reshape(n_pages, page_size, kvh)
+            ),
+        )
+    b = blk
+    return arena.at[page_ids].set(
+        b[0].reshape(n_pages, page_size, b.shape[2],
+                     b.shape[3]).astype(arena.dtype)
+    )

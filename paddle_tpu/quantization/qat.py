@@ -74,10 +74,12 @@ class ObservedLayer(Layer):
 
 
 # layers the walker must never descend into (their _inner would be
-# matched and double-wrapped)
+# matched and double-wrapped). QuantizedLinear (serving.py) matches by
+# name to avoid a circular import: wrapping an already-int8 layer in a
+# fake-quant wrapper (or re-quantizing it) would double-round weights.
 def _is_quant_layer(layer):
     return isinstance(layer, (QuantedWrapper, ObservedLayer)) or (
-        type(layer).__name__ == "_ObservingWrapper"
+        type(layer).__name__ in ("_ObservingWrapper", "QuantizedLinear")
     )
 
 

@@ -232,11 +232,10 @@ class ServingEngine:
             return fn
 
         def body(flat_decode, flat_block, slot):
-            z = jnp.zeros((), slot.dtype)
+            from ..quantization.kv import adopt_into_slab
+
             return [
-                jax.lax.dynamic_update_slice(
-                    d, b.astype(d.dtype), (slot, z, z, z)
-                )
+                adopt_into_slab(d, b, slot)
                 for d, b in zip(flat_decode, flat_block)
             ]
 

@@ -25,7 +25,11 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..models.generation import DEFAULT_CACHE_DTYPE, alloc_kv_caches
+from ..models.generation import (
+    DEFAULT_CACHE_DTYPE,
+    alloc_kv_caches,
+    normalize_cache_dtype,
+)
 
 
 def bucket_for(seq_len, min_bucket=16, max_seq_len=None):
@@ -105,7 +109,7 @@ class KVCachePool:
     def __init__(self, config, *, dtype=None, min_bucket=16,
                  max_seq_len=4096, max_blocks=None):
         self.config = config
-        self.dtype = jnp.dtype(dtype or DEFAULT_CACHE_DTYPE)
+        self.dtype = jnp.dtype(normalize_cache_dtype(dtype))
         self.min_bucket = int(min_bucket)
         self.max_seq_len = int(max_seq_len)
         self.max_blocks = max_blocks  # live-block cap (None = unbounded)
@@ -184,10 +188,14 @@ class KVCachePool:
         return self._live_blocks + sum(s.claimed for s in self._slabs)
 
     def _bytes(self, bucket, rows=1):
+        from ..quantization.kv import kv_token_bytes
+
         cfg = self.config
+        # int8 counts its per-token fp32 scale overhead — residency
+        # numbers must not flatter quantized caches
         return (
             2 * cfg.num_hidden_layers * rows * bucket
-            * cfg.kv_heads * cfg.head_dim * self.dtype.itemsize
+            * kv_token_bytes(cfg.kv_heads, cfg.head_dim, self.dtype)
         )
 
     def stats(self):

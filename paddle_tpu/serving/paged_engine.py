@@ -207,13 +207,12 @@ class PagedServingEngine(ServingEngine):
         n_pages_b = bucket // ps
 
         def body(flat_arena, flat_block, page_ids):
-            out = []
-            for a, b in zip(flat_arena, flat_block):
-                blk = b[0].reshape(
-                    n_pages_b, ps, b.shape[2], b.shape[3]
-                ).astype(a.dtype)
-                out.append(a.at[page_ids].set(blk))
-            return out
+            from ..quantization.kv import adopt_into_pages
+
+            return [
+                adopt_into_pages(a, b, page_ids, n_pages_b, ps)
+                for a, b in zip(flat_arena, flat_block)
+            ]
 
         fn = jax.jit(
             body, donate_argnums=(0,) if self._donate else ()

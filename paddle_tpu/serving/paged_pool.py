@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..models.generation import DEFAULT_CACHE_DTYPE
+from ..models.generation import normalize_cache_dtype
 
 
 class PagesExhausted(RuntimeError):
@@ -65,7 +65,12 @@ class PagedKVPool:
         if self.num_pages < 1:
             raise ValueError("need at least one usable page")
         self.max_seq_len = int(max_seq_len)
-        self.dtype = jnp.dtype(dtype or DEFAULT_CACHE_DTYPE)
+        # saved-artifact accounting pools carry no model config and
+        # never allocate arrays — any dtype name is just a label there
+        self.dtype = jnp.dtype(
+            normalize_cache_dtype(dtype) if config is not None
+            else (dtype or "bfloat16")
+        )
         # ids 1..num_pages are claimable; 0 is the garbage page
         self._free = list(range(1, self.num_pages + 1))[::-1]
         self._claimed = set()
@@ -90,10 +95,20 @@ class PagedKVPool:
     def alloc_arena_arrays(self):
         """The page arena in the shared cache layout:
         ``[num_pages + 1, page_size, kvH, D]`` x2 per layer (row 0 =
-        garbage page), pool dtype."""
+        garbage page), pool dtype. An int8 pool allocates quantized
+        storage (int8 values + per-(slot, kvH) fp32 scales as one
+        ``QuantizedKV`` pytree per array; zero scales keep the garbage
+        page dequantizing to exact zeros)."""
         cfg = self.config
         shape = (self.num_pages + 1, self.page_size, cfg.kv_heads,
                  cfg.head_dim)
+        if self.dtype == jnp.int8:
+            from ..quantization.kv import alloc_quantized
+
+            return [
+                (alloc_quantized(shape), alloc_quantized(shape))
+                for _ in range(cfg.num_hidden_layers)
+            ]
         return [
             (jnp.zeros(shape, self.dtype), jnp.zeros(shape, self.dtype))
             for _ in range(cfg.num_hidden_layers)
@@ -153,8 +168,12 @@ class PagedKVPool:
         cfg = self.config
         if cfg is None:
             return 0
+        from ..quantization.kv import kv_token_bytes
+
+        # int8 pages count their per-token fp32 scale overhead: the
+        # equal-HBM concurrency comparison must not flatter quantization
         return (2 * cfg.num_hidden_layers * self.page_size
-                * cfg.kv_heads * cfg.head_dim * self.dtype.itemsize)
+                * kv_token_bytes(cfg.kv_heads, cfg.head_dim, self.dtype))
 
     def request_resident_bytes(self, total_tokens):
         """Resident KV bytes one admitted request costs in this pool —

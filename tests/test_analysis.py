@@ -66,6 +66,56 @@ def test_dtype_churn_positive_bulk_upcast():
                for f in rep)
 
 
+def test_dtype_churn_quant_whitelist_by_function_name():
+    """An int8 quant-dequant convert chain issued from a function whose
+    name matches the quant pattern is intentional narrow-dtype
+    execution, not churn (the PR 9 kernels land with 0 baseline
+    growth)."""
+    def _quantize_roundtrip(x):
+        q = jnp.clip(jnp.round(x / 0.5), -127, 127).astype(jnp.int8)
+        return q.astype(jnp.float32) * 0.5
+
+    rep = analysis.lint_fn(_quantize_roundtrip,
+                           jnp.ones((4,), jnp.float32), graph="g")
+    assert "dtype-churn" not in rules_of(rep)
+
+
+def test_dtype_churn_quant_whitelist_by_marker():
+    """The explicit ``# tpu-lint: quant`` source marker whitelists a
+    chain through a quant dtype even in a neutrally-named function."""
+    def _helper(x):
+        y = x.astype(jnp.int8)
+        return y.astype(jnp.float32)  # tpu-lint: quant
+
+    rep = analysis.lint_fn(_helper, jnp.ones((4,), jnp.float32),
+                           graph="g")
+    assert "dtype-churn" not in rules_of(rep)
+
+
+def test_dtype_churn_untagged_quant_chain_still_fires():
+    """No tag, no mercy: an int8 chain in a neutrally-named function
+    without the marker is still reported (it may well be churn)."""
+    def _helper(x):
+        y = x.astype(jnp.int8)
+        return y.astype(jnp.float32)
+
+    rep = analysis.lint_fn(_helper, jnp.ones((4,), jnp.float32),
+                           graph="g")
+    assert any(f.rule == "dtype-churn" for f in rep)
+
+
+def test_dtype_churn_wide_chain_in_quant_named_fn_still_fires():
+    """The whitelist needs BOTH a quant dtype in the chain and a tag —
+    a bf16/f32 round trip does not get a pass just because it lives in
+    a quant-named function."""
+    def _quantize_helper(x):
+        return x.astype(jnp.float32).astype(jnp.bfloat16)
+
+    rep = analysis.lint_fn(_quantize_helper,
+                           jnp.ones((4,), jnp.bfloat16), graph="g")
+    assert any(f.rule == "dtype-churn" for f in rep)
+
+
 def test_dtype_churn_negative():
     def f(x):
         return (x.astype(jnp.float32) * 2).astype(jnp.bfloat16)

@@ -1,0 +1,166 @@
+"""AMP O3: fp8 train-step matmuls with per-tensor delayed scaling.
+
+The acceptance pin: an O3 (e4m3 fwd / e5m2 bwd) tiny-llama training
+run must track the bf16 (O1) loss curve within the pinned tolerance,
+with the delayed-scaling state carried through the compiled step and
+the analytic HBM delta reported through the StepMeter.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import fp8
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trainer import CompiledTrainStep
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+# ------------------------------------------------------------ unit level
+def test_fp8_dot_quantization_error_bounded():
+    """e4m3 has ~2 mantissa-bit steps at full scale: the fp8 product
+    must track the fp32 product within e4m3's relative error budget."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    w = jnp.asarray(rng.randn(64, 32), jnp.float32)
+    one = jnp.float32(1.0)
+    out = fp8._fp8_dot("float32", "float32", x, w, one, one)
+    ref = np.asarray(x) @ np.asarray(w)
+    err = np.abs(np.asarray(out) - ref).max()
+    # operands ~N(0,1): elementwise e4m3 error ~6%, dot over 64 terms
+    # partially cancels (measured ~0.88 abs / 3.4% of the output range)
+    assert err < 0.05 * np.abs(ref).max(), err
+    assert not np.allclose(np.asarray(out), ref)  # it IS quantized
+
+
+def test_fp8_dot_backward_e5m2_and_dtypes():
+    """Gradients flow through the e5m2 backward with cotangent dtypes
+    matching the primals (bf16 primals get bf16 grads)."""
+    rng = np.random.RandomState(1)
+    for dt in (jnp.float32, jnp.bfloat16):
+        x = jnp.asarray(rng.randn(8, 16), dt)
+        w = jnp.asarray(rng.randn(16, 8), dt)
+        one = jnp.float32(1.0)
+
+        def f(xv, wv):
+            return fp8._fp8_dot(jnp.dtype(dt).name, jnp.dtype(dt).name,
+                                xv, wv, one, one).sum()
+
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+        assert gx.dtype == dt and gw.dtype == dt
+        # direction sanity vs the exact gradient of the float dot
+        ex = np.ones((8, 8)) @ np.asarray(w, np.float32).T
+        cos = (np.asarray(gx, np.float32) * ex).sum() / (
+            np.linalg.norm(np.asarray(gx, np.float32))
+            * np.linalg.norm(ex) + 1e-9
+        )
+        assert cos > 0.98, cos
+
+
+def test_delayed_scale_from_history():
+    """An empty history quantizes at scale 1; a filled history uses its
+    max amax; new amaxes roll in at slot 0."""
+    h = jnp.zeros((fp8.HISTORY_LEN,), jnp.float32)
+    assert float(fp8._delayed_scale(h, fp8.E4M3_MAX)) == 1.0
+    h = fp8._roll_in(h, jnp.float32(896.0))
+    assert float(h[0]) == 896.0
+    assert float(fp8._delayed_scale(h, fp8.E4M3_MAX)) == pytest.approx(
+        896.0 / 448.0
+    )
+    h2 = fp8._roll_in(h, jnp.float32(1.0))
+    assert float(h2[0]) == 1.0 and float(h2[1]) == 896.0
+    # the window slides: the old max eventually falls out
+    for _ in range(fp8.HISTORY_LEN):
+        h2 = fp8._roll_in(h2, jnp.float32(2.0))
+    assert float(fp8._delayed_scale(h2, fp8.E4M3_MAX)) == pytest.approx(
+        2.0 / 448.0
+    )
+
+
+def test_fp8_autocast_collects_sites_in_call_order():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+    w1 = jnp.asarray(rng.randn(8, 8), jnp.float32)
+    w2 = jnp.asarray(rng.randn(8, 4), jnp.float32)
+    with fp8.fp8_autocast(None) as ctx:
+        y = fp8.fp8_linear_value(x, w1, None)
+        fp8.fp8_linear_value(y, w2, None)
+    assert sorted(ctx.new_state) == [
+        "linear0/w", "linear0/x", "linear1/w", "linear1/x",
+    ]
+    # fp32 weights: 3 bytes saved per element
+    assert ctx.weight_bytes_saved == (8 * 8 + 8 * 4) * 3
+    assert not fp8.active()  # context unwound
+
+
+# ------------------------------------------------------- train-step level
+def _run(amp_level, steps=10):
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=net.parameters()
+    )
+
+    def loss_fn(logits, labels):
+        return paddle.nn.functional.cross_entropy(
+            logits.reshape([-1, 64]), labels.reshape([-1])
+        )
+
+    step = CompiledTrainStep(net, loss_fn, opt, amp_level=amp_level)
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(steps):
+        x = Tensor(jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32))
+        y = Tensor(jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32))
+        loss, _ = step([x], [y])
+        losses.append(float(loss.numpy()))
+    return losses, step
+
+
+def test_o3_loss_curve_tracks_bf16_within_tolerance():
+    """The parity gate: O3's loss curve stays within 3% of O1's at
+    every step on the tiny flagship (measured ~0.6%), and the model
+    actually trains (the last losses improve on the first)."""
+    lb, _ = _run("O1")
+    l8, st = _run("O3")
+    rel = max(abs(a - b) / max(abs(a), 1e-6) for a, b in zip(lb, l8))
+    assert rel < 0.03, (rel, lb, l8)
+    assert min(l8[-3:]) < l8[0]
+    # the delayed-scaling state: one x + one w history per linear
+    # (2 layers x 6 projections + lm_head = 13 matmul sites)
+    assert len(st._fp8_state) == 26
+    h = np.asarray(st._fp8_state["linear0/w"])
+    assert h.shape == (fp8.HISTORY_LEN,)
+    assert (h > 0).sum() == 10  # one amax rolled in per step
+    # analytic HBM delta: every linear weight moved at 1 byte instead
+    # of 4 (fp32 params under O1-style autocast arrive bf16 -> 1 saved
+    # per elem at minimum); reported via the StepMeter gauge
+    assert st._fp8_bytes_saved > 0
+    from paddle_tpu import observability as obs
+
+    assert obs.get_step_meter().fp8_bytes_saved.value() == pytest.approx(
+        float(st._fp8_bytes_saved)
+    )
+
+
+def test_o3_state_is_device_carried_not_host():
+    """The histories come back as device arrays (no host sync in the
+    step loop) and advance step to step."""
+    _, st = _run("O3", steps=3)
+    leaf = st._fp8_state["linear0/x"]
+    assert isinstance(leaf, jax.Array)
+    assert int((np.asarray(leaf) > 0).sum()) == 3
+
+
+def test_o1_and_o2_unaffected_by_fp8_plumbing():
+    """Non-O3 levels must carry NO fp8 state and keep training."""
+    for level in ("O1", "O2", None):
+        losses, st = _run(level, steps=3)
+        assert st._fp8_state is None
+        assert len(losses) == 3

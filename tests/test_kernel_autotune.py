@@ -666,3 +666,157 @@ def test_paged_entry_activates_llama_decode_path(tmp_cache):
     assert sel_after.get(k, 0) == sel_before.get(k, 0)  # no fused pick
     np.testing.assert_array_equal(masked, base)
     np.testing.assert_allclose(fused, base, rtol=2e-4, atol=2e-4)
+
+
+# ------------------------------------------------------------ int8 matmul
+def test_int8_matmul_candidates_and_sig():
+    for cfg in at.int8_matmul_candidates(8, 256):
+        assert at.int8_matmul_config_legal(8, 256, cfg), cfg
+    assert not at.int8_matmul_config_legal(8, 256, {"block_rows": 3,
+                                                    "block_cols": 128})
+    assert at.int8_matmul_sig(8, 64, 256) == "r8_h64_n256"
+    # the int8-KV paged flavor is its OWN tuning signature — a bf16
+    # measurement must never activate the quantized kernel untested
+    assert at.paged_attention_sig(2, 4, 8, 4, 2, 16, quant=True) \
+        == "b2_p4_ps8_h4_kv2_d16_q8"
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_int8_matmul_fused_equals_composed(dtype):
+    """The weight-only kernel contract: fused (dequant epilogue in
+    VMEM) == composed (dequant then matmul) EXACTLY under jit, for
+    every legal block config."""
+    from paddle_tpu.kernels import int8_matmul as im
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 64), dtype)
+    wq, sc = im.quantize_weight(
+        jnp.asarray(rng.randn(64, 256), jnp.float32)
+    )
+    comp = jax.jit(lambda a: im.int8_matmul_composed(a, wq, sc))(x)
+    assert comp.dtype == dtype
+    for br, bc in ((8, 128), (16, 256), (4, 128)):
+        fused = jax.jit(
+            lambda a: im.int8_matmul(a, wq, sc, block_rows=br,
+                                     block_cols=bc)
+        )(x)
+        assert (np.asarray(fused, np.float32)
+                == np.asarray(comp, np.float32)).all(), (br, bc)
+    # and the quantized product stays close to the exact dequantized
+    # product (fp32 only — bf16 adds its own output rounding on top)
+    if dtype == jnp.float32:
+        wf = np.asarray(wq, np.float32) * np.asarray(sc)[None, :]
+        ref = np.asarray(x, np.float32) @ wf
+        np.testing.assert_allclose(np.asarray(comp, np.float32), ref,
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_int8_matmul_selection_cache_optin(tmp_cache):
+    """Same discipline as every fused kernel: no entry -> composed;
+    entry -> fused config; measured composed-win refused; stale entry
+    is a counted fallback."""
+    from paddle_tpu.kernels import int8_matmul as im
+
+    sig = at.int8_matmul_sig(8, 64, 256)
+    assert im.int8_matmul_select(8, 64, 256) is None
+
+    at.get_cache().record("int8_matmul", sig,
+                          {"block_rows": 8, "block_cols": 128},
+                          save=False)
+    assert im.int8_matmul_select(8, 64, 256) == {
+        "block_rows": 8, "block_cols": 128}
+    sel = at.selection_counter().series()
+    assert sel.get((("kernel", "int8_matmul"),
+                    ("path", "fused:cached")), 0) >= 1
+
+    at.get_cache().record(
+        "int8_matmul", sig, {"block_rows": 8, "block_cols": 128},
+        extra={"fused_beats_composed": False}, save=False,
+    )
+    assert im.int8_matmul_select(8, 64, 256) is None
+
+    at.get_cache().record("int8_matmul", sig,
+                          {"block_rows": 3, "block_cols": 128},
+                          save=False)  # illegal for rows=8
+    assert im.int8_matmul_select(8, 64, 256) is None
+    fb = at.fallback_counter().series()
+    assert any(
+        dict(k).get("kernel") == "int8_matmul"
+        and dict(k).get("reason") == "stale-config"
+        for k in fb
+    )
+
+
+def test_quantized_linear_activates_fused_from_cache(tmp_cache):
+    """Model-level: a tune-cache entry for the QuantizedLinear's exact
+    shape routes its forward through the fused kernel (selection
+    counted) with output EXACTLY equal to the composed path."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.kernels import int8_matmul as im
+    from paddle_tpu.quantization import QuantizedLinear
+
+    rng = np.random.RandomState(2)
+    wq, sc = im.quantize_weight(
+        jnp.asarray(rng.randn(64, 256), jnp.float32)
+    )
+    lin = QuantizedLinear(wq, sc)
+    x = Tensor(jnp.asarray(rng.randn(8, 64), jnp.float32))
+    base = np.asarray(lin(x).numpy())
+    at.get_cache().record(
+        "int8_matmul", at.int8_matmul_sig(8, 64, 256),
+        {"block_rows": 8, "block_cols": 128}, save=False,
+    )
+    sel_before = at.selection_counter().series()
+    fused = np.asarray(lin(x).numpy())
+    sel_after = at.selection_counter().series()
+    k = (("kernel", "int8_matmul"), ("path", "fused:cached"))
+    assert sel_after.get(k, 0) - sel_before.get(k, 0) >= 1
+    np.testing.assert_array_equal(fused, base)
+
+
+# --------------------------------------------------------- int8 paged KV
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_int8_paged_kernel_bitexact_vs_reference(dtype):
+    """Int8-arena flavor of the kernel contract: bit-identical to the
+    blocked dequant reference under jit, knob-invariant, and the
+    composed dequant-on-gather agrees to float rounding."""
+    from paddle_tpu.kernels import paged_attention as pa
+    from paddle_tpu.quantization.kv import QuantizedKV, quantize_kv
+
+    q, kp, vp, tbl, pos = _paged_fixture(dtype)
+    kq = QuantizedKV(*quantize_kv(kp))
+    vq = QuantizedKV(*quantize_kv(vp))
+    ref = pa.paged_attention_reference(q, kq, vq, tbl, pos)
+    for bk in (1, 2):
+        out = jax.jit(lambda a, k_, v_: pa.paged_attention_fused(
+            a, k_, v_, tbl, pos, block_kvh=bk))(q, kq, vq)
+        assert out.dtype == q.dtype
+        assert (np.asarray(out, np.float32)
+                == np.asarray(ref, np.float32)).all(), bk
+    comp = pa.paged_attention_composed(q, kq, vq, tbl, pos)
+    np.testing.assert_allclose(
+        np.asarray(comp, np.float32), np.asarray(ref, np.float32),
+        rtol=3e-2 if dtype == jnp.bfloat16 else 1e-5,
+        atol=3e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_int8_paged_selection_keyed_by_quant_sig(tmp_cache):
+    """A bf16 entry for the shape must NOT activate the int8 kernel
+    (and vice versa): the quantized flavor selects only under its own
+    ``_q8`` signature."""
+    from paddle_tpu.kernels import paged_attention as pa
+
+    at.get_cache().record(
+        "paged_attention", at.paged_attention_sig(2, 4, 8, 4, 2, 16),
+        {"block_kvh": 2}, save=False,
+    )
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16) is not None
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16,
+                                     quantized=True) is None
+    at.get_cache().record(
+        "paged_attention",
+        at.paged_attention_sig(2, 4, 8, 4, 2, 16, quant=True),
+        {"block_kvh": 1}, save=False,
+    )
+    assert pa.paged_attention_select(2, 4, 8, 4, 2, 16,
+                                     quantized=True) == {"block_kvh": 1}

@@ -72,6 +72,20 @@ def standard_specs(on_tpu):
             ("paged_attention",
              {"b": 32, "pages": 128, "page_size": 16, "h": 16,
               "kvh": 16, "d": 128}),
+            # int8-KV flavor of the same decode shape (its own entry:
+            # int8 page loads + in-VMEM dequant profile differently)
+            ("paged_attention",
+             {"b": 32, "pages": 128, "page_size": 16, "h": 16,
+              "kvh": 16, "d": 128, "quant": True}),
+            # weight-only int8 decode projections: qkv/o-sized and the
+            # serving lm_head (rows = resident decode slots)
+            ("int8_matmul", {"rows": 32, "hidden": 2048, "n_out": 2048}),
+            ("int8_matmul",
+             {"rows": 32, "hidden": 2048, "n_out": 32000}),
+            # fp8 train matmul (AMP O3): the flagship gemm shapes —
+            # records the measured fp8-vs-bf16 verdict for the device
+            ("fp8_matmul", {"m": 4096, "k": 2048, "n": 8192}),
+            ("fp8_matmul", {"m": 4096, "k": 2048, "n": 2048}),
         ]
     return [
         ("rope_attention", {"b": 2, "s": 64, "h": 2, "d": 16}),
@@ -79,6 +93,11 @@ def standard_specs(on_tpu):
         ("paged_attention",
          {"b": 2, "pages": 4, "page_size": 8, "h": 4, "kvh": 2,
           "d": 16}),
+        ("paged_attention",
+         {"b": 2, "pages": 4, "page_size": 8, "h": 4, "kvh": 2,
+          "d": 16, "quant": True}),
+        ("int8_matmul", {"rows": 8, "hidden": 64, "n_out": 256}),
+        ("fp8_matmul", {"m": 16, "k": 64, "n": 128}),
     ]
 
 
@@ -104,8 +123,16 @@ def _sig_and_candidates(kernel, spec):
     elif kernel == "paged_attention":
         sig = autotune.paged_attention_sig(
             spec["b"], spec["pages"], spec["page_size"], spec["h"],
-            spec["kvh"], spec["d"])
+            spec["kvh"], spec["d"], quant=spec.get("quant", False))
         cands = autotune.paged_attention_candidates(spec["kvh"])
+    elif kernel == "int8_matmul":
+        sig = autotune.int8_matmul_sig(spec["rows"], spec["hidden"],
+                                       spec["n_out"])
+        cands = autotune.int8_matmul_candidates(spec["rows"],
+                                                spec["n_out"])
+    elif kernel == "fp8_matmul":
+        sig = autotune.fp8_matmul_sig(spec["m"], spec["k"], spec["n"])
+        cands = autotune.fp8_matmul_candidates()
     else:
         raise ValueError(f"unknown kernel {kernel!r}")
     return sig, cands
@@ -227,6 +254,14 @@ def _build_factory(kernel, spec):
         q = jnp.asarray(rng.randn(b, 1, h, d), dtype)
         kp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
         vp = jnp.asarray(rng.randn(n, ps, kvh, d), dtype)
+        if spec.get("quant"):
+            from paddle_tpu.quantization.kv import (
+                QuantizedKV,
+                quantize_kv,
+            )
+
+            kp = QuantizedKV(*quantize_kv(kp))
+            vp = QuantizedKV(*quantize_kv(vp))
         # disjoint per-row tables (the serving layout), rows near full
         tbl = jnp.asarray(
             1 + np.arange(b * pages).reshape(b, pages), jnp.int32
@@ -250,6 +285,63 @@ def _build_factory(kernel, spec):
 
             step = jax.jit(f)
             return lambda: step(q, kp, vp)
+
+        return build
+
+    if kernel == "int8_matmul":
+        from paddle_tpu.kernels import int8_matmul as im
+
+        rows, hidden, n_out = spec["rows"], spec["hidden"], spec["n_out"]
+        x = jnp.asarray(rng.randn(rows, hidden), dtype)
+        wq, sc = im.quantize_weight(
+            jnp.asarray(rng.randn(hidden, n_out), jnp.float32)
+        )
+
+        def build(config):
+            # weight-only decode is fwd-only: time the forward
+            if config.get("path") == "composed":
+                def f(xv):
+                    return im.int8_matmul_composed(
+                        xv, wq, sc
+                    ).astype(jnp.float32).sum()
+            else:
+                br, bc = config["block_rows"], config["block_cols"]
+
+                def f(xv):
+                    return im.int8_matmul(
+                        xv, wq, sc, block_rows=br, block_cols=bc
+                    ).astype(jnp.float32).sum()
+
+            step = jax.jit(f)
+            return lambda: step(x)
+
+        return build
+
+    if kernel == "fp8_matmul":
+        from paddle_tpu.amp import fp8 as fp8_mod
+
+        m, kk, n = spec["m"], spec["k"], spec["n"]
+        x = jnp.asarray(rng.randn(m, kk), dtype)
+        w = jnp.asarray(rng.randn(kk, n), dtype)
+        sx = jnp.float32(1.0)
+        sw = jnp.float32(1.0)
+        xname = jnp.dtype(x.dtype).name
+        wname = jnp.dtype(w.dtype).name
+
+        def build(config):
+            # the O3 unit: fwd + bwd through the e4m3/e5m2 custom VJP
+            # vs the production bf16/fp32 dot it would replace
+            if config.get("path") == "composed":
+                def f(xv, wv):
+                    return jnp.dot(xv, wv).astype(jnp.float32).sum()
+            else:
+                def f(xv, wv):
+                    return fp8_mod._fp8_dot(
+                        xname, wname, xv, wv, sx, sw
+                    ).astype(jnp.float32).sum()
+
+            step = jax.jit(jax.grad(f, argnums=(0, 1)))
+            return lambda: step(x, w)
 
         return build
 
@@ -395,6 +487,12 @@ def smoke():
         assert autotune.norm_matmul_config_legal(16, 256, cfg), cfg
     for cfg in autotune.paged_attention_candidates(8):
         assert autotune.paged_attention_config_legal(8, cfg), cfg
+    for cfg in autotune.int8_matmul_candidates(8, 256):
+        assert autotune.int8_matmul_config_legal(8, 256, cfg), cfg
+    assert autotune.fp8_matmul_candidates() == [{"format": "e4m3"}]
+    # the quantized paged flavor tunes under its own signature
+    assert autotune.paged_attention_sig(2, 4, 8, 4, 2, 16, quant=True) \
+        .endswith("_q8")
 
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "tune_cache.json")
@@ -422,6 +520,11 @@ def smoke():
             elif kernel == "paged_attention":
                 assert autotune.paged_attention_config_legal(
                     spec["kvh"], cfg), cfg
+            elif kernel == "int8_matmul":
+                assert autotune.int8_matmul_config_legal(
+                    spec["rows"], spec["n_out"], cfg), cfg
+            elif kernel == "fp8_matmul":
+                assert cfg.get("format") == "e4m3", cfg
             else:
                 assert autotune.norm_matmul_config_legal(
                     spec["rows"], spec["n_out"], cfg), cfg
@@ -463,6 +566,25 @@ def smoke():
     rp = pa.paged_attention_reference(qp, kp, vp, tbl, pos)
     assert (np.asarray(fp) == np.asarray(rp)).all(), \
         "paged_attention parity"
+    # int8 flavors: weight-only matmul fused == composed bit-exact,
+    # int8-arena paged kernel == its blocked dequant reference
+    from paddle_tpu.kernels import int8_matmul as im
+    from paddle_tpu.quantization.kv import QuantizedKV, quantize_kv
+
+    wq, sc = im.quantize_weight(jnp.asarray(rng.randn(64, 256),
+                                            jnp.float32))
+    xq = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    fi = jax.jit(lambda a: im.int8_matmul(a, wq, sc, block_rows=8,
+                                          block_cols=128))(xq)
+    ci = jax.jit(lambda a: im.int8_matmul_composed(a, wq, sc))(xq)
+    assert (np.asarray(fi) == np.asarray(ci)).all(), "int8_matmul parity"
+    kq = QuantizedKV(*quantize_kv(kp))
+    vq = QuantizedKV(*quantize_kv(vp))
+    fq = jax.jit(lambda a: pa.paged_attention_fused(
+        a, kq, vq, tbl, pos, block_kvh=1))(qp)
+    rq = pa.paged_attention_reference(qp, kq, vq, tbl, pos)
+    assert (np.asarray(fq) == np.asarray(rq)).all(), \
+        "int8 paged_attention parity"
     print("tune-smoke OK: generators legal, cache round-trips, "
           "re-run is 100% hits with 0 measurements, parity holds")
     return 0

@@ -1,0 +1,188 @@
+"""quant_smoke — the ``make quant-smoke`` CPU gate for quantized serving.
+
+End-to-end over the REAL deployment chain, no hardware:
+
+1. PTQ-calibrate a tiny llama (observers on every Linear), convert to
+   frozen scales, then ``quantize_for_serving`` -> int8 weights +
+   per-channel scales (asserted idempotent: a second pass must be a
+   structural no-op — re-rounding int8 weights would silently degrade
+   them).
+2. Export the quantized greedy decoder with an int8 KV cache through
+   ``jit.save`` and serve it back through ``create_predictor`` — the
+   saved-artifact path must reproduce the live model's int8 decode
+   exactly.
+3. Serve one request through the HTTP/SSE front-end over a
+   ``PagedServingEngine`` with int8 weights AND ``cache_dtype="int8"``
+   pages; the token stream must agree with the fp32 float reference
+   within the pinned budget below, and the page pool must drain to
+   zero (no leaks).
+
+The agreement budget is a RATCHET, not a vibe: loosen it only with a
+measured reason in the diff.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# greedy tokens (of MAX_NEW) that must match the fp32 float reference
+# exactly from the start of the generated stream
+MAX_NEW = 8
+PINNED_AGREEMENT = 6
+
+
+def _prefix_agreement(a, b):
+    n = 0
+    for x, y in zip(a, b):
+        if int(x) != int(y):
+            break
+        n += 1
+    return n
+
+
+def main():
+    import tempfile
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import GreedyDecoder
+    from paddle_tpu.quantization import (
+        AbsmaxObserver,
+        PTQ,
+        PerChannelAbsmaxObserver,
+        QuantConfig,
+        QuantizedLinear,
+        quantize_for_serving,
+    )
+    from paddle_tpu.serving import (
+        PagedServingEngine,
+        ServingFrontend,
+        stream_generate,
+    )
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import nn
+
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 64, (1, 6)).astype(np.int32)
+
+    # ---- 1. PTQ -> convert -> quantize_for_serving ---------------------
+    qcfg = QuantConfig()
+    qcfg.add_type_config(
+        nn.Linear, activation=AbsmaxObserver(),
+        weight=PerChannelAbsmaxObserver(channel_axis=-1),
+    )
+    ptq = PTQ(qcfg)
+    observing = ptq.quantize(net, inplace=False)
+    for _ in range(3):  # calibration batches
+        ids = rng.randint(0, 64, (1, 8)).astype(np.int32)
+        observing(Tensor(jnp.asarray(ids)))
+    converted = ptq.convert(observing, inplace=False)
+    qnet = quantize_for_serving(converted)
+    qnet.eval()
+    n_q = sum(1 for _ in qnet.named_buffers())
+    assert any(
+        isinstance(m, QuantizedLinear)
+        for _, m in qnet.named_sublayers()
+    ), "no QuantizedLinear produced"
+    # idempotence: a second pass must leave every int8 buffer untouched
+    qnet2 = quantize_for_serving(qnet)
+    b1 = {k: np.asarray(v.value) for k, v in qnet.named_buffers()}
+    b2 = {k: np.asarray(v.value) for k, v in qnet2.named_buffers()}
+    assert b1.keys() == b2.keys(), "double-quantize changed structure"
+    for k in b1:
+        np.testing.assert_array_equal(
+            b1[k], b2[k], err_msg=f"double-quantize changed {k}"
+        )
+    print(f"quant-smoke: PTQ->serve conversion OK ({n_q} buffers, "
+          "idempotent)")
+
+    # the fp32 float reference stream
+    want = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=MAX_NEW,
+        cache_dtype="float32",
+    ).numpy())[0][prompt.shape[1]:]
+    # the quantized model's own int8-KV stream (the exactness anchor
+    # for both serving paths below)
+    q_ref = np.asarray(qnet.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=MAX_NEW,
+        cache_dtype="int8",
+    ).numpy())[0][prompt.shape[1]:]
+
+    # ---- 2. save -> predictor round trip -------------------------------
+    with tempfile.TemporaryDirectory() as td:
+        prefix = os.path.join(td, "llama_int8")
+        dec = GreedyDecoder(qnet, max_new_tokens=MAX_NEW,
+                            cache_dtype="int8")
+        dec.save(prefix, input_spec=[
+            InputSpec([1, prompt.shape[1]], "int32", "ids")
+        ])
+        pred = create_predictor(
+            Config(prefix + ".stablehlo", prefix + ".pdiparams")
+        )
+        pred.get_input_handle("ids").copy_from_cpu(prompt)
+        pred.run()
+        got = pred.get_output_handle(
+            pred.get_output_names()[0]
+        ).copy_to_cpu()[0][prompt.shape[1]:]
+    np.testing.assert_array_equal(
+        got, q_ref,
+        err_msg="saved int8 artifact diverged from the live int8 decode",
+    )
+    print("quant-smoke: jit.save int8 artifact round trip exact OK")
+
+    # ---- 3. HTTP/SSE over int8 weights + int8 KV pages -----------------
+    eng = PagedServingEngine(
+        qnet, max_batch_size=2, max_seq_len=64, min_bucket=8,
+        page_size=8, cache_dtype="int8",
+    )
+    fe = ServingFrontend(eng).start()
+    try:
+        events, _tm = stream_generate(
+            "127.0.0.1", fe.port,
+            {"input_ids": [int(t) for t in prompt[0]],
+             "max_new_tokens": MAX_NEW},
+        )
+    finally:
+        fe.stop(close_engine=True)
+    kind, data = events[-1]
+    assert kind == "done" and data["status"] == "DONE", events[-1]
+    toks = [d["token"] for e, d in events if e == "token"]
+    # the served stream IS the quantized model's decode, exactly
+    np.testing.assert_array_equal(
+        toks, q_ref,
+        err_msg="HTTP stream diverged from the quantized int8 decode",
+    )
+    agree = _prefix_agreement(toks, want)
+    assert agree >= PINNED_AGREEMENT, (
+        f"int8 stream agrees with the fp32 reference on only "
+        f"{agree}/{MAX_NEW} tokens (pinned >= {PINNED_AGREEMENT})"
+    )
+    st = eng.page_pool.stats()
+    assert st["pages_in_use"] == 0, st
+    assert st["claims"] == st["releases"] > 0, st
+    assert eng.pool.occupancy == 0
+    print(f"quant-smoke: HTTP int8-weights+int8-KV stream OK "
+          f"({agree}/{MAX_NEW} tokens match fp32 reference, "
+          f"0 pages leaked)")
+    print("quant-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

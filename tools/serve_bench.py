@@ -112,8 +112,9 @@ def run_bench(args):
         # warmup tokens must not pollute the report
         engine.metrics = type(engine.metrics)()
 
+    peak_active = 0
     if args.http:
-        handles, wall, wire = run_http_trace(engine, trace)
+        handles, wall, wire, peak_active = run_http_trace(engine, trace)
     else:
         wire = None
         t0 = time.monotonic()
@@ -126,6 +127,7 @@ def run_bench(args):
                 handles.append(engine.submit(ids, m))
             if engine.scheduler.depth or engine.active_slots:
                 engine.step()
+                peak_active = max(peak_active, engine.active_slots)
             elif pending:
                 time.sleep(min(0.001, pending[0][0] - now))
         wall = time.monotonic() - t0
@@ -149,14 +151,82 @@ def run_bench(args):
         "pool": engine.pool.stats(),
         "metrics": rep,
     }
+    out["peak_active_requests"] = peak_active
     page_pool = getattr(engine, "page_pool", None)
     if page_pool is not None:
         # occupancy / exhaustion counters in the record (the paged
         # pool's claims/releases/exhausted_events + peak residency)
         out["page_pool"] = page_pool.stats()
+        # per-request resident KV bytes — what the admitted-concurrency
+        # claims are made of. The MEAN request of this trace, plus the
+        # byte budget the whole arena pins, so a quantized-KV record is
+        # directly comparable against a bf16 one at equal HBM.
+        mean_total = sum(
+            p.shape[1] + m for _, p, m in trace
+        ) / max(len(trace), 1)
+        out["page_pool"]["request_resident_bytes_mean"] = (
+            page_pool.request_resident_bytes(int(round(mean_total)))
+        )
+        out["page_pool"]["token_bytes"] = (
+            page_pool.page_bytes() // max(page_pool.page_size, 1)
+        )
     if wire is not None:
         out["wire"] = wire
     return engine, handles, out
+
+
+def run_kv_compare(args):
+    """Replay the SAME paged trace twice — bf16 KV and int8 KV at an
+    EQUAL page-arena byte budget — and report residency + concurrency
+    side by side. This is the measurable form of the ~2x-slots claim:
+    the int8 record must show more usable token-slots (and, under
+    backpressure, more peak concurrent requests) for the same HBM."""
+    import copy
+
+    base = copy.copy(args)
+    base.paged, base.http = True, False
+
+    a_bf16 = copy.copy(base)
+    a_bf16.cache_dtype = "bfloat16"
+    eng_b, _, rec_b = run_bench(a_bf16)
+    arena = eng_b.page_pool.arena_bytes()
+
+    from paddle_tpu.serving import PagedKVPool
+
+    probe = PagedKVPool(
+        eng_b.page_pool.config, page_size=args.page_size, num_pages=1,
+        dtype="int8", max_seq_len=args.max_seq,
+    )
+    a_int8 = copy.copy(base)
+    a_int8.cache_dtype = "int8"
+    # same byte budget: as many int8 pages as fit in the bf16 arena
+    # (garbage page included on both sides)
+    a_int8.num_pages = max(int(arena // probe.page_bytes()) - 1, 1)
+    eng_i, _, rec_i = run_bench(a_int8)
+
+    slots_b = eng_b.page_pool.num_pages * eng_b.page_pool.page_size
+    slots_i = eng_i.page_pool.num_pages * eng_i.page_pool.page_size
+    return {
+        "metric": "serve_kv_compare",
+        "equal_hbm_budget_bytes": arena,
+        "int8_arena_bytes": eng_i.page_pool.arena_bytes(),
+        "token_slots": {"bfloat16": slots_b, "int8": slots_i},
+        "slots_ratio": round(slots_i / max(slots_b, 1), 3),
+        "request_resident_bytes_mean": {
+            "bfloat16": rec_b["page_pool"]["request_resident_bytes_mean"],
+            "int8": rec_i["page_pool"]["request_resident_bytes_mean"],
+        },
+        "peak_active_requests": {
+            "bfloat16": rec_b["peak_active_requests"],
+            "int8": rec_i["peak_active_requests"],
+        },
+        "peak_pages_in_use": {
+            "bfloat16": rec_b["page_pool"]["peak_pages_in_use"],
+            "int8": rec_i["page_pool"]["peak_pages_in_use"],
+        },
+        "bfloat16": rec_b,
+        "int8": rec_i,
+    }
 
 
 class _HTTPHandle:
@@ -188,7 +258,8 @@ def _pctl(xs):
 def run_http_trace(engine, trace):
     """Replay the trace through the HTTP/SSE front-end on localhost —
     one thread per request, arrivals honored, every token crossing a
-    real socket. Returns (handles, wall_s, wire-stats dict)."""
+    real socket. Returns (handles, wall_s, wire-stats dict,
+    peak-concurrency sample)."""
     import threading
 
     from paddle_tpu.serving import (
@@ -228,6 +299,19 @@ def run_http_trace(engine, trace):
 
     t0 = time.monotonic()
     threads = []
+    peak = [0]
+    done = threading.Event()
+
+    def sample_peak():
+        # the frontend's driver thread steps the engine; sample its
+        # concurrency here so wire-mode records carry the same
+        # peak_active_requests the in-process replay reports
+        while not done.is_set():
+            peak[0] = max(peak[0], engine.active_slots)
+            time.sleep(0.005)
+
+    sampler = threading.Thread(target=sample_peak, daemon=True)
+    sampler.start()
     try:
         for i, (arrival, ids, max_new) in enumerate(trace):
             dt = arrival - (time.monotonic() - t0)
@@ -241,6 +325,8 @@ def run_http_trace(engine, trace):
             th.join(timeout=600)
         wall = time.monotonic() - t0
     finally:
+        done.set()
+        sampler.join(timeout=5)
         fe.stop()
     wire = {
         "ttft": _pctl(ttfts),
@@ -248,7 +334,8 @@ def run_http_trace(engine, trace):
         "rejected_by_reason": rejects,
         "stream_aborts": fe.metrics.stream_aborts.by_label(),
     }
-    return [r or _HTTPHandle("ERROR") for r in results], wall, wire
+    return ([r or _HTTPHandle("ERROR") for r in results], wall, wire,
+            peak[0])
 
 
 def main(argv=None):
@@ -281,6 +368,10 @@ def main(argv=None):
                     help="replay through the HTTP/SSE front-end over "
                          "localhost; records wire-level TTFT/ITL next "
                          "to the in-process numbers")
+    ap.add_argument("--kv-compare", action="store_true",
+                    help="run the paged trace twice — bf16 KV vs int8 "
+                         "KV at an EQUAL page-arena byte budget — and "
+                         "report residency/concurrency side by side")
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON report only")
@@ -299,6 +390,21 @@ def main(argv=None):
         server = start_metrics_server(port=args.metrics_port)
         print(f"serve_bench: metrics at {server.url}", file=sys.stderr)
     try:
+        if args.kv_compare:
+            out = run_kv_compare(args)
+            if args.json:
+                print(json.dumps(out, indent=2, default=str))
+            else:
+                print(
+                    f"kv-compare at {out['equal_hbm_budget_bytes']} "
+                    f"arena bytes: token-slots bf16="
+                    f"{out['token_slots']['bfloat16']} int8="
+                    f"{out['token_slots']['int8']} "
+                    f"(x{out['slots_ratio']}), peak concurrent "
+                    f"bf16={out['peak_active_requests']['bfloat16']} "
+                    f"int8={out['peak_active_requests']['int8']}"
+                )
+            return out
         engine, handles, out = run_bench(args)
     finally:
         if server is not None:
