@@ -5,7 +5,7 @@ PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
-	tune-smoke serve-smoke quant-smoke
+	tune-smoke serve-smoke quant-smoke layout-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -63,6 +63,16 @@ serve-smoke:
 # budget and the page pool must drain to zero.
 quant-smoke:
 	$(ENV) $(PY) tools/quant_smoke.py
+
+# Sharding-layout gate: default policy == legacy annotations, explicit
+# vocab-parallel CE parity + zero fp32 full-vocab avals, pp-sharded
+# optimizer moments written back sharded, and the 7B abstract build for
+# BOTH layouts measured from sharded avals (pp-sharded state must come
+# in <= 18.4 GiB/chip analytic at v5p-64; regression fails). On
+# modern-jax images additionally lowers the full 7B for both layouts
+# plus the S=8192 long-context flagship and refreshes LOWER_7B.json.
+layout-smoke:
+	$(ENV) $(PY) tools/layout_smoke.py
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

@@ -31,8 +31,14 @@ them):
                      outputs undonated (optimizer state, KV slabs):
                      XLA must double-buffer them every step.
 - ``collective-mesh-mismatch``  collectives whose axis names are not
-                     axes of the installed ``parallel.mesh`` mesh —
-                     the graph can never run on the fleet topology.
+                     axes of the installed ``parallel.mesh`` mesh (nor,
+                     in auto mode, axes an EXPLICITLY installed
+                     ``parallel.layout`` policy declares — the hybrid
+                     layout's vocab-CE psum / pp state-sharding
+                     collectives lint clean under a narrower installed
+                     mesh; with no policy installed the rule stays
+                     fully strict) — the graph can never run on the
+                     fleet topology.
 - ``broadcast-blowup``  non-scalar broadcasts that multiply bytes past
                      a threshold (materialized [B,H,S,S] masks etc.).
 """
@@ -64,6 +70,15 @@ class LintConfig:
     broadcast_ratio: float = 64.0
     min_upcast_bytes: int = 32 << 20        # bulk narrow->wide promotion
     mesh_axes: tuple | None = None          # None: use the global mesh
+    #: auto mode only: accept axis names declared by an EXPLICITLY
+    #: installed parallel.layout policy on top of the installed mesh's —
+    #: a graph built for the hybrid layout (vocab-CE psum over mp, pp
+    #: state-sharding collectives) lints clean even when the process
+    #: currently holds a narrower mesh (e.g. the serving dp-only one).
+    #: With no policy installed the rule keeps full strictness (the
+    #: implicit default would whitelist every standard axis name), and
+    #: explicit ``mesh_axes`` configs are honored verbatim.
+    include_policy_axes: bool = True
 
     def resolved_mesh_axes(self):
         if self.mesh_axes is not None:
@@ -71,7 +86,16 @@ class LintConfig:
         from ..parallel import mesh as mesh_mod
 
         if mesh_mod.mesh_defined():
-            return tuple(mesh_mod.get_mesh().axis_names)
+            axes = tuple(mesh_mod.get_mesh().axis_names)
+            if self.include_policy_axes:
+                from ..parallel import layout as layout_mod
+
+                if layout_mod.policy_installed():
+                    axes += tuple(
+                        a for a in layout_mod.get_policy().axis_names()
+                        if a not in axes
+                    )
+            return axes
         return None  # no mesh installed -> rule cannot judge, skip
 
 

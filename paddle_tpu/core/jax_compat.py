@@ -50,8 +50,18 @@ def _install_shard_map_alias():
             **kwargs,
         )
 
+    shard_map._paddle_tpu_legacy_alias = True
     jax.shard_map = shard_map
 
 
 if not hasattr(jax, "shard_map"):  # pragma: no branch
     _install_shard_map_alias()
+
+
+def partial_manual_shard_map_supported() -> bool:
+    """Whether this jax supports manual-over-a-SUBSET shard_map
+    (axis_names=...). False on 0.4.x images where the alias above
+    refuses it — callers (compiled pipeline lowering proofs, ring
+    attention benches, their tests) degrade to GSPMD-only reduced modes
+    there instead of failing mid-trace."""
+    return not getattr(jax.shard_map, "_paddle_tpu_legacy_alias", False)

@@ -13,6 +13,15 @@ Megatron collective pattern (identity/allreduce pairs, masked vocab
 lookup + psum, distributed softmax) — see paddle_tpu/parallel/tp_ops.py
 for the equivalent explicit shard_map form, tested to match.
 
+The specs themselves come from the ACTIVE ``parallel.layout.LayoutPolicy``
+(one rule per parameter family), so the whole layout is a swappable
+object: the default ``tp-pp-dp`` policy reproduces the historical
+hard-coded annotations byte-for-byte, and swapping in e.g.
+``pp-sharded-state`` changes optimizer-state placement and the loss
+collective pattern without touching any model code. An ``mp_group``
+carrying a custom ``mesh_axis`` still overrides the policy's mp axis
+(reference subgroup semantics).
+
 Initialization uses the *full* logical weight (same RNG stream as the
 single-device model), so mp-sharded training is bit-comparable to gold —
 this replaces the reference's per-rank RNG tracker init dance.
@@ -27,13 +36,25 @@ from .....core.tensor import Tensor
 from .....nn import functional as F
 from .....nn import initializer as I
 from .....nn.layer.layers import Layer
+from .....parallel import layout as layout_mod
 from .....parallel import mesh as mesh_mod
+from .....parallel import tp_ops
 
 
 def _mp_axis(mp_group):
     if mp_group is not None and getattr(mp_group, "mesh_axis", None):
         return mp_group.mesh_axis
-    return "mp"
+    return layout_mod.get_policy().mp_axis
+
+
+def _family_spec(family, axis):
+    """The active policy's spec for a parameter family, re-expressed on
+    ``axis`` when an mp_group overrides the policy's mp axis name."""
+    pol = layout_mod.get_policy()
+    spec = pol.spec(family)
+    if axis == pol.mp_axis:
+        return tuple(spec)
+    return tuple(axis if e == pol.mp_axis else e for e in spec)
 
 
 def _mp_degree(axis):
@@ -103,7 +124,7 @@ class VocabParallelEmbedding(Layer):
                 [num_embeddings, embedding_dim], attr=weight_attr,
                 default_initializer=I.XavierUniform(),
             ),
-            self._axis, None,
+            *_family_spec("embedding", self._axis),
         )
 
     def forward(self, x):
@@ -138,7 +159,7 @@ class ColumnParallelLinear(Layer):
                     fan_in=in_features, fan_out=out_features
                 ),
             ),
-            None, self._axis,
+            *_family_spec("column_weight", self._axis),
         )
         self.bias = None
         if has_bias is None or has_bias:
@@ -146,7 +167,7 @@ class ColumnParallelLinear(Layer):
                 self.create_parameter(
                     [out_features], is_bias=True,
                 ),
-                self._axis,
+                *_family_spec("column_bias", self._axis),
             )
 
     def forward(self, x):
@@ -185,7 +206,7 @@ class RowParallelLinear(Layer):
                     fan_in=in_features, fan_out=out_features
                 ),
             ),
-            self._axis, None,
+            *_family_spec("row_weight", self._axis),
         )
         self.bias = None
         if has_bias:
@@ -203,14 +224,33 @@ class RowParallelLinear(Layer):
         return y
 
 
+def _vp_ce_op(logits, labels, *, axis_name, ignore_index, lead_axes,
+              epoch):
+    """dispatch op body for the explicit vocab-parallel CE (``epoch``
+    keys the op cache to the installed mesh, like shard_constraint)."""
+    del epoch
+    return tp_ops.vocab_parallel_cross_entropy_spmd(
+        logits, labels, axis_name=axis_name, lead_axes=lead_axes,
+        ignore_index=ignore_index,
+    )
+
+
 class ParallelCrossEntropy(Layer):
     """Softmax cross entropy over vocab-sharded logits.
 
-    The logits keep their P(..., 'mp') sharding through log-softmax; XLA
-    partitions the max/sum-exp reductions across the mp axis (the
-    distributed-softmax pattern of the reference's ParallelCrossEntropy);
-    paddle_tpu.parallel.tp_ops.vocab_parallel_cross_entropy is the
-    explicit equivalent.
+    Two lowerings behind one layer, selected by the active
+    ``parallel.layout`` policy:
+
+    - default (GSPMD): the logits keep their P(..., 'mp') sharding
+      through log-softmax; XLA partitions the max/sum-exp reductions
+      across the mp axis (the distributed-softmax pattern of the
+      reference's ParallelCrossEntropy).
+    - ``vocab_parallel_loss`` policies: the explicit Megatron form —
+      tp_ops.vocab_parallel_cross_entropy inside a shard_map, so each
+      chip's fp32 block is the LOCAL [rows, V/mp] shard and the
+      full-vocab fp32 logits array is never materialized (the 7B
+      memory lever; fp32-tolerance parity with the GSPMD path is
+      tier-1-pinned).
     """
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
@@ -219,6 +259,23 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
+        pol = layout_mod.get_policy()
+        deg = _mp_degree(self._axis)
+        if (
+            pol.vocab_parallel_loss
+            and deg > 1
+            and int(input.shape[-1]) % deg == 0
+        ):
+            return dispatch.apply(
+                "vocab_parallel_cross_entropy", _vp_ce_op,
+                (input, label),
+                {
+                    "axis_name": self._axis,
+                    "ignore_index": int(self.ignore_index),
+                    "lead_axes": pol.loss_lead_axes(),
+                    "epoch": mesh_mod.mesh_epoch(),
+                },
+            )
         logits = shard_constraint(
             input, *([None] * (len(input.shape) - 1)), self._axis
         )

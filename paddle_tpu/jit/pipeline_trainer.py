@@ -54,14 +54,24 @@ def _block_signature(layer):
 class CompiledPipelineTrainStep(CompiledTrainStep):
     def __init__(self, layers, loss_fn, optimizer, micro_batches=1,
                  num_virtual=1, amp_level=None, amp_dtype="bfloat16",
-                 pp_axis="pp", scaler=None):
+                 pp_axis=None, scaler=None, layout_policy=None):
         from ..distributed.fleet.meta_parallel.parallel_layers.pp_layers \
             import PipelineLayer
+        from ..parallel import layout as layout_mod
 
         if not isinstance(layers, PipelineLayer):
             raise TypeError(
                 "CompiledPipelineTrainStep expects a PipelineLayer"
             )
+        if pp_axis is None:
+            # the ring axis comes from the layout policy (one object
+            # names every axis), not a per-call-site string
+            pol = (
+                layout_mod.resolve(layout_policy)
+                if layout_policy is not None
+                else layout_mod.get_policy()
+            )
+            pp_axis = pol.pp_axis
         # fp16 dynamic loss scaling rides the base class's in-trace
         # mechanism unchanged: the whole-batch loss after the ppermute
         # schedule is scaled, grads unscaled + finite-checked across ALL
@@ -69,7 +79,8 @@ class CompiledPipelineTrainStep(CompiledTrainStep):
         # update conditionally skipped with scaler state carried through
         # the jitted step (reference: PipelineParallel + GradScaler).
         super().__init__(
-            layers, loss_fn, optimizer, amp_level, amp_dtype, scaler=scaler
+            layers, loss_fn, optimizer, amp_level, amp_dtype,
+            scaler=scaler, layout_policy=layout_policy,
         )
         self.micro_batches = int(micro_batches)
         self.num_virtual = int(num_virtual)

@@ -41,7 +41,7 @@ class CompiledTrainStep:
     )
 
     def __init__(self, network, loss_fn, optimizer, amp_level=None,
-                 amp_dtype="bfloat16", scaler=None):
+                 amp_dtype="bfloat16", scaler=None, layout_policy=None):
         from .dy2static import convert_to_static
 
         # dy2static pass on the top-level forward so Python if/while on
@@ -78,6 +78,22 @@ class CompiledTrainStep:
         self._step_fn = None
         self._param_names = [k for k, _ in network.named_parameters()]
         self._checkpoint = None
+        # sharding layout: an explicit LayoutPolicy (or registry name)
+        # pins this trainer; None captures the ACTIVE parallel.layout
+        # policy NOW, at construction — so the documented pattern
+        # (`with layout.use_policy(...): trainer = ...`, step later)
+        # keeps the chosen layout even after the context exits. The
+        # policy's optimizer-state / master-param rules are stamped on
+        # the step's outputs, so e.g. the pp-sharded-state layout keeps
+        # Adam moments sharded over the pp axis across steps (the
+        # 29.4 -> 18.4 GiB/chip 7B lever).
+        from ..parallel import layout as layout_mod
+
+        self._layout_policy = (
+            layout_mod.resolve(layout_policy)
+            if layout_policy is not None
+            else layout_mod.get_policy()
+        )
         # AMP O3 (fp8 matmuls): per-tensor delayed-scaling amax
         # histories, carried through the compiled step next to the
         # optimizer state (structure discovered on the first call)
@@ -276,6 +292,26 @@ class CompiledTrainStep:
         # layout; XLA realizes the reduce-scatter + sharded-update pattern
         grad_placements = getattr(opt, "_grad_placements", None) or {}
 
+        # layout-policy memory levers: stamp the policy's optimizer-state
+        # (and master-param) shardings on the step outputs so the lowered
+        # module carries them and the write-back keeps them steady-state.
+        # The default tp-pp-dp policy produces NO pins — the step stays
+        # byte-identical to the pre-policy trainer.
+        from ..parallel import mesh as mesh_mod
+
+        pol = self._layout_policy
+        policy_state_pins, policy_param_pins = {}, {}
+        if mesh_mod.mesh_defined() and (
+            pol.pp_shard_optimizer_state or pol.pp_shard_master_params
+        ):
+            for k, p in network.named_parameters():
+                sh = pol.optimizer_state_sharding(p.value)
+                if sh is not None:
+                    policy_state_pins[k] = sh
+                sh = pol.master_param_sharding(p.value)
+                if sh is not None:
+                    policy_param_pins[k] = sh
+
         scaler = self.scaler
 
         def step(params, opt_state, buffers, lr, t, rng, inputs, labels,
@@ -387,6 +423,31 @@ class CompiledTrainStep:
                     )
                     new_params[k] = np_
                     new_state[k] = (m2, v2)
+
+            if policy_state_pins or policy_param_pins:
+                new_state = {
+                    k: tuple(
+                        (
+                            jax.lax.with_sharding_constraint(
+                                a, policy_state_pins[k]
+                            )
+                            if k in policy_state_pins and a.ndim
+                            else a
+                        )
+                        for a in accs
+                    )
+                    for k, accs in new_state.items()
+                }
+                new_params = {
+                    k: (
+                        jax.lax.with_sharding_constraint(
+                            v, policy_param_pins[k]
+                        )
+                        if k in policy_param_pins
+                        else v
+                    )
+                    for k, v in new_params.items()
+                }
 
             if scaler is not None:
                 # non-finite grads: keep params/state, adjust the scale
@@ -550,6 +611,19 @@ class CompiledTrainStep:
 
     # ---------------------------------------------------------------- call
     def __call__(self, inputs, labels):
+        """One optimizer step. The trainer's captured layout policy is
+        ACTIVE for the whole call: policy-routed code that resolves the
+        policy at trace time (ParallelCrossEntropy / causal_lm_loss,
+        sep-ring attention, Optimizer._acc accumulator births) sees the
+        trainer's layout even when the step runs outside the
+        use_policy context the trainer was constructed in — otherwise
+        the layout would apply half-way (pinned state, default loss)."""
+        from ..parallel import layout as layout_mod
+
+        with layout_mod.use_policy(self._layout_policy):
+            return self._step_once(inputs, labels)
+
+    def _step_once(self, inputs, labels):
         _t0 = time.perf_counter()
         _warmup = self._step_fn is None  # first call traces + compiles
         if self._step_fn is None:

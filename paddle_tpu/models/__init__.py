@@ -15,7 +15,12 @@ from .bert import (  # noqa: F401
     BertPretrainingCriterion,
 )
 from .gpt_moe import GPTMoEConfig, GPTMoEForCausalLM  # noqa: F401
-from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    causal_lm_loss,
+)
 from .llama_pipe import (  # noqa: F401
     LlamaDecoderLayerTP,
     LlamaForCausalLMPipe,

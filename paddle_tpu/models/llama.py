@@ -69,6 +69,33 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
 
+def causal_lm_loss(logits, labels, ignore_index=-100):
+    """THE causal-LM training-loss seam: per-token CE (zeros at
+    ``ignore_index`` rows); callers own the reduction. Routed by the
+    active ``parallel.layout`` policy — when the installed mesh shards
+    the vocab axis the loss goes through ParallelCrossEntropy (and, for
+    ``vocab_parallel_loss`` policies, the explicit Megatron shard_map CE
+    that never materializes the full-vocab fp32 logits block per chip);
+    single-device and dp-only meshes take plain cross_entropy."""
+    from ..parallel import layout as layout_mod
+    from ..parallel import mesh as mesh_mod
+
+    V = int(logits.shape[-1])
+    flat = logits.reshape([-1, V])
+    lab = labels.reshape([-1])
+    pol = layout_mod.get_policy()
+    deg = (
+        mesh_mod.axis_size(pol.mp_axis) if mesh_mod.mesh_defined() else 1
+    )
+    if deg > 1:
+        from ..distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+        return ParallelCrossEntropy(ignore_index=ignore_index)(flat, lab)
+    return F.cross_entropy(
+        flat, lab, reduction="none", ignore_index=ignore_index
+    )
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()

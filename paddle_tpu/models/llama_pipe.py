@@ -7,7 +7,10 @@ suffix] structure, but executed as ONE jitted SPMD program — Megatron TP
 via GSPMD shardings (mp axis), the microbatch schedule via the compiled
 ppermute ring (pp axis), data parallel via batch sharding (dp axis).
 
-Sharding layout per decoder block (mesh axes (dp, pp, mp)):
+The sharding layout comes from the ACTIVE ``parallel.layout``
+LayoutPolicy (swap it with ``layout.use_policy(...)`` — no model edits);
+under the default ``tp-pp-dp`` policy, per decoder block (mesh axes
+(dp, pp, mp)):
 - q/k/v projections: ColumnParallelLinear, weight P(None, 'mp') — heads
   split across mp ranks;
 - o_proj: RowParallelLinear, weight P('mp', None) — the attention
@@ -18,7 +21,13 @@ Sharding layout per decoder block (mesh axes (dp, pp, mp)):
 - RMSNorm weights: replicated (tiny);
 - embedding: VocabParallelEmbedding, weight P('mp', None) (vocab rows);
 - lm head: ColumnParallelLinear gather_output=False + the distributed
-  softmax of ParallelCrossEntropy over vocab-sharded logits.
+  softmax of ParallelCrossEntropy over vocab-sharded logits (the
+  explicit Megatron shard_map CE under ``vocab_parallel_loss``
+  policies — the fp32 logits block stays [rows, V/mp] per chip).
+
+``use_sep_attention`` policies additionally route decoder attention
+through the sep-axis ring (parallel.ring_flash_attention) whenever the
+mesh carries sep degree > 1 — the long-context (S=8192) regime.
 
 Each block rebuilds its rope cache from the static sequence length —
 XLA constant-folds it once per compilation; blocks carry no buffers (a
@@ -35,12 +44,14 @@ from ..incubate.nn import functional as IF
 from ..distributed.fleet.meta_parallel import (
     ColumnParallelLinear,
     LayerDesc,
-    ParallelCrossEntropy,
     PipelineLayer,
     RowParallelLinear,
     VocabParallelEmbedding,
 )
-from .llama import LlamaConfig, LlamaFlopsMixin
+from ..parallel import layout as layout_mod
+from ..parallel import mesh as mesh_mod
+from ..parallel.sep_ops import ring_flash_attention
+from .llama import LlamaConfig, LlamaFlopsMixin, causal_lm_loss
 
 
 class LlamaDecoderLayerTP(nn.Layer):
@@ -99,9 +110,21 @@ class LlamaDecoderLayerTP(nn.Layer):
             rep = cfg.num_attention_heads // cfg.kv_heads
             k = k.repeat_interleave(rep, axis=2)
             v = v.repeat_interleave(rep, axis=2)
-        a = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, training=self.training
-        )
+        pol = layout_mod.get_policy()
+        if (
+            pol.use_sep_attention
+            and mesh_mod.mesh_defined()  # never install a mesh as a side effect
+            and mesh_mod.axis_size(pol.sep_axis) > 1
+        ):
+            # long-context policies: exact full attention over the
+            # sep-sharded sequence via the KV rotation ring — per-device
+            # score memory stays O((S/sep)^2) per hop
+            a = ring_flash_attention(q, k, v, causal=True,
+                                     axis=pol.sep_axis)
+        else:
+            a = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training
+            )
         x = x + self.o_proj(a.reshape([B, S, -1]))
         h2 = self.post_attention_layernorm(x)
         return x + self.down_proj(
@@ -131,13 +154,11 @@ class LlamaForCausalLMPipe(LlamaFlopsMixin, PipelineLayer):
         if num_stages is None:
             num_stages = mesh_mod.global_mesh_shape().get("pp", 1)
         self.config = config
-        pce = ParallelCrossEntropy()
 
         def loss_fn(logits, labels):
-            return pce(
-                logits.reshape([-1, config.vocab_size]),
-                labels.reshape([-1]),
-            ).mean()
+            # one seam for every causal-LM loss: routes through the
+            # active layout policy (vocab-parallel CE when enabled)
+            return causal_lm_loss(logits, labels).mean()
 
         super().__init__(
             [LayerDesc(VocabParallelEmbedding, config.vocab_size,

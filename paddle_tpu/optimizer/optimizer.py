@@ -280,6 +280,15 @@ class Optimizer:
             # per-param placements so optimizer state is stored sharded
             # over the sharding axis
             sh = getattr(self, "_acc_placements", {}).get(id(p))
+            if sh is None:
+                # layout-policy rule (e.g. pp-sharded-state): fresh
+                # accumulators are BORN on the policy's optimizer-state
+                # layout instead of being resharded by the first
+                # compiled step — at 7B scale the difference is whether
+                # full-size fp32 moments ever exist per chip
+                from ..parallel import layout as _layout
+
+                sh = _layout.accumulator_sharding(p.value)
             if sh is not None and getattr(v, "ndim", 0) > 0:
                 import jax as _jax
 

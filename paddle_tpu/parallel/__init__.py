@@ -16,4 +16,12 @@ from .mesh import (  # noqa: F401
     set_mesh,
 )
 from . import collectives  # noqa: F401
+from . import layout  # noqa: F401
+from .layout import (  # noqa: F401
+    LayoutPolicy,
+    get_policy,
+    register_policy,
+    set_policy,
+    use_policy,
+)
 from .sep_ops import ring_flash_attention, ulysses_attention  # noqa: F401

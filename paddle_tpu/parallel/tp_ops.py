@@ -18,8 +18,13 @@ only inside shard_map/pmap-style named-axis contexts.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
 
 
 def identity_fwd_allreduce_bwd(x, axis_name):
@@ -122,3 +127,108 @@ def vocab_parallel_cross_entropy(logits_shard, labels, axis_name="mp"):
         jnp.where(ok, picked, jnp.zeros_like(picked)), axis_name
     )
     return jnp.log(sumexp) - label_logit
+
+
+def vocab_parallel_cross_entropy_grad(logits_shard, labels, ct,
+                                      axis_name="mp", ignore_index=None):
+    """Analytic local-shard gradient of the Megatron parallel CE:
+    (softmax_local - onehot_local) * ct, zero on ignored rows. Used as
+    the hand-written backward of the SPMD wrapper below — recomputing
+    softmax per shard keeps the residuals at just (logits, labels) and
+    sidesteps shard_map's replicated-cotangent transpose entirely."""
+    lg = logits_shard.astype(jnp.float32)
+    n_local = lg.shape[-1]
+    m = jax.lax.pmax(jnp.max(lg, axis=-1), axis_name)
+    e = jnp.exp(lg - m[..., None])
+    sumexp = jax.lax.psum(jnp.sum(e, axis=-1), axis_name)
+    soft = e / sumexp[..., None]
+    start = jax.lax.axis_index(axis_name) * n_local
+    local = labels - start
+    onehot = (
+        local[..., None] == jnp.arange(n_local)[None, :]
+    ).astype(jnp.float32)
+    ct = ct.astype(jnp.float32)
+    if ignore_index is not None:
+        ct = jnp.where(labels != ignore_index, ct, 0.0)
+    return ((soft - onehot) * ct[..., None]).astype(logits_shard.dtype)
+
+
+def _loss_lead_spec(n_rows, lead_axes):
+    """The flattened-token dim's spec entry: shard over every lead axis
+    (dp, then sep) whose degree divides evenly; replicate otherwise."""
+    sizes = mesh_mod.global_mesh_shape()
+    lead, prod = [], 1
+    for a in lead_axes:
+        d = sizes.get(a, 1)
+        if d > 1 and n_rows % (prod * d) == 0:
+            lead.append(a)
+            prod *= d
+    return tuple(lead) if lead else None
+
+
+def vocab_parallel_cross_entropy_spmd(logits, labels, *, axis_name="mp",
+                                      lead_axes=("dp", "sep"),
+                                      ignore_index=-100):
+    """Global-array form of the Megatron parallel CE for GSPMD programs.
+
+    logits: [..., V] with V sharded over ``axis_name`` on the installed
+    mesh (the gather_output=False column head's layout); labels:
+    replicated-or-batch-sharded ints. Returns per-token loss (zero at
+    ``ignore_index`` rows — F.cross_entropy reduction='none' parity),
+    fp32, with the SAME leading shape.
+
+    The body runs in a shard_map manual over ALL mesh axes (works on
+    every jax line this repo supports; partial-manual is not required
+    because the loss sits outside the pipeline ring), so per chip only
+    the LOCAL [rows, V/mp] fp32 block ever exists — the full-vocab fp32
+    logits array is never materialized, which is the 7B memory lever
+    (lower_7b pins this on the lowered module's avals). The backward is
+    a second fully-sharded shard_map over the analytic gradient — a
+    replicated-output cotangent never meets shard_map's transpose."""
+    mesh = mesh_mod.get_mesh()
+    lead_shape = tuple(logits.shape[:-1])
+    V = int(logits.shape[-1])
+    n_rows = int(np.prod(lead_shape, dtype=np.int64)) if lead_shape else 1
+    lead = _loss_lead_spec(n_rows, lead_axes)
+    spec_l = P(lead, axis_name)
+    spec_y = P(lead)
+
+    def fwd_body(lg, lb):
+        ce = vocab_parallel_cross_entropy(
+            lg.astype(jnp.float32), lb, axis_name=axis_name
+        )
+        return jnp.where(lb != ignore_index, ce, 0.0)
+
+    def bwd_body(lg, lb, ct):
+        return vocab_parallel_cross_entropy_grad(
+            lg, lb, ct, axis_name=axis_name, ignore_index=ignore_index
+        )
+
+    fwd_sm = jax.shard_map(
+        fwd_body, mesh=mesh, in_specs=(spec_l, spec_y),
+        out_specs=spec_y, check_vma=False,
+    )
+    bwd_sm = jax.shard_map(
+        bwd_body, mesh=mesh, in_specs=(spec_l, spec_y, spec_y),
+        out_specs=spec_l, check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def ce(lg, lb):
+        return fwd_sm(lg, lb)
+
+    def ce_fwd(lg, lb):
+        return fwd_sm(lg, lb), (lg, lb)
+
+    def ce_bwd(res, ct):
+        lg, lb = res
+        return (
+            bwd_sm(lg, lb, ct),
+            np.zeros(lb.shape, jax.dtypes.float0),  # int labels: no grad
+        )
+
+    ce.defvjp(ce_fwd, ce_bwd)
+    flat = ce(
+        logits.reshape((n_rows, V)), labels.reshape((n_rows,))
+    )
+    return flat.reshape(lead_shape)

@@ -1,0 +1,272 @@
+"""``make layout-smoke`` — the sharding-layout-policy gate.
+
+Runs on a virtual 8-device CPU mesh (subprocess; backend init is
+process-global) and asserts the layout-policy contract end to end:
+
+1. the default ``tp-pp-dp`` policy reproduces the legacy per-model
+   annotations exactly (spec table + constructed TP layer shardings);
+2. the explicit vocab-parallel CE matches unsharded cross entropy to
+   fp32 tolerance (loss AND gradient) and its jaxpr contains ZERO fp32
+   full-vocab avals (per-shard [rows, V/mp] blocks only);
+3. a compiled train step under ``pp-sharded-state`` writes optimizer
+   moments back SHARDED over the pp axis (executed, not just lowered)
+   and matches the default layout's training numerics;
+4. the REAL 7B abstract build, both layouts: measured-from-avals
+   per-chip state bytes must shrink by the pp degree, and the analytic
+   v5p-64 table must come in at <= 18.4 GiB/chip pp-sharded
+   (vs ~29.4 default) — regression here fails the gate;
+5. on a jax with partial-manual shard_map, the full 7B lowering for
+   both layouts PLUS the S=8192 long-context (sep-ring) flagship,
+   asserting the collective set and writing LOWER_7B.json. Legacy
+   0.4.x images run steps 1-4 (GSPMD + manual-over-all shard_map) and
+   report the reduced mode honestly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PP_SHARDED_BUDGET_GIB = 18.4  # the ROADMAP item-4 claim, now asserted
+
+
+def _check_default_policy_is_legacy_layout(out):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+    from paddle_tpu.parallel import layout
+
+    pol = layout.get_policy()
+    assert pol.name == "tp-pp-dp", pol.name
+    expect = {
+        "embedding": ("mp", None),
+        "column_weight": (None, "mp"),
+        "column_bias": ("mp",),
+        "row_weight": ("mp", None),
+        "replicated": (),
+        "lm_head": (None, "mp"),
+    }
+    for fam, spec in expect.items():
+        got = tuple(pol.spec(fam))
+        assert got == spec, f"{fam}: {got} != legacy {spec}"
+    with paddle.LazyGuard():
+        col = ColumnParallelLinear(8, 8, gather_output=False)
+        row = RowParallelLinear(8, 8, has_bias=False)
+        emb = VocabParallelEmbedding(16, 8)
+    assert tuple(col.weight.value.sharding.spec) == (None, "mp")
+    assert tuple(col.bias.value.sharding.spec) == ("mp",)
+    assert tuple(row.weight.value.sharding.spec) == ("mp", None)
+    assert tuple(emb.weight.value.sharding.spec) == ("mp", None)
+    out["default_policy_legacy_parity"] = True
+
+
+def _check_vocab_ce(out):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ParallelCrossEntropy,
+    )
+    from paddle_tpu.parallel import layout, tp_ops
+
+    N, V = 32, 64
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(N, V), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, (N,)), jnp.int32)
+    labels = labels.at[5].set(-100)
+
+    with layout.use_policy("pp-sharded-state"):
+        lt = Tensor(logits, stop_gradient=False)
+        loss = ParallelCrossEntropy()(lt, Tensor(labels)).mean()
+        loss.backward()
+        g_sharded = np.asarray(lt.grad.numpy())
+    lr = Tensor(logits, stop_gradient=False)
+    ref = F.cross_entropy(
+        lr, Tensor(labels), reduction="none", ignore_index=-100
+    ).mean()
+    ref.backward()
+    np.testing.assert_allclose(
+        float(loss.numpy()), float(ref.numpy()), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        g_sharded, np.asarray(lr.grad.numpy()), rtol=1e-5, atol=1e-7
+    )
+
+    # aval pin: zero fp32 full-vocab blocks in the sharded CE's graph
+    from tools.lower_7b import count_fp32_full_vocab_avals
+
+    jx = jax.make_jaxpr(
+        lambda l, y: tp_ops.vocab_parallel_cross_entropy_spmd(l, y)
+    )(logits.astype(jnp.bfloat16), labels)
+    n_full = count_fp32_full_vocab_avals(jx.jaxpr, V)
+    assert n_full == 0, f"{n_full} fp32 full-vocab avals in vocab CE"
+    # sanity: the unsharded fp32 softmax DOES materialize the block
+    jx_ref = jax.make_jaxpr(
+        lambda l: jax.nn.log_softmax(l.astype(jnp.float32), axis=-1)
+    )(logits.astype(jnp.bfloat16))
+    assert count_fp32_full_vocab_avals(jx_ref.jaxpr, V) > 0
+    out["vocab_ce_parity"] = True
+    out["vocab_ce_fp32_full_vocab_avals"] = 0
+
+
+def _check_pp_sharded_step(out):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.parallel import layout
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 4, (8,)))
+
+    def run(policy):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        with layout.use_policy(policy):
+            step = CompiledTrainStep(
+                net, lambda o, t: F.cross_entropy(o, t), opt
+            )
+            for _ in range(2):
+                loss, _ = step([Tensor(x)], [Tensor(y)])
+        accs = {
+            k: str(getattr(getattr(v, "sharding", None), "spec", None))
+            for k, v in opt._accumulators.items()
+            if getattr(v, "ndim", 0) > 1
+        }
+        return float(loss.numpy()), accs
+
+    l_def, _ = run("tp-pp-dp")
+    l_pp, accs = run("pp-sharded-state")
+    np.testing.assert_allclose(l_pp, l_def, rtol=1e-5)
+    assert accs and all("pp" in s for s in accs.values()), accs
+    out["pp_sharded_step_parity"] = True
+
+
+def _measure_7b(out):
+    from tools.lower_7b import _per_chip_budget, build_7b, measured_per_chip
+
+    measured = {}
+    n_params = None
+    for layout_name in ("tp-pp-dp", "pp-sharded-state"):
+        b = build_7b(layout=layout_name)
+        n_params = b["n_params"]
+        measured[layout_name] = measured_per_chip(
+            b["params"], b["opt_state"]
+        )
+    pp = 2  # build-mesh pp degree
+    for row in ("adam_m", "adam_v", "params"):
+        d = measured["tp-pp-dp"]["rows_gib"][row]
+        s = measured["pp-sharded-state"]["rows_gib"][row]
+        assert s <= d / pp * 1.05, (
+            f"{row}: pp-sharded {s} GiB/chip not ~1/{pp} of default {d}"
+        )
+    cfg_budget = _per_chip_budget(
+        b["cfg"], n_params, tp=4, pp=2, dp=4, b_micro=1, seq=4096,
+        hbm_gib=95, pp_sharded_state=True,
+    )
+    assert cfg_budget["total_gib_if_pp_sharded_state"] <= \
+        PP_SHARDED_BUDGET_GIB, cfg_budget
+    out["measured_7b_per_chip"] = measured
+    out["v5p64_pp_sharded_total_gib"] = (
+        cfg_budget["total_gib_if_pp_sharded_state"]
+    )
+    out["v5p64_default_total_gib"] = cfg_budget["total_gib"]
+
+
+def _full_lowerings(out):
+    from tools.lower_7b import lower_7b
+
+    rep_def = lower_7b(layout="tp-pp-dp", write_notes=True)
+    rep_pp = lower_7b(layout="pp-sharded-state", write_notes=True)
+    rep_lc = lower_7b(
+        dp=1, pp=2, mp=2, sep=2, B=4, S=8192, write_notes=True,
+        layout="long-context", budget_geometry=(4, 2, 2, 2, 1, 8192),
+    )
+    # collective-set regression gate: the ring + TP reductions must
+    # survive every layout, the sep variant must keep its ring too
+    for rep in (rep_def, rep_pp, rep_lc):
+        assert rep["collective_permute_ops"] > 0
+        assert rep["all_reduce_ops"] > 0
+    assert rep_pp["fp32_full_vocab_avals"] == 0
+    assert rep_pp["v5p64_budget"]["total_gib_if_pp_sharded_state"] <= \
+        PP_SHARDED_BUDGET_GIB
+    assert rep_lc["v5p64_budget"]["fits"]
+    out["lowered"] = {
+        "tp-pp-dp": rep_def["v5p64_budget"]["total_gib"],
+        "pp-sharded-state":
+            rep_pp["v5p64_budget"]["effective_total_gib"],
+        "long-context-s8192":
+            rep_lc["v5p64_budget"]["effective_total_gib"],
+    }
+
+
+def run_smoke():
+    from paddle_tpu.core.jax_compat import (
+        partial_manual_shard_map_supported,
+    )
+    from paddle_tpu.distributed.fleet.base.topology import (
+        CommunicateTopology,
+        HybridCommunicateGroup,
+    )
+
+    # the hybrid mesh every check resolves specs against (the same
+    # geometry the lower_7b builds re-install)
+    HybridCommunicateGroup(CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"], [2, 2, 1, 1, 2]
+    ))
+    out = {"ok": False}
+    _check_default_policy_is_legacy_layout(out)
+    _check_vocab_ce(out)
+    _check_pp_sharded_step(out)
+    _measure_7b(out)
+    if partial_manual_shard_map_supported():
+        _full_lowerings(out)
+        out["mode"] = "full"
+    else:
+        out["mode"] = "reduced"
+        out["reduced_reason"] = (
+            "legacy jax: partial-manual shard_map unavailable, the "
+            "compiled pp ring cannot lower here — measured-aval + GSPMD "
+            "checks ran; run on a modern-jax image for the full 7B "
+            "lowerings"
+        )
+    out["ok"] = True
+    print("layout-smoke: " + json.dumps(out))
+    return out
+
+
+def main():
+    from tools.vmesh import run_in_virtual_cpu_mesh
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = run_in_virtual_cpu_mesh(
+        8, "from tools.layout_smoke import run_smoke; run_smoke()",
+        cwd=here, timeout=1500,
+    )
+    sys.stdout.write(r.stdout)
+    sys.stderr.write(r.stderr)
+    if r.returncode != 0 or "layout-smoke" not in r.stdout:
+        print("layout-smoke: FAILED", file=sys.stderr)
+        raise SystemExit(r.returncode or 1)
+    print("layout-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()
