@@ -5,7 +5,7 @@ PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
-	tune-smoke serve-smoke quant-smoke layout-smoke
+	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -55,6 +55,16 @@ tune-smoke:
 # nonzero wire-TTFT series.
 serve-smoke:
 	$(ENV) $(PY) tools/serve_smoke.py
+
+# Cluster-serving gate: a prefill-pool worker + replica subprocesses
+# behind the occupancy-aware router. Disaggregated-prefill streams must
+# be exact-equal to local prefill, aggregate throughput must scale from
+# 1 -> 2 replicas, a SIGKILLed replica must shed cleanly (terminal
+# error events, unstarted requests retried on the survivor, zero
+# leaked pages), and the router /metrics must parse with nonzero
+# per-replica series.
+fleet-smoke:
+	$(ENV) $(PY) tools/fleet_smoke.py
 
 # Quantized-execution gate: PTQ the tiny llama -> quantize_for_serving
 # (int8 weights, asserted idempotent) -> jit.save/predictor round trip

@@ -21,13 +21,29 @@ exactly once), and ``http_frontend.ServingFrontend`` puts any engine
 on a port as a stdlib-only HTTP/SSE server (POST submit -> SSE token
 stream, backpressure as HTTP status, wire-level TTFT/ITL metrics).
 
+Above one engine sits the fleet tier (``fleet/``): a
+``FleetRouter`` places requests across N replica processes by
+health/occupancy (scraped replica status, circuit breaking, bounded
+retry of unstarted requests, shed-with-reason), and a
+``PrefillWorker``/``RemotePrefillClient`` pair disaggregates prefill
+from decode ACROSS processes — finished KV pages ship over a
+CRC-checked socket and adopt bit-identically to local prefill, with
+clean local fallback.
+
 Everything is pure Python + JAX and CPU-testable;
 ``tools/serve_bench.py`` replays a synthetic Poisson trace offline
-(``--http`` drives real SSE streams over localhost) and reports
-throughput/latency percentiles; ``make serve-smoke`` gates the HTTP
-round-trip end to end.
+(``--http`` drives real SSE streams over localhost; ``--fleet N``
+spawns replica subprocesses behind the router) and reports
+throughput/latency percentiles; ``make serve-smoke`` and
+``make fleet-smoke`` gate the HTTP and cluster paths end to end.
 """
 from .engine import ServingEngine, StaticBatchEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetRouter,
+    PrefillWorker,
+    RemotePrefillClient,
+    TransferError,
+)
 from .http_frontend import (  # noqa: F401
     FrontendMetrics,
     HTTPRejected,

@@ -70,6 +70,26 @@ def _unflatten(flat):
     return [(flat[2 * i], flat[2 * i + 1]) for i in range(len(flat) // 2)]
 
 
+def build_prefill_body(net, do_sample, top_k, top_p):
+    """The (un-jitted) bucketed-prefill program body every prefill site
+    shares: the engines' per-bucket programs and the fleet tier's
+    remote :class:`~.fleet.kv_transfer.PrefillWorker` trace the SAME
+    function, which is what makes a disaggregated prefill bit-identical
+    to a local one (same weights -> same block, same first token)."""
+
+    def body(params, buffers, ids, length, flat_block, temperature, key):
+        net.load_functional_state(params, buffers)
+        net.eval()
+        logits, caches = prefill(
+            net, ids, _unflatten(flat_block), length=length
+        )
+        nxt = _select_next(logits, do_sample, temperature, top_k, top_p,
+                           key)
+        return nxt, _flatten(caches)
+
+    return body
+
+
 class _Seq:
     """Host-side state of one running sequence (one slab row)."""
 
@@ -103,10 +123,19 @@ class ServingEngine:
                  top_k=0, top_p=1.0, seed=0, min_bucket=16,
                  max_queue_size=64, max_tokens_in_flight=None,
                  scheduler=None, metrics=None, pool=None,
-                 clock=time.monotonic, recompile_guard_max=None):
+                 clock=time.monotonic, recompile_guard_max=None,
+                 weights_version=None):
         cfg = net.config
         self.net = net
         self.config = cfg
+        # routing-tier identity: which weights this engine serves.
+        # `generation` counts in-place weight swaps (live reload bumps
+        # it); `weights_version` names the checkpoint. A fleet router
+        # reads both off the replica status JSON.
+        self.generation = 0
+        self.weights_version = (
+            "v0" if weights_version is None else str(weights_version)
+        )
         self.max_batch_size = int(max_batch_size)
         self.max_seq_len = int(max_seq_len)
         self.clock = clock
@@ -205,18 +234,8 @@ class ServingEngine:
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
             return fn
-
-        def body(params, buffers, ids, length, flat_block, temperature,
-                 key):
-            self.net.load_functional_state(params, buffers)
-            self.net.eval()
-            logits, caches = prefill(
-                self.net, ids, _unflatten(flat_block), length=length
-            )
-            nxt = _select_next(logits, self.do_sample, temperature,
-                               self.top_k, self.top_p, key)
-            return nxt, _flatten(caches)
-
+        body = build_prefill_body(self.net, self.do_sample, self.top_k,
+                                  self.top_p)
         fn = jax.jit(
             body, donate_argnums=(4,) if self._donate else ()
         )

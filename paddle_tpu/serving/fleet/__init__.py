@@ -1,0 +1,35 @@
+"""paddle_tpu.serving.fleet — cluster serving tier.
+
+The unit of scaling above one engine: a *fleet* of engine replicas
+behind an occupancy-aware routing front-end, with true cross-process
+prefill->decode disaggregation.
+
+- :mod:`router` — :class:`FleetRouter`: a stdlib-HTTP front-end that
+  terminates ``/v1/generate`` SSE and places each request on the
+  least-loaded healthy replica (free pages x queue depth, scraped from
+  the replicas' machine-readable ``/healthz`` status), with per-replica
+  circuit breaking, bounded retry of UNSTARTED requests, shed-with-
+  reason when the whole fleet is saturated, and an aggregated
+  ``/metrics`` exposition carrying per-replica health series.
+- :mod:`kv_transfer` — the disaggregation wire: a
+  :class:`PrefillWorker` runs bucketed prefill and ships the finished
+  KV pages (bf16 or int8 + scales) as length-prefixed, CRC-checked
+  page payloads over a socket; a :class:`RemotePrefillClient` attached
+  to a ``PagedServingEngine`` adopts them through the existing
+  per-bucket adopt-pages programs. Token streams are EXACT-EQUAL to
+  local prefill (same compiled program, same weights), and any
+  transfer failure falls back to local prefill cleanly.
+- :mod:`launch` — subprocess entrypoints (``python -m
+  paddle_tpu.serving.fleet.launch``) that put a replica or a prefill
+  worker on an ephemeral port, plus the spawn helpers
+  ``serve_bench --fleet`` / ``make fleet-smoke`` / tests share.
+
+Everything is stdlib + the existing serving stack: single-machine
+multi-process today, and the seam multi-host pools deploy behind.
+"""
+from .kv_transfer import (  # noqa: F401
+    PrefillWorker,
+    RemotePrefillClient,
+    TransferError,
+)
+from .router import FleetRouter, RouterMetrics  # noqa: F401

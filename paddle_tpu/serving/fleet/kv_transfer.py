@@ -1,0 +1,511 @@
+"""Cross-process prefill->decode disaggregation: the KV transfer wire.
+
+The within-process disaggregation lever (``max_prefills_per_step``)
+bounds how much prefill work can delay decode, but one host still pays
+for both phases. This module splits them across PROCESSES: a
+:class:`PrefillWorker` owns the prompt phase — it runs the same
+compiled per-bucket prefill program the engines use
+(:func:`~..engine.build_prefill_body`) and ships the finished KV block
+to the decode replica as PAGE payloads; the decode engine adopts them
+through its existing per-bucket adopt-pages scatter and the request
+enters the decode batch exactly as if it had prefilled locally.
+
+Wire format (one socket, length-prefixed frames, CRC-checked)::
+
+    frame := MAGIC(4) | payload_len(u64 BE) | crc32(u32 BE) | payload
+    payload := header_len(u32 BE) | header_json | raw_bytes
+
+A prefill exchange is one request frame (prompt ids + bucket geometry +
+sampling temperature/key) answered by one ``prefilled`` meta frame and
+then one frame per cache array, each reshaped to ``[n_pages,
+page_size, kvH, D]`` — pages are the transfer unit, mirroring the page
+arena they land in. int8 pools ship TWO frames per array (int8 codes +
+fp32 scales), so quantized transfer is bit-exact too. A corrupted
+frame (bad magic, short read, CRC mismatch) raises
+:class:`TransferError`; the engine's response is always the same: fall
+back to LOCAL prefill and keep serving (disaggregation is an
+optimization, never a correctness dependency).
+
+Exactness contract: worker and engine trace the SAME prefill body over
+the SAME weights, so the shipped block and first token are
+bit-identical to what local prefill would have produced — the tier-1
+test pins arena equality after adoption, and the fleet smoke pins
+token streams.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...models.generation import alloc_kv_caches, normalize_cache_dtype
+from ...quantization.kv import QuantizedKV, is_quantized
+from ..engine import _flatten, build_prefill_body
+from ..metrics import Counter
+
+MAGIC = b"PKV1"
+_HEAD = struct.Struct(">QI")   # payload_len, crc32
+_HLEN = struct.Struct(">I")    # header_json length
+# one frame is at most a few pages of KV; anything past this is a
+# corrupted length field, not a real payload
+MAX_FRAME_BYTES = 1 << 31
+
+
+class TransferError(RuntimeError):
+    """Any failure of the KV transfer path (connect, frame, CRC,
+    worker-side error). The decode engine catches exactly this and
+    falls back to local prefill."""
+
+
+# ------------------------------------------------------------------ frames
+def send_frame(sock, header, blob=b""):
+    hj = json.dumps(header).encode("utf-8")
+    payload = _HLEN.pack(len(hj)) + hj + bytes(blob)
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    try:
+        sock.sendall(MAGIC + _HEAD.pack(len(payload), crc) + payload)
+    except OSError as e:
+        raise TransferError(f"send failed: {e!r}")
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+        except OSError as e:
+            raise TransferError(f"recv failed: {e!r}")
+        if not chunk:
+            raise TransferError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock):
+    head = _recv_exact(sock, 4 + _HEAD.size)
+    if head[:4] != MAGIC:
+        raise TransferError(f"bad frame magic {head[:4]!r}")
+    length, crc = _HEAD.unpack(head[4:])
+    if length < _HLEN.size or length > MAX_FRAME_BYTES:
+        raise TransferError(f"implausible frame length {length}")
+    payload = _recv_exact(sock, length)
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise TransferError("frame CRC mismatch")
+    hlen = _HLEN.unpack(payload[:_HLEN.size])[0]
+    if _HLEN.size + hlen > length:
+        raise TransferError("frame header overruns payload")
+    try:
+        header = json.loads(payload[_HLEN.size:_HLEN.size + hlen]
+                            .decode("utf-8"))
+    except Exception as e:
+        raise TransferError(f"bad frame header: {e!r}")
+    return header, payload[_HLEN.size + hlen:]
+
+
+def _encode_array(arr):
+    a = np.asarray(arr)
+    return {"dtype": str(a.dtype), "shape": list(a.shape)}, a.tobytes()
+
+
+def _decode_array(header, blob):
+    dt = jnp.dtype(header["dtype"])
+    n = int(np.prod(header["shape"])) * dt.itemsize
+    if len(blob) != n:
+        raise TransferError(
+            f"array payload {len(blob)}B != expected {n}B for "
+            f"{header['dtype']}{header['shape']}"
+        )
+    return np.frombuffer(blob, dtype=dt).reshape(header["shape"])
+
+
+# ------------------------------------------------------------------ worker
+class PrefillWorker:
+    """The prefill pool's unit: a socket server that runs bucketed
+    prefill and ships the finished KV pages.
+
+    Holds a weights snapshot of ``net`` (same discipline as the
+    engines) and compiles one prefill program per ``(bucket,
+    cache_dtype)`` on demand — the block arrays are reused across
+    requests exactly like the engines' bucketed block pool (every
+    bucket position is rewritten each prefill). Requests are served
+    one at a time under a lock: prefill is compute-bound, and the
+    decode replicas' fallback path means a slow worker degrades to
+    local prefill rather than queueing.
+
+    ``do_sample``/``top_k``/``top_p`` are baked into the compiled
+    program and must match the decode engines'; temperature and the
+    PRNG key travel per request, so sampled streams stay reproducible.
+    """
+
+    def __init__(self, net, *, host="127.0.0.1", port=0, do_sample=False,
+                 top_k=0, top_p=1.0, weights_version=None):
+        self.net = net
+        self.config = net.config
+        self.do_sample = bool(do_sample)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p) if top_p is not None else 1.0
+        self.weights_version = (
+            "v0" if weights_version is None else str(weights_version)
+        )
+        self._params = {k: p.value for k, p in net.named_parameters()}
+        self._buffers = {k: b.value for k, b in net.named_buffers()}
+        self._was_training = net.training
+        self._fns = {}      # (bucket, dtype_name) -> jitted program
+        self._blocks = {}   # (bucket, dtype_name) -> flat block arrays
+        self._traced = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.host = host
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = None
+        self.served = 0
+        self.errors = 0
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="paddle-prefill-worker",
+            daemon=True,
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+            self._accept_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def stats(self):
+        return {
+            "port": self.port,
+            "served": self.served,
+            "errors": self.errors,
+            "weights_version": self.weights_version,
+            "buckets": sorted({b for b, _ in self._fns}),
+        }
+
+    # ------------------------------------------------------------- serving
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn):
+        conn.settimeout(60.0)
+        try:
+            while not self._stop.is_set():
+                try:
+                    req, _ = recv_frame(conn)
+                except TransferError:
+                    return  # client went away / corrupt stream
+                try:
+                    if req.get("kind") == "ping":
+                        send_frame(conn, {"kind": "pong",
+                                          "stats": self.stats()})
+                        continue
+                    if req.get("kind") != "prefill":
+                        raise ValueError(
+                            f"unknown request kind {req.get('kind')!r}"
+                        )
+                    self._handle_prefill(conn, req)
+                    self.served += 1
+                except TransferError:
+                    self.errors += 1
+                    return  # send path broken; nothing else to say
+                except Exception as e:
+                    self.errors += 1
+                    try:
+                        send_frame(conn, {"kind": "error",
+                                          "error": repr(e)})
+                    except TransferError:
+                        return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _program(self, bucket, dtype_name):
+        key = (bucket, dtype_name)
+        fn = self._fns.get(key)
+        if fn is None:
+            body = build_prefill_body(self.net, self.do_sample,
+                                      self.top_k, self.top_p)
+            fn = jax.jit(body)
+            self._fns[key] = fn
+        blk = self._blocks.get(key)
+        if blk is None:
+            blk = _flatten(alloc_kv_caches(self.config, 1, bucket,
+                                           dtype_name))
+            self._blocks[key] = blk
+        return fn, blk
+
+    def _handle_prefill(self, conn, req):
+        bucket = int(req["bucket"])
+        ps = int(req["page_size"])
+        prompt = [int(t) for t in req["prompt"]]
+        L = int(req["prompt_len"])
+        if L != len(prompt) or not 1 <= L <= bucket:
+            raise ValueError(
+                f"prompt_len {L} inconsistent with prompt/bucket "
+                f"{len(prompt)}/{bucket}"
+            )
+        if ps < 1 or bucket % ps:
+            raise ValueError(
+                f"page_size {ps} must divide bucket {bucket}"
+            )
+        dtype_name = normalize_cache_dtype(req["cache_dtype"])
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :L] = prompt
+        key = jnp.asarray(np.asarray(req["key"], np.uint32))
+        with self._lock:
+            fn, blk = self._program(bucket, dtype_name)
+            nxt, new_flat = fn(
+                self._params, self._buffers, jnp.asarray(ids),
+                jnp.int32(L), blk,
+                jnp.float32(req.get("temperature", 1.0)), key,
+            )
+            trace_key = ("prefill", bucket, dtype_name)
+            if trace_key not in self._traced:
+                # tracing swapped tracers into the Layer objects —
+                # restore concrete state (the engines' _run discipline)
+                self._traced.add(trace_key)
+                self.net.load_functional_state(self._params,
+                                               self._buffers)
+                if self._was_training:
+                    self.net.train()
+                else:
+                    self.net.eval()
+            # the returned block (this request's KV) doubles as the
+            # next request's input block, like the engines' block pool
+            self._blocks[(bucket, dtype_name)] = new_flat
+            t0 = int(np.asarray(nxt)[0])
+        n_pages = bucket // ps
+        send_frame(conn, {
+            "kind": "prefilled", "first_token": t0, "bucket": bucket,
+            "page_size": ps, "n_pages": n_pages,
+            "cache_dtype": dtype_name, "entries": len(new_flat),
+            "weights_version": self.weights_version,
+        })
+        for arr in new_flat:
+            if is_quantized(arr):
+                kvh, d = arr.q.shape[2], arr.q.shape[3]
+                h, b = _encode_array(
+                    np.asarray(arr.q)[0].reshape(n_pages, ps, kvh, d)
+                )
+                send_frame(conn, dict(h, part="q"), b)
+                h, b = _encode_array(
+                    np.asarray(arr.scale)[0].reshape(n_pages, ps, kvh)
+                )
+                send_frame(conn, dict(h, part="scale"), b)
+            else:
+                a = np.asarray(arr)
+                kvh, d = a.shape[2], a.shape[3]
+                h, b = _encode_array(a[0].reshape(n_pages, ps, kvh, d))
+                send_frame(conn, dict(h, part="dense"), b)
+
+
+# ------------------------------------------------------------------ client
+class RemotePrefillClient:
+    """The decode replica's end of the transfer: attached to a
+    ``PagedServingEngine`` as ``prefill_transport``, it ships each
+    admission's prompt to the prefill pool and returns ``(first_token,
+    flat_block)`` ready for the engine's adopt-pages program.
+
+    Single-threaded by design (only the engine's driver thread calls
+    it). Any failure raises :class:`TransferError` AND opens a
+    cooldown window — ``available()`` goes False for ``cooldown_s`` so
+    a dead worker costs one connect timeout, not one per admission —
+    then half-opens for a fresh attempt."""
+
+    def __init__(self, host, port, *, timeout_s=10.0, cooldown_s=2.0,
+                 expected_weights_version=None, registry=None,
+                 clock=time.monotonic):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.cooldown_s = float(cooldown_s)
+        self.expected_weights_version = expected_weights_version
+        self.clock = clock
+        self._sock = None
+        self._down_until = 0.0
+        self.transfers = Counter(
+            "kv_transfers", labelname="outcome",
+            prom_name="paddle_fleet_kv_transfers_total",
+            help="remote prefill transfers, by outcome")
+        self.transfer_bytes = Counter(
+            "kv_transfer_bytes",
+            prom_name="paddle_fleet_kv_transfer_bytes_total",
+            help="KV page payload bytes received from the prefill pool")
+        if registry is None:
+            from ...observability import get_registry
+
+            registry = get_registry()
+        registry.register_all([self.transfers, self.transfer_bytes])
+
+    def available(self):
+        return self.clock() >= self._down_until
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _mark_down(self):
+        self.close()
+        self._down_until = self.clock() + self.cooldown_s
+
+    def _connection(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s
+            )
+        return self._sock
+
+    def prefill(self, prompt, prompt_len, bucket, page_size,
+                cache_dtype, temperature, key):
+        """One remote prefill: returns ``(first_token, flat_block)``
+        where ``flat_block`` matches the engine's local prefill output
+        (``[1, bucket, kvH, D]`` per K/V per layer; ``QuantizedKV``
+        for int8 pools). Raises :class:`TransferError` on ANY failure
+        after opening the cooldown window.
+
+        A failure on a REUSED connection gets one fresh-connection
+        retry first: the worker idle-closes connections (and may have
+        restarted), and a stale cached socket must not demote a
+        healthy worker to local-prefill + cooldown. Prefill is pure
+        compute, so the retry is safe to replay."""
+        args = (prompt, prompt_len, bucket, page_size, cache_dtype,
+                temperature, key)
+        reused = self._sock is not None
+        try:
+            t0, flat, nbytes = self._prefill_once(*args)
+        except TransferError:
+            if not reused:
+                self._mark_down()
+                self.transfers.inc(label="error")
+                raise
+            self.close()
+            try:
+                t0, flat, nbytes = self._prefill_once(*args)
+            except TransferError:
+                self._mark_down()
+                self.transfers.inc(label="error")
+                raise
+        self.transfers.inc(label="ok")
+        self.transfer_bytes.inc(nbytes)
+        return t0, flat
+
+    def _prefill_once(self, prompt, prompt_len, bucket, page_size,
+                      cache_dtype, temperature, key):
+        try:
+            sock = self._connection()
+            send_frame(sock, {
+                "kind": "prefill",
+                "prompt": [int(t) for t in prompt],
+                "prompt_len": int(prompt_len),
+                "bucket": int(bucket),
+                "page_size": int(page_size),
+                "cache_dtype": str(cache_dtype),
+                "temperature": float(temperature),
+                "key": [int(x) for x in np.asarray(key).ravel()],
+            })
+            meta, _ = recv_frame(sock)
+            if meta.get("kind") == "error":
+                raise TransferError(
+                    f"worker error: {meta.get('error')}"
+                )
+            if meta.get("kind") != "prefilled":
+                raise TransferError(
+                    f"unexpected response kind {meta.get('kind')!r}"
+                )
+            if (self.expected_weights_version is not None
+                    and meta.get("weights_version")
+                    != self.expected_weights_version):
+                raise TransferError(
+                    f"weights version skew: worker serves "
+                    f"{meta.get('weights_version')!r}, engine expects "
+                    f"{self.expected_weights_version!r}"
+                )
+            bkt = int(meta["bucket"])
+            flat, nbytes = [], 0
+            for _ in range(int(meta["entries"])):
+                h, blob = recv_frame(sock)
+                nbytes += len(blob)
+                if h.get("part") == "q":
+                    hs, sb = recv_frame(sock)
+                    nbytes += len(sb)
+                    if hs.get("part") != "scale":
+                        raise TransferError(
+                            "quantized entry missing its scale frame"
+                        )
+                    q = _decode_array(h, blob)
+                    s = _decode_array(hs, sb)
+                    kvh, d = q.shape[2], q.shape[3]
+                    flat.append(QuantizedKV(
+                        jnp.asarray(q.reshape(1, bkt, kvh, d)),
+                        jnp.asarray(s.reshape(1, bkt, kvh)),
+                    ))
+                else:
+                    a = _decode_array(h, blob)
+                    kvh, d = a.shape[2], a.shape[3]
+                    flat.append(
+                        jnp.asarray(a.reshape(1, bkt, kvh, d))
+                    )
+        except TransferError:
+            self.close()  # protocol state unknown; never reuse it
+            raise
+        except (OSError, KeyError, ValueError) as e:
+            self.close()
+            raise TransferError(repr(e))
+        return int(meta["first_token"]), flat, nbytes
+
+    def ping(self):
+        """Round-trip liveness probe; returns the worker's stats dict
+        or raises :class:`TransferError`."""
+        try:
+            sock = self._connection()
+            send_frame(sock, {"kind": "ping"})
+            meta, _ = recv_frame(sock)
+            if meta.get("kind") != "pong":
+                raise TransferError(
+                    f"unexpected ping response {meta.get('kind')!r}"
+                )
+            return meta.get("stats", {})
+        except (OSError, TransferError) as e:
+            self._mark_down()
+            raise TransferError(repr(e))

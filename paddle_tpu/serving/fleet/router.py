@@ -1,0 +1,704 @@
+"""Occupancy-aware replica router — the fleet's front door.
+
+One :class:`~..http_frontend.ServingFrontend` serves one engine; at the
+millions-of-users north star the unit of scaling is a FLEET of them.
+:class:`FleetRouter` is a stdlib-HTTP front-end with the same wire
+surface (``POST /v1/generate`` -> SSE token stream) that places each
+request on the best replica:
+
+- **Admission signal**: a scrape loop polls every replica's
+  machine-readable ``/healthz`` status (free pages, queue depth,
+  in-flight, draining, generation) and publishes it as per-replica
+  gauges. Placement picks the eligible replica with the LOWEST load
+  score ``(1 + queue_depth + active + routed_in_flight) / (1 +
+  free_pages)`` — free pages are capacity, queue depth is pressure,
+  and the router's own in-flight count covers scrape staleness.
+- **Circuit breaking**: request-path failures (connect errors, 5xx)
+  count per replica; past ``breaker_threshold`` consecutive failures
+  the breaker OPENS for ``breaker_cooldown_s`` (placement skips it),
+  then half-opens for one fresh attempt. A success closes it.
+- **Bounded retry of UNSTARTED requests**: a request that failed
+  before its first token event (connect refused, replica 429/503,
+  mid-handshake death) is retried on the next-best replica, each
+  eligible replica tried at most once. A request that already
+  streamed tokens is NEVER replayed — its stream ends with a terminal
+  ``event: error`` carrying the reason (``replica_failed``), because
+  re-running a partially-streamed decode would duplicate tokens.
+- **Shed with reason**: when every eligible replica rejects with
+  backpressure the client gets HTTP 429 ``{"reason":
+  "fleet_saturated"}`` BEFORE any stream opens; an empty/unhealthy
+  fleet sheds 503 ``no_replicas``; all-connect-failures sheds 502
+  ``replicas_unavailable``.
+- **Aggregated /metrics**: the router's process registry exposition
+  carries the routing counters AND the per-replica health series
+  (``paddle_fleet_replica_{healthy,free_pages,queue_depth,active}``),
+  so one scrape shows the whole fleet.
+
+Admin surface: ``GET /replicas`` (full status JSON), ``POST
+/admin/drain/<i>`` / ``/admin/undrain/<i>`` proxy the replica's drain
+toggle and immediately stop/resume routing to it — rotate a replica
+out with zero dropped requests.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ...observability import Gauge, get_registry
+from ...observability.exporter import prometheus_text
+from ..metrics import Counter, Histogram
+
+# terminal stream-abort reasons the router originates
+ABORT_REPLICA_FAILED = "replica_failed"
+ABORT_CLIENT_DISCONNECT = "client_disconnect"
+
+SHED_FLEET_SATURATED = "fleet_saturated"
+SHED_NO_REPLICAS = "no_replicas"
+SHED_REPLICAS_UNAVAILABLE = "replicas_unavailable"
+
+_SHED_STATUS = {
+    SHED_FLEET_SATURATED: 429,
+    SHED_NO_REPLICAS: 503,
+    SHED_REPLICAS_UNAVAILABLE: 502,
+}
+
+
+class RouterMetrics:
+    """The router's registry instruments: routing counters + the
+    per-replica health gauges the scrape loop feeds."""
+
+    def __init__(self, registry=None, namespace="paddle_fleet"):
+        ns = namespace
+        self.requests = Counter(
+            "fleet_requests", labelname="replica",
+            prom_name=f"{ns}_requests_total",
+            help="requests routed, by replica index")
+        self.http_requests = Counter(
+            "fleet_http_requests", labelname="code",
+            prom_name=f"{ns}_http_requests_total",
+            help="router HTTP responses, by status code")
+        self.retries = Counter(
+            "fleet_retries", labelname="reason",
+            prom_name=f"{ns}_retries_total",
+            help="unstarted requests retried on another replica, by "
+                 "trigger")
+        self.shed = Counter(
+            "fleet_shed", labelname="reason",
+            prom_name=f"{ns}_shed_total",
+            help="requests shed by the router, by reason")
+        self.breaker_opens = Counter(
+            "fleet_breaker_opens", labelname="replica",
+            prom_name=f"{ns}_breaker_opens_total",
+            help="circuit-breaker opens, by replica index")
+        self.stream_aborts = Counter(
+            "fleet_stream_aborts", labelname="reason",
+            prom_name=f"{ns}_stream_aborts_total",
+            help="router-side streams ended by a terminal error event")
+        self.ttft = Histogram(
+            "fleet_ttft", prom_name=f"{ns}_router_ttft_seconds",
+            help="router-received to first token byte forwarded")
+        self.replica_healthy = Gauge(
+            "fleet_replica_healthy",
+            prom_name=f"{ns}_replica_healthy",
+            help="1 when the replica's last status scrape succeeded")
+        self.replica_free_pages = Gauge(
+            "fleet_replica_free_pages",
+            prom_name=f"{ns}_replica_free_pages",
+            help="free KV pages from the replica's last status")
+        self.replica_queue_depth = Gauge(
+            "fleet_replica_queue_depth",
+            prom_name=f"{ns}_replica_queue_depth",
+            help="scheduler queue depth from the replica's last status")
+        self.replica_active = Gauge(
+            "fleet_replica_active",
+            prom_name=f"{ns}_replica_active",
+            help="in-flight decode rows from the replica's last status")
+        reg = registry or get_registry()
+        reg.register_all([
+            self.requests, self.http_requests, self.retries, self.shed,
+            self.breaker_opens, self.stream_aborts, self.ttft,
+            self.replica_healthy, self.replica_free_pages,
+            self.replica_queue_depth, self.replica_active,
+        ])
+
+
+class ReplicaState:
+    """Router-side view of one replica."""
+
+    def __init__(self, index, host, port):
+        self.index = index
+        self.host = host
+        self.port = int(port)
+        self.status = None          # last /healthz JSON
+        self.status_time = 0.0
+        self.healthy = False
+        self.draining = False
+        self.in_flight = 0          # router-side routed-not-finished
+        self.failures = 0           # consecutive request-path failures
+        self.breaker_open_until = 0.0
+        self.requests_routed = 0
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def summary(self, now):
+        st = self.status or {}
+        return {
+            "index": self.index,
+            "host": self.host,
+            "port": self.port,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "breaker_open": now < self.breaker_open_until,
+            "in_flight_routed": self.in_flight,
+            "requests_routed": self.requests_routed,
+            "status_age_s": (None if not self.status_time
+                             else round(now - self.status_time, 3)),
+            "free_pages": st.get("free_pages"),
+            "queue_depth": st.get("queue_depth"),
+            "active": st.get("active"),
+            "generation": st.get("generation"),
+            "weights_version": st.get("weights_version"),
+        }
+
+
+def _parse_replica(spec):
+    if isinstance(spec, (tuple, list)):
+        return str(spec[0]), int(spec[1])
+    host, _, port = str(spec).rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+class FleetRouter:
+    """Route ``/v1/generate`` across N engine replicas.
+
+    ``replicas`` is a list of ``(host, port)`` pairs or
+    ``"host:port"`` strings — each the address of a
+    :class:`~..http_frontend.ServingFrontend`. ``port=0`` binds the
+    router on an ephemeral port (read ``.port`` back)."""
+
+    def __init__(self, replicas, *, host="127.0.0.1", port=0,
+                 registry=None, health_interval_s=0.25,
+                 status_ttl_s=3.0, breaker_threshold=3,
+                 breaker_cooldown_s=2.0, connect_timeout_s=5.0,
+                 stream_timeout_s=120.0, clock=time.monotonic):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = [
+            ReplicaState(i, *_parse_replica(s))
+            for i, s in enumerate(replicas)
+        ]
+        self.host = host
+        self.port = int(port)
+        self.metrics = RouterMetrics(registry=registry)
+        self.health_interval_s = float(health_interval_s)
+        self.status_ttl_s = float(status_ttl_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.stream_timeout_s = float(stream_timeout_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._httpd = None
+        self._http_thread = None
+        self._scrape_thread = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self):
+        from ..httpd import start_http_server
+
+        # one synchronous scrape first, so the router can place
+        # requests the moment start() returns
+        self._scrape_all()
+        self._httpd, self._http_thread = start_http_server(
+            self.host, self.port, self._handle_get, self._handle_post,
+            name="paddle-fleet-http",
+        )
+        self.port = self._httpd.server_address[1]
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="paddle-fleet-scrape",
+            daemon=True,
+        )
+        self._scrape_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5)
+            self._scrape_thread = None
+        from ..httpd import stop_http_server
+
+        stop_http_server(self._httpd, self._http_thread)
+        self._httpd = None
+        self._http_thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------- scrape
+    def _scrape_one(self, r):
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=max(self.health_interval_s, 1.0)
+            )
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                raise OSError(f"healthz HTTP {resp.status}")
+            status = json.loads(body)
+        except (OSError, ValueError) as e:
+            with self._lock:
+                r.healthy = False
+                r.status_time = self.clock()
+                r.status = {"error": repr(e)}
+            self.metrics.replica_healthy.set(0, replica=str(r.index))
+            return
+        with self._lock:
+            r.status = status
+            r.status_time = self.clock()
+            r.healthy = bool(status.get("accepting", True))
+            r.draining = bool(status.get("draining", False))
+        m = self.metrics
+        idx = str(r.index)
+        m.replica_healthy.set(1 if r.healthy else 0, replica=idx)
+        for gauge, field in (
+            (m.replica_free_pages, "free_pages"),
+            (m.replica_queue_depth, "queue_depth"),
+            (m.replica_active, "active"),
+        ):
+            v = status.get(field)
+            if v is not None:
+                gauge.set(float(v), replica=idx)
+
+    def _scrape_all(self):
+        # one thread per replica: a few unreachable hosts hanging to
+        # their connect timeout must not age every HEALTHY replica's
+        # status past status_ttl_s (serial scraping would shed the
+        # whole fleet as stale)
+        threads = [
+            threading.Thread(target=self._scrape_one, args=(r,),
+                             daemon=True)
+            for r in self.replicas
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def _scrape_loop(self):
+        while not self._stop.wait(self.health_interval_s):
+            self._scrape_all()
+
+    # ---------------------------------------------------------- placement
+    def _eligible(self, now, exclude=()):
+        out = []
+        with self._lock:
+            for r in self.replicas:
+                if r.index in exclude:
+                    continue
+                if not r.healthy or r.draining:
+                    continue
+                if now < r.breaker_open_until:
+                    continue
+                if now - r.status_time > self.status_ttl_s:
+                    continue
+                out.append(r)
+        return out
+
+    def _pick(self, exclude=()):
+        """Least-loaded eligible replica, or None. Load folds the
+        scraped queue depth + active rows (pressure) against free
+        pages (capacity), plus the router's own in-flight count so two
+        back-to-back requests don't pile onto one replica between
+        scrapes."""
+        now = self.clock()
+        best, best_score = None, None
+        for r in self._eligible(now, exclude):
+            st = r.status or {}
+            pressure = 1.0 + float(st.get("queue_depth") or 0) \
+                + float(st.get("active") or 0) + float(r.in_flight)
+            capacity = 1.0 + float(st.get("free_pages") or 0)
+            score = pressure / capacity
+            if best_score is None or score < best_score:
+                best, best_score = r, score
+        return best
+
+    def _breaker_fail(self, r):
+        with self._lock:
+            r.failures += 1
+            r.healthy = False  # next scrape may resurrect it
+            if r.failures >= self.breaker_threshold:
+                r.breaker_open_until = (self.clock()
+                                        + self.breaker_cooldown_s)
+                r.failures = 0
+                opened = True
+            else:
+                opened = False
+        self.metrics.replica_healthy.set(0, replica=str(r.index))
+        if opened:
+            self.metrics.breaker_opens.inc(label=str(r.index))
+
+    def _breaker_ok(self, r):
+        with self._lock:
+            r.failures = 0
+            r.breaker_open_until = 0.0
+
+    # ----------------------------------------------------------- handlers
+    def _send_json(self, h, code, obj):
+        from ..httpd import send_json
+
+        try:
+            send_json(h, code, obj)
+        except OSError:
+            return
+        self.metrics.http_requests.inc(label=str(code))
+
+    def _handle_get(self, h):
+        from ..httpd import send_text
+
+        path = h.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                send_text(
+                    h, 200, prometheus_text().encode("utf-8"),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.metrics.http_requests.inc(label="200")
+            elif path in ("/healthz", "/replicas"):
+                now = self.clock()
+                reps = [r.summary(now) for r in self.replicas]
+                self._send_json(h, 200, {
+                    "role": "fleet-router",
+                    "replicas": reps,
+                    "eligible": len(self._eligible(now)),
+                })
+            else:
+                self._send_json(h, 404, {"error": "not found"})
+        except Exception as e:
+            try:
+                self._send_json(h, 500, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def _handle_post(self, h):
+        path = h.path.split("?", 1)[0]
+        if path.startswith("/admin/drain/") \
+                or path.startswith("/admin/undrain/"):
+            self._handle_admin_drain(h, path)
+            return
+        if path != "/v1/generate":
+            self._send_json(h, 404, {"error": "not found"})
+            return
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = h.rfile.read(n) or b"{}"
+            parsed = json.loads(body)
+            if not isinstance(parsed, dict):
+                raise ValueError("body must be a JSON object")
+        except Exception as e:
+            self._send_json(h, 400, {"error": f"bad request: {e}"})
+            return
+        stream = bool(parsed.get("stream", True))
+        try:
+            self._route(h, body, stream)
+        except Exception as e:
+            # last-ditch: the client must get a status or a terminal
+            # event, never a silently dropped connection
+            try:
+                self._send_json(h, 502, {"error": repr(e)})
+            except Exception:
+                pass
+
+    def _handle_admin_drain(self, h, path):
+        import http.client
+
+        undo = path.startswith("/admin/undrain/")
+        try:
+            idx = int(path.rsplit("/", 1)[1])
+            r = self.replicas[idx]
+        except (ValueError, IndexError):
+            self._send_json(h, 404, {"error": "no such replica"})
+            return
+        try:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=self.connect_timeout_s
+            )
+            conn.request("POST", "/undrain" if undo else "/drain")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            replica_resp = json.loads(body or b"{}")
+        except (OSError, ValueError) as e:
+            self._send_json(h, 502, {"error": repr(e),
+                                     "replica": idx})
+            return
+        # stop/resume routing immediately; the scrape loop keeps the
+        # flag in sync with the replica's own report afterwards
+        with self._lock:
+            r.draining = not undo
+        self._send_json(h, 200, {"replica": idx,
+                                 "draining": not undo,
+                                 "replica_response": replica_resp})
+
+    # ------------------------------------------------------------ routing
+    def _route(self, h, body, stream):
+        t_recv = self.clock()
+        tried = set()
+        saw_saturated = False
+        saw_conn_error = False
+        client = _ClientStream(h, self.metrics)
+        while True:
+            r = self._pick(exclude=tried)
+            if r is None:
+                break
+            tried.add(r.index)
+            with self._lock:
+                r.in_flight += 1
+            try:
+                outcome = self._try_replica(r, client, body, stream,
+                                            t_recv)
+            finally:
+                with self._lock:
+                    r.in_flight -= 1
+            if outcome == "done":
+                self._breaker_ok(r)
+                return
+            if outcome == "client_gone":
+                return
+            if outcome == "failed_after_tokens":
+                # terminal error already sent; never replayed
+                self._breaker_fail(r)
+                return
+            if outcome == "saturated":
+                saw_saturated = True
+                self.metrics.retries.inc(label="replica_busy")
+                continue
+            if outcome in ("conn_error", "midstream_unstarted"):
+                # midstream_unstarted already counted its retry label
+                # in _pipe_sse — one retry event, one sample
+                saw_conn_error = True
+                self._breaker_fail(r)
+                if outcome == "conn_error":
+                    self.metrics.retries.inc(label="conn_error")
+                continue
+            raise AssertionError(f"unknown outcome {outcome!r}")
+        # fleet exhausted: shed with a reason that tells the client
+        # (and the load balancer above us) what to do about it
+        if saw_saturated:
+            reason = SHED_FLEET_SATURATED
+        elif saw_conn_error:
+            reason = SHED_REPLICAS_UNAVAILABLE
+        else:
+            reason = SHED_NO_REPLICAS
+        self.metrics.shed.inc(label=reason)
+        if client.headers_sent:
+            # stream already open (a replica died mid-handshake after
+            # we committed to SSE): terminal error event, not a status
+            client.error_event({"reason": reason})
+            self.metrics.stream_aborts.inc(label=reason)
+        else:
+            self._send_json(h, _SHED_STATUS[reason], {
+                "error": "rejected", "reason": reason,
+                "replicas_tried": len(tried),
+            })
+
+    def _try_replica(self, r, client, body, stream, t_recv):
+        """One placement attempt. Returns 'done' | 'client_gone' |
+        'failed_after_tokens' | 'saturated' | 'conn_error' |
+        'midstream_unstarted'."""
+        import http.client
+
+        # a replica dying mid-response surfaces as HTTPException
+        # (BadStatusLine, IncompleteRead) — NOT an OSError subclass;
+        # both mean the same thing here: replica trouble, retryable
+        # while nothing reached the client
+        _replica_err = (OSError, http.client.HTTPException)
+        conn = http.client.HTTPConnection(
+            r.host, r.port, timeout=self.connect_timeout_s
+        )
+        try:
+            conn.request(
+                "POST", "/v1/generate", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            # connect is bounded by connect_timeout_s above; from here
+            # on reads wait on GENERATION (a non-stream response only
+            # arrives when decode finishes), so the stream timeout
+            # governs — for every branch, not just SSE piping
+            if conn.sock is not None:
+                conn.sock.settimeout(self.stream_timeout_s)
+            resp = conn.getresponse()
+        except _replica_err:
+            conn.close()
+            return "conn_error"
+        try:
+            if resp.status != 200:
+                try:
+                    payload = resp.read()
+                except _replica_err:
+                    return "conn_error"
+                if resp.status in (429, 503):
+                    # replica backpressure / draining / closed: the
+                    # request never started — try the next replica
+                    return "saturated"
+                if resp.status in (400, 413):
+                    # the REQUEST's fault; identical on every replica
+                    self._forward_reject(client, resp.status, payload)
+                    return "done"
+                return "conn_error"  # 5xx: replica trouble
+            self.metrics.requests.inc(label=str(r.index))
+            with self._lock:
+                r.requests_routed += 1
+            if not stream:
+                try:
+                    payload = resp.read()
+                except _replica_err:
+                    # nothing reached the client yet — retryable
+                    return "conn_error"
+                self._forward_reject(client, 200, payload)
+                return "done"
+            return self._pipe_sse(r, resp, client, t_recv)
+        finally:
+            conn.close()
+
+    def _forward_reject(self, client, code, payload):
+        try:
+            obj = json.loads(payload or b"{}")
+        except ValueError:
+            obj = {"raw": payload.decode("utf-8", "replace")}
+        if client.headers_sent:
+            # the SSE stream is already open (prior attempt died after
+            # the handshake) — a status line now would corrupt it
+            client.error_event(dict(obj, reason=obj.get(
+                "reason", f"http_{code}")))
+            return
+        self._send_json(client.h, code, obj)
+
+    def _pipe_sse(self, r, resp, client, t_recv):
+        """Forward the replica's SSE stream event-block by event-block.
+        Token events count toward the unstarted/started boundary; a
+        replica failure after the first forwarded token ends the
+        client stream with a terminal error event instead of a retry.
+        """
+        import http.client
+
+        tokens_forwarded = 0
+        try:
+            for block, event in _iter_sse_blocks(resp):
+                if not client.write(block):
+                    return "client_gone"
+                if event == "token":
+                    if tokens_forwarded == 0:
+                        self.metrics.ttft.observe(
+                            self.clock() - t_recv
+                        )
+                    tokens_forwarded += 1
+                elif event in ("done", "error"):
+                    return "done"
+            # stream ended without a terminal event: replica died
+            raise OSError("replica stream ended mid-request")
+        except (OSError, http.client.HTTPException):
+            if tokens_forwarded == 0:
+                # unstarted — safe to replay on another replica
+                self.metrics.retries.inc(label="midstream_unstarted")
+                return "midstream_unstarted"
+            client.error_event({
+                "reason": ABORT_REPLICA_FAILED,
+                "replica": r.index,
+                "tokens_forwarded": tokens_forwarded,
+            })
+            self.metrics.stream_aborts.inc(label=ABORT_REPLICA_FAILED)
+            return "failed_after_tokens"
+
+
+class _ClientStream:
+    """The router's half-open SSE response: headers sent lazily at the
+    first forwarded block, so an unstarted request can still fail over
+    to another replica (or shed with a plain HTTP status)."""
+
+    def __init__(self, h, metrics):
+        self.h = h
+        self.metrics = metrics
+        self.headers_sent = False
+        self.client_gone = False
+
+    def _send_headers(self):
+        self.h.send_response(200)
+        self.h.send_header("Content-Type", "text/event-stream")
+        self.h.send_header("Cache-Control", "no-cache")
+        self.h.send_header("Connection", "close")
+        self.h.end_headers()
+        self.headers_sent = True
+        self.metrics.http_requests.inc(label="200")
+
+    def write(self, block):
+        """Forward one SSE event block; False when the client is gone
+        (the caller aborts the upstream read)."""
+        if self.client_gone:
+            return False
+        try:
+            if not self.headers_sent:
+                self._send_headers()
+            self.h.wfile.write(block)
+            self.h.wfile.flush()
+            return True
+        except OSError:
+            self.client_gone = True
+            self.metrics.stream_aborts.inc(
+                label=ABORT_CLIENT_DISCONNECT
+            )
+            return False
+
+    def error_event(self, payload):
+        if self.client_gone:
+            return
+        try:
+            if not self.headers_sent:
+                self._send_headers()
+            data = json.dumps(payload)
+            self.h.wfile.write(
+                f"event: error\ndata: {data}\n\n".encode("utf-8")
+            )
+            self.h.wfile.flush()
+        except OSError:
+            self.client_gone = True
+
+
+def _iter_sse_blocks(fp):
+    """Yield ``(raw_block_bytes, event_name)`` per SSE event from a
+    replica response — raw bytes so forwarding is byte-faithful, the
+    event name so the router can track the token/terminal boundary.
+
+    A tail without its blank-line terminator is a TRUNCATED block (the
+    replica died mid-write) and is deliberately dropped — forwarding
+    half a ``data:`` line would corrupt the client's stream right
+    before the terminal error event; complete blocks always flush
+    inside the loop because writers end every event with ``\\n\\n``."""
+    lines = []
+    event = None
+    for raw in fp:
+        line = raw.decode("utf-8", "replace").rstrip("\n").rstrip("\r")
+        if line:
+            lines.append(raw)
+            if line.startswith("event:"):
+                event = line[6:].strip()
+            continue
+        if lines:
+            yield b"".join(lines) + b"\n", event
+            lines, event = [], None

@@ -64,6 +64,7 @@ _STATUS_FOR_REASON = {
     "too_long": 413,
     "shape_mismatch": 400,
     "engine_closed": 503,
+    "draining": 503,
 }
 
 
@@ -112,6 +113,11 @@ class ServingFrontend:
         self.port = int(port)
         self.metrics = FrontendMetrics(registry=registry)
         self.stream_timeout_s = float(stream_timeout_s)
+        # graceful drain: a draining frontend stops ADMITTING (new
+        # generate requests get 503 {"reason": "draining"}) but keeps
+        # the driver stepping, so every in-flight stream finishes —
+        # the router rotates a replica out with zero dropped requests
+        self.draining = False
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._httpd = None
@@ -123,30 +129,13 @@ class ServingFrontend:
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
-        import http.server
+        from .httpd import start_http_server
 
-        fe = self
-
-        class _Server(http.server.ThreadingHTTPServer):
-            daemon_threads = True  # SSE handlers must not pin shutdown
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def do_GET(self):
-                fe._handle_get(self)
-
-            def do_POST(self):
-                fe._handle_post(self)
-
-        self._httpd = _Server((self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._http_thread = threading.Thread(
-            target=self._httpd.serve_forever, name="paddle-serve-http",
-            daemon=True,
+        self._httpd, self._http_thread = start_http_server(
+            self.host, self.port, self._handle_get, self._handle_post,
+            name="paddle-serve-http",
         )
-        self._http_thread.start()
+        self.port = self._httpd.server_address[1]
         self._driver_thread = threading.Thread(
             target=self._drive, name="paddle-serve-driver", daemon=True,
         )
@@ -168,13 +157,11 @@ class ServingFrontend:
         if self._driver_thread is not None:
             self._driver_thread.join(timeout=10)
             self._driver_thread = None
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._http_thread is not None:
-            self._http_thread.join(timeout=10)
-            self._http_thread = None
+        from .httpd import stop_http_server
+
+        stop_http_server(self._httpd, self._http_thread)
+        self._httpd = None
+        self._http_thread = None
 
     def __enter__(self):
         return self.start()
@@ -223,27 +210,21 @@ class ServingFrontend:
 
     # ----------------------------------------------------------- handlers
     def _send_json(self, h, code, obj):
-        data = json.dumps(obj, default=str).encode("utf-8")
-        h.send_response(code)
-        h.send_header("Content-Type", "application/json")
-        h.send_header("Content-Length", str(len(data)))
-        h.end_headers()
-        h.wfile.write(data)
+        from .httpd import send_json
+
+        send_json(h, code, obj)
         self.metrics.http_requests.inc(label=str(code))
 
     def _handle_get(self, h):
+        from .httpd import send_text
+
         path = h.path.split("?", 1)[0]
         try:
             if path == "/metrics":
-                body = prometheus_text().encode("utf-8")
-                h.send_response(200)
-                h.send_header(
-                    "Content-Type",
+                send_text(
+                    h, 200, prometheus_text().encode("utf-8"),
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
-                h.send_header("Content-Length", str(len(body)))
-                h.end_headers()
-                h.wfile.write(body)
                 self.metrics.http_requests.inc(label="200")
             elif path == "/healthz":
                 self._send_json(h, 200, self.health())
@@ -256,12 +237,27 @@ class ServingFrontend:
                 pass
 
     def health(self):
+        """Machine-readable replica status — the routing-admission
+        signal a fleet router scrapes, not just a liveness bit: free
+        pages (capacity), queue depth + in-flight (pressure), engine
+        generation/weights version (routing can pin a version during a
+        rollout), and the draining/accepting flags."""
         eng = self.engine
+        queue_depth = getattr(eng.scheduler, "depth", 0)
+        active = getattr(eng, "active_slots", 0)
+        closed = bool(getattr(eng, "_closed", False))
         out = {
-            "queue_depth": getattr(eng.scheduler, "depth", 0),
-            "active": getattr(eng, "active_slots", 0),
-            "closed": bool(getattr(eng, "_closed", False)),
+            "queue_depth": queue_depth,
+            "active": active,
+            "in_flight": queue_depth + active,
+            "closed": closed,
+            "draining": bool(self.draining),
+            "accepting": not closed and not self.draining,
             "engine": type(eng).__name__,
+            "generation": getattr(eng, "generation", 0),
+            "weights_version": getattr(eng, "weights_version", None),
+            "max_queue_size": getattr(eng.scheduler, "max_queue_size",
+                                      None),
         }
         pool = getattr(eng, "pool", None)
         if pool is not None:
@@ -269,12 +265,38 @@ class ServingFrontend:
         page_pool = getattr(eng, "page_pool", None)
         if page_pool is not None:
             out["page_pool"] = page_pool.stats()
+            out["free_pages"] = page_pool.free_pages
+        else:
+            slab = getattr(eng, "_slab", None)
+            if slab is not None:
+                # slab rows are the closest capacity analogue
+                out["free_pages"] = slab.free_slots
+        transport = getattr(eng, "prefill_transport", None)
+        if transport is not None:
+            out["remote_prefill"] = {
+                "available": transport.available(),
+                "remote": getattr(eng, "remote_prefills", 0),
+                "local": getattr(eng, "local_prefills", 0),
+                "fallbacks": getattr(eng, "remote_prefill_fallbacks",
+                                     0),
+            }
         return out
 
     def _handle_post(self, h):
         path = h.path.split("?", 1)[0]
+        if path in ("/drain", "/undrain"):
+            # rotate-out seam: stop admitting, finish in-flight, report
+            # the moment the replica is idle via the status fields
+            self.draining = path == "/drain"
+            self._send_json(h, 200, self.health())
+            return
         if path != "/v1/generate":
             self._send_json(h, 404, {"error": "not found"})
+            return
+        if self.draining:
+            self._send_json(
+                h, 503, {"error": "rejected", "reason": "draining"}
+            )
             return
         try:
             n = int(h.headers.get("Content-Length", 0))

@@ -73,7 +73,8 @@ class PagedServingEngine(ServingEngine):
                  seed=0, min_bucket=16, max_queue_size=64,
                  max_tokens_in_flight=None, max_prefills_per_step=1,
                  scheduler=None, metrics=None, pool=None, page_pool=None,
-                 clock=time.monotonic, recompile_guard_max=None):
+                 clock=time.monotonic, recompile_guard_max=None,
+                 weights_version=None, prefill_transport=None):
         ps = int(page_size)
         if ps < 1 or (ps & (ps - 1)):
             raise ValueError(
@@ -97,6 +98,16 @@ class PagedServingEngine(ServingEngine):
             None if max_prefills_per_step is None
             else int(max_prefills_per_step)
         )
+        # cross-process disaggregation: when a transport (a
+        # fleet.kv_transfer.RemotePrefillClient) is attached, admission
+        # ships the prompt to the prefill pool and adopts the returned
+        # KV pages; any transfer failure falls back to LOCAL prefill on
+        # this engine — disaggregation is an optimization, never a
+        # correctness dependency.
+        self.prefill_transport = prefill_transport
+        self.remote_prefills = 0
+        self.local_prefills = 0
+        self.remote_prefill_fallbacks = 0
         super().__init__(
             net, max_batch_size=max_batch_size, max_seq_len=max_seq_len,
             cache_dtype=cache_dtype, do_sample=do_sample,
@@ -105,6 +116,7 @@ class PagedServingEngine(ServingEngine):
             max_tokens_in_flight=max_tokens_in_flight,
             scheduler=scheduler, metrics=metrics, pool=pool, clock=clock,
             recompile_guard_max=recompile_guard_max,
+            weights_version=weights_version,
         )
 
     # ------------------------------------------------------- KV backend
@@ -225,37 +237,81 @@ class PagedServingEngine(ServingEngine):
         return fn
 
     # ---------------------------------------------------------- requests
+    def _drop_block(self, blk):
+        """Return a prefill block after a failed admission. Under
+        donation the failed call may already have consumed the block's
+        buffers — recycling would poison the freelist, so discard."""
+        if blk is None:
+            return
+        if self._donate:
+            self.pool.discard(blk)
+        else:
+            self.pool.free(blk)
+
+    def _remote_prefill(self, req, bucket, key):
+        """Try the attached prefill pool: ``(first_token, flat_block)``
+        on success, None when the transport is absent/down/failing (the
+        caller runs local prefill — clean fallback, counted)."""
+        tr = self.prefill_transport
+        if tr is None or not tr.available():
+            return None
+        from .fleet.kv_transfer import TransferError
+
+        try:
+            out = tr.prefill(
+                [int(t) for t in req.input_ids], req.prompt_len, bucket,
+                self.page_size, str(self.cache_dtype),
+                float(self.temperature), key,
+            )
+        except TransferError:
+            self.remote_prefill_fallbacks += 1
+            return None
+        self.remote_prefills += 1
+        return out
+
     def _admit_one(self, handle):
         req = handle.request
         now = self.clock()
         bucket = self.pool.bucket_for(req.prompt_len)
         n_req = self.page_pool.pages_for(req.total_tokens)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, : req.prompt_len] = req.input_ids
-        blk = self.pool.alloc(req.prompt_len)
+        # sampling key drawn ONCE so a remote-prefill failure that falls
+        # back locally consumes the same key the pure-local path would —
+        # sampled streams stay reproducible either way
+        key = self._next_key()
+        remote = self._remote_prefill(req, bucket, key)
+        blk = None
+        if remote is None:
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, : req.prompt_len] = req.input_ids
+            blk = self.pool.alloc(req.prompt_len)
         # the budget gate already sized the claim against free pages;
         # claim + row pop still guarded so an exception can never
         # strand pages or a row
         try:
             pages = self.page_pool.claim(n_req)
         except PagesExhausted:
-            if self._donate:
-                self.pool.discard(blk)
-            else:
-                self.pool.free(blk)
+            self._drop_block(blk)
             raise
         row = self._free_rows.pop()
         try:
             self._tables[row, :] = 0
             self._tables[row, :n_req] = pages
-            with profiler.RecordEvent(f"serving::prefill_b{bucket}"):
-                nxt, new_flat = self._run(
-                    ("prefill", bucket), self._prefill_fn(bucket),
-                    self._params, self._buffers, jnp.asarray(ids),
-                    jnp.int32(req.prompt_len), _flatten(blk.caches),
-                    jnp.float32(self.temperature), self._next_key(),
-                )
-                blk.caches = _unflatten(new_flat)
+            if remote is None:
+                self.local_prefills += 1
+                with profiler.RecordEvent(f"serving::prefill_b{bucket}"):
+                    nxt, new_flat = self._run(
+                        ("prefill", bucket), self._prefill_fn(bucket),
+                        self._params, self._buffers, jnp.asarray(ids),
+                        jnp.int32(req.prompt_len), _flatten(blk.caches),
+                        jnp.float32(self.temperature), key,
+                    )
+                    blk.caches = _unflatten(new_flat)
+                    t0 = int(np.asarray(nxt)[0])
+            else:
+                # the prefill pool already ran the bucket program; the
+                # wire block adopts through the SAME compiled scatter
+                t0, new_flat = remote
+            with profiler.RecordEvent(f"serving::adopt_b{bucket}"):
                 # adopt: first min(n_req, bucket/ps) block pages land in
                 # the claim; block pad pages (prompt shorter than the
                 # bucket's page span) scatter to garbage page 0
@@ -267,19 +323,14 @@ class PagedServingEngine(ServingEngine):
                     ("adopt", bucket), self._adopt_fn(bucket),
                     self._flat, new_flat, jnp.asarray(page_ids),
                 )
-                t0 = int(np.asarray(nxt)[0])
         except BaseException:
             self._tables[row, :] = 0
             self._free_rows.append(row)
             self.page_pool.release(pages)
-            # under donation the failed call may already have consumed
-            # the block's buffers — recycling would poison the freelist
-            if self._donate:
-                self.pool.discard(blk)
-            else:
-                self.pool.free(blk)
+            self._drop_block(blk)
             raise
-        self.pool.free(blk)
+        if blk is not None:
+            self.pool.free(blk)
         self._row_pages[row] = pages
         handle.status = RUNNING
         handle.admit_time = now
@@ -295,5 +346,7 @@ class PagedServingEngine(ServingEngine):
 
     def close(self):
         super().close()
+        if self.prefill_transport is not None:
+            self.prefill_transport.close()
         self._tables = None
         self._row_pages = [None] * self.max_batch_size

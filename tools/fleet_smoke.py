@@ -1,0 +1,325 @@
+"""fleet-smoke — end-to-end gate for the cluster serving tier.
+
+Spawns REAL subprocesses (identical weights via the shared seed):
+a prefill-pool worker, two plain replicas, and one replica attached to
+the worker, then asserts the fleet contract:
+
+1. **Disaggregated prefill is exact**: the same prompts streamed
+   through the prefill-attached replica and a plain replica produce
+   IDENTICAL token streams, both equal to a local ``net.generate``;
+   the replica's status must show the prefills actually went remote.
+2. **Throughput scales with replicas**: a saturating closed-loop burst
+   through the router at fleet size 1 vs 2 must show aggregate
+   decode tokens/s scaling (loose >= 1.25x bound — the claim is
+   "adding a replica adds throughput", not a tight benchmark).
+3. **Kill-a-replica sheds cleanly**: SIGKILL one replica mid-run of
+   concurrent SSE streams. Every stream must end with a terminal
+   event — DONE streams token-exact, failed streams carrying reason
+   ``replica_failed`` (never a hang) — fresh requests after the kill
+   must complete via retry/re-scrape on the survivor, and the
+   survivor must drain to ZERO leaked pages.
+4. **Aggregated /metrics parses** with nonzero per-replica series.
+
+Exit 0 = gate passed. Wired as ``make fleet-smoke`` next to
+``serve-smoke``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SEED = 7
+MODEL = ["--vocab", "64", "--hidden", "32", "--layers", "2",
+         "--heads", "4", "--seed", str(SEED)]
+ENGINE = ["--max-batch", "2", "--max-seq", "64", "--min-bucket", "8",
+          "--page-size", "8"]
+
+
+def _local_reference():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(SEED)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _generate_ref(net, ids, max_new):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray(np.asarray(ids)[None, :])),
+        max_new_tokens=max_new,
+    ).numpy())
+    return [int(t) for t in out[0][len(ids):]]
+
+
+def _stream(port, ids, max_new):
+    from paddle_tpu.serving import HTTPRejected, stream_generate
+
+    try:
+        events, _ = stream_generate(
+            "127.0.0.1", port,
+            {"input_ids": [int(t) for t in ids],
+             "max_new_tokens": int(max_new)},
+        )
+    except HTTPRejected as e:
+        return ("REJECTED", (e.body or {}).get("reason"), [])
+    toks = [d["token"] for ev, d in events if ev == "token"]
+    last = events[-1] if events else ("error", {})
+    if last[0] == "done":
+        return ("DONE", None, toks)
+    return ("ERROR", (last[1] or {}).get("reason"), toks)
+
+
+def _concurrent_streams(port, reqs):
+    results = [None] * len(reqs)
+
+    def one(i):
+        results[i] = _stream(port, *reqs[i])
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return results
+
+
+def _burst_tok_s(port, reqs):
+    t0 = time.monotonic()
+    results = _concurrent_streams(port, reqs)
+    wall = time.monotonic() - t0
+    toks = sum(len(r[2]) for r in results if r is not None)
+    done = sum(1 for r in results if r is not None and r[0] == "DONE")
+    return toks / wall, done
+
+
+def _healthz(port):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/healthz")
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return body
+
+
+def main():
+    import numpy as np
+
+    from paddle_tpu.observability import parse_prometheus_text
+    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.fleet.launch import spawn, spawn_all
+
+    failures = []
+    rng = np.random.RandomState(5)
+    net = _local_reference()
+
+    print("fleet_smoke: spawning prefill worker + 3 replicas...")
+    worker = spawn("prefill", MODEL)  # replicas need its port
+    rep_a, rep_b, rep_d = spawn_all([
+        ("replica", MODEL + ENGINE),
+        ("replica", MODEL + ENGINE),
+        ("replica", MODEL + ENGINE + [
+            "--prefill-worker", f"127.0.0.1:{worker.port}"]),
+    ])
+    procs = [worker, rep_a, rep_b, rep_d]
+    try:
+        # -- 1. disaggregated prefill exact across processes ----------
+        reqs = [(list(map(int, rng.randint(0, 64, (L,)))), m)
+                for L, m in ((5, 6), (9, 8), (6, 5), (13, 7))]
+        via_d = _concurrent_streams(rep_d.port, reqs)
+        via_a = _concurrent_streams(rep_a.port, reqs)
+        for i, (ids, m) in enumerate(reqs):
+            want = _generate_ref(net, ids, m)
+            for tag, got in (("disagg", via_d[i]), ("plain", via_a[i])):
+                if got is None or got[0] != "DONE" or got[2] != want:
+                    failures.append(
+                        f"{tag} stream {i}: {got} != DONE {want}"
+                    )
+        st = _healthz(rep_d.port)
+        rp = st.get("remote_prefill") or {}
+        # warmup resets the counters at READY, so these reflect the
+        # test streams ONLY: every prefill must have gone remote with
+        # zero local fallbacks, or the exactness claim above proved
+        # nothing about disaggregation
+        if rp.get("remote", 0) < len(reqs) or rp.get("fallbacks", 0):
+            failures.append(
+                f"prefills did not all go remote: {rp}"
+            )
+        print(f"fleet_smoke: disaggregated-prefill streams exact-equal "
+              f"to local prefill + net.generate "
+              f"(remote={rp.get('remote')}, "
+              f"fallbacks={rp.get('fallbacks')})")
+
+        # -- 2. throughput scales 1 -> 2 replicas ---------------------
+        burst = [(list(map(int, rng.randint(0, 64, (6,)))), 24)
+                 for _ in range(16)]
+        with FleetRouter([("127.0.0.1", rep_a.port)],
+                         health_interval_s=0.05) as r1:
+            tok_1, done_1 = _burst_tok_s(r1.port, burst)
+        with FleetRouter([("127.0.0.1", rep_a.port),
+                          ("127.0.0.1", rep_b.port)],
+                         health_interval_s=0.05) as r2:
+            tok_2, done_2 = _burst_tok_s(r2.port, burst)
+        ratio = tok_2 / max(tok_1, 1e-9)
+        print(f"fleet_smoke: aggregate throughput {tok_1:.1f} tok/s "
+              f"(1 replica, {done_1} done) -> {tok_2:.1f} tok/s "
+              f"(2 replicas, {done_2} done), x{ratio:.2f}")
+        if done_1 != len(burst) or done_2 != len(burst):
+            failures.append(
+                f"burst incomplete: {done_1}/{done_2} of {len(burst)}"
+            )
+        if ratio < 1.25:
+            failures.append(
+                f"throughput did not scale with replicas: x{ratio:.2f}"
+            )
+
+        # -- 3. SIGKILL one replica mid-run ---------------------------
+        router = FleetRouter(
+            [("127.0.0.1", rep_a.port), ("127.0.0.1", rep_b.port)],
+            health_interval_s=0.05, breaker_cooldown_s=0.5,
+        ).start()
+        reqs3 = [(list(map(int, rng.randint(0, 64, (6,)))), 40)
+                 for _ in range(16)]
+        results3 = [None] * len(reqs3)
+
+        def one3(i):
+            results3[i] = _stream(router.port, *reqs3[i])
+
+        threads = [threading.Thread(target=one3, args=(i,),
+                                    daemon=True)
+                   for i in range(len(reqs3))]
+        for t in threads:
+            t.start()
+        # kill once BOTH replicas have live streams (poll, not sleep —
+        # the point is a mid-run kill, not an after-the-fact one)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            routed = router.metrics.requests.by_label()
+            if routed.get("0", 0) >= 2 and routed.get("1", 0) >= 2:
+                break
+            time.sleep(0.01)
+        time.sleep(0.15)  # let a few tokens flow on the doomed replica
+        unfinished_at_kill = sum(1 for r in results3 if r is None)
+        rep_a.kill()
+        print(f"fleet_smoke: SIGKILLed replica A mid-run "
+              f"({unfinished_at_kill} streams in flight)")
+        if unfinished_at_kill == 0:
+            failures.append(
+                "kill landed after the run completed — lengthen the "
+                "streams"
+            )
+        for t in threads:
+            t.join(timeout=300)
+        hangs = sum(1 for r in results3 if r is None)
+        if hangs:
+            failures.append(f"{hangs} streams never terminated")
+        errored = [r for r in results3
+                   if r is not None and r[0] != "DONE"]
+        for r in errored:
+            if r[1] not in ("replica_failed", "replicas_unavailable",
+                            "fleet_saturated"):
+                failures.append(
+                    f"stream shed with unexpected reason: {r[:2]}"
+                )
+        for i, r in enumerate(results3):
+            if r is not None and r[0] == "DONE":
+                want = _generate_ref(net, *reqs3[i])
+                if r[2] != want:
+                    failures.append(
+                        f"survivor stream {i} tokens {r[2]} != {want}"
+                    )
+        print(f"fleet_smoke: {len(reqs3) - len(errored)} streams DONE "
+              f"exact, {len(errored)} shed with terminal "
+              f"error(reason=replica_failed) — zero hangs")
+
+        # fresh requests after the kill must land on the survivor
+        retried = _concurrent_streams(
+            router.port,
+            [(list(map(int, rng.randint(0, 64, (5,)))), 6)
+             for _ in range(6)],
+        )
+        bad = [r for r in retried if r is None or r[0] != "DONE"]
+        if bad:
+            failures.append(
+                f"post-kill requests did not all complete: {bad}"
+            )
+        print(f"fleet_smoke: 6/6 post-kill requests completed on the "
+              f"survivor (router retries: "
+              f"{router.metrics.retries.by_label()})")
+
+        # survivor drained clean: zero leaked pages, still accepting
+        st_b = _healthz(rep_b.port)
+        pp = st_b.get("page_pool") or {}
+        if pp.get("pages_in_use") != 0:
+            failures.append(
+                f"survivor leaked pages: {pp}"
+            )
+        if not st_b.get("accepting"):
+            failures.append(f"survivor not accepting: {st_b}")
+        print(f"fleet_smoke: survivor zero leaked pages "
+              f"(claims {pp.get('claims')} == releases "
+              f"{pp.get('releases')})")
+
+        # -- 4. aggregated /metrics parses, per-replica series --------
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode("utf-8")
+        conn.close()
+        parsed = parse_prometheus_text(text)  # raises on malformed
+        fleet_series = [k for k in parsed if k.startswith(
+            "paddle_fleet_")]
+        if not fleet_series:
+            failures.append("no paddle_fleet_* series in /metrics")
+        routed = router.metrics.requests.by_label()
+        if not (routed.get("0", 0) > 0 and routed.get("1", 0) > 0):
+            failures.append(
+                f"per-replica request series not nonzero: {routed}"
+            )
+        for needle in ("paddle_fleet_requests_total",
+                       "paddle_fleet_replica_healthy",
+                       "paddle_fleet_replica_free_pages"):
+            if needle not in text:
+                failures.append(f"/metrics missing {needle}")
+        print("fleet_smoke: router /metrics parses with nonzero "
+              "per-replica series")
+        router.stop()
+    finally:
+        for p in procs:
+            p.terminate()
+    if failures:
+        print("fleet_smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("fleet_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

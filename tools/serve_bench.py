@@ -19,6 +19,12 @@ its own thread, so the JSON record carries WIRE-level TTFT/ITL (client-
 measured, socket included) next to the engine's in-process numbers,
 plus the page-pool occupancy/exhaustion counters.
 
+``--fleet N`` goes one tier up: N replica SUBPROCESSES on ephemeral
+ports behind the occupancy-aware ``FleetRouter``, the trace replayed
+through the router — the record carries per-replica occupancy and
+request counts next to aggregate throughput (``--fleet-prefill`` adds
+a cross-process prefill-pool worker).
+
 Open-loop means arrivals do not wait for completions: when the engine
 falls behind, the queue grows and (past ``--max-queue``) requests are
 REJECTED — that backpressure shows up in the report rather than being
@@ -229,6 +235,161 @@ def run_kv_compare(args):
     }
 
 
+def run_fleet_bench(args):
+    """Fleet mode: spawn ``--fleet N`` replica SUBPROCESSES on
+    ephemeral ports (identical weights via the shared seed), put the
+    occupancy-aware router in front, and replay the Poisson trace
+    through it — every request a real POST + SSE stream. The record
+    carries aggregate throughput next to PER-REPLICA occupancy
+    (sampled active rows + the page pool's own lifetime peak), which
+    is what the 1->2 replica ~linear-scaling claim is made of.
+    ``--fleet-prefill`` additionally spawns a prefill-pool worker and
+    attaches every replica to it (cross-process disaggregation)."""
+    import threading
+
+    from paddle_tpu.serving import HTTPRejected, stream_generate
+    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.fleet.launch import spawn, spawn_all
+
+    n = int(args.fleet)
+    common = [
+        "--vocab", args.vocab, "--hidden", args.hidden,
+        "--layers", args.layers, "--heads", args.heads,
+        "--seed", args.seed, "--max-batch", args.max_batch,
+        "--max-seq", args.max_seq, "--min-bucket", args.min_bucket,
+        "--page-size", args.page_size, "--max-queue", args.max_queue,
+        "--cache-dtype", args.cache_dtype,
+    ]
+    if args.num_pages is not None:
+        common += ["--num-pages", args.num_pages]
+    if not args.warmup:
+        common += ["--no-warmup"]
+    procs, worker, router = [], None, None
+    try:
+        if args.fleet_prefill:
+            worker = spawn("prefill", common)
+            common += ["--prefill-worker", f"127.0.0.1:{worker.port}"]
+        print(f"serve_bench: spawning {n} replica(s)...",
+              file=sys.stderr)
+        procs = spawn_all([("replica", common)] * n)
+        router = FleetRouter(
+            [("127.0.0.1", p.port) for p in procs],
+            health_interval_s=0.05,
+        ).start()
+        trace = build_trace(
+            args.requests, args.rate, args.seed, args.vocab,
+            args.prompt_min, args.prompt_max, args.new_min,
+            args.new_max,
+        )
+        results = [None] * len(trace)
+        ttfts, itls, rejects, tokens = [], [], {}, [0]
+        lock = threading.Lock()
+
+        def one(i, ids, max_new):
+            try:
+                events, tm = stream_generate(
+                    "127.0.0.1", router.port,
+                    {"input_ids": [int(t) for t in ids[0]],
+                     "max_new_tokens": int(max_new)},
+                )
+            except HTTPRejected as e:
+                with lock:
+                    reason = (e.body or {}).get("reason",
+                                                f"http_{e.code}")
+                    rejects[reason] = rejects.get(reason, 0) + 1
+                    results[i] = _HTTPHandle("REJECTED", reason)
+                return
+            toks = [d["token"] for ev, d in events if ev == "token"]
+            last = events[-1] if events else ("error", {})
+            status = (last[1] or {}).get("status", "ERROR") \
+                if last[0] == "done" else "ERROR"
+            with lock:
+                results[i] = _HTTPHandle(
+                    status, (last[1] or {}).get("reason"), toks)
+                tokens[0] += len(toks)
+                if tm["ttft_s"] is not None:
+                    ttfts.append(tm["ttft_s"])
+                itls.extend(tm["itl_s"])
+
+        peak_active = [0] * n
+        done_flag = threading.Event()
+
+        def sample_peaks():
+            while not done_flag.is_set():
+                for i, r in enumerate(router.replicas):
+                    st = r.status or {}
+                    peak_active[i] = max(peak_active[i],
+                                         int(st.get("active") or 0))
+                time.sleep(0.01)
+
+        sampler = threading.Thread(target=sample_peaks, daemon=True)
+        sampler.start()
+        t0 = time.monotonic()
+        threads = []
+        try:
+            for i, (arrival, ids, max_new) in enumerate(trace):
+                dt = arrival - (time.monotonic() - t0)
+                if dt > 0:
+                    time.sleep(dt)
+                th = threading.Thread(target=one,
+                                      args=(i, ids, max_new),
+                                      daemon=True)
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(timeout=600)
+            wall = time.monotonic() - t0
+        finally:
+            done_flag.set()
+            sampler.join(timeout=5)
+        per_replica = []
+        routed = router.metrics.requests.by_label()
+        for i, p in enumerate(procs):
+            st = (router.replicas[i].status or {})
+            per_replica.append({
+                "port": p.port,
+                "requests_routed": int(routed.get(str(i), 0)),
+                "peak_active_sampled": peak_active[i],
+                "free_pages": st.get("free_pages"),
+                "page_pool": st.get("page_pool"),
+                "remote_prefill": st.get("remote_prefill"),
+            })
+        done = sum(1 for r in results
+                   if r is not None and r.status == "DONE")
+        out = {
+            "metric": "serve_fleet_bench",
+            "mode": "fleet",
+            "replicas": n,
+            "prefill_pool": bool(args.fleet_prefill),
+            "requests": args.requests,
+            "rate_req_s": args.rate,
+            "wall_s": round(wall, 3),
+            "completed": done,
+            "tokens_out": tokens[0],
+            "decode_tok_s": round(tokens[0] / wall, 1),
+            "req_s": round(done / wall, 2),
+            "rejected_by_reason": rejects,
+            "per_replica": per_replica,
+            "router": {
+                "retries": router.metrics.retries.by_label(),
+                "shed": router.metrics.shed.by_label(),
+                "breaker_opens":
+                    router.metrics.breaker_opens.by_label(),
+                "stream_aborts":
+                    router.metrics.stream_aborts.by_label(),
+            },
+            "wire": {"ttft": _pctl(ttfts), "itl": _pctl(itls)},
+        }
+        return out
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.terminate()
+        if worker is not None:
+            worker.terminate()
+
+
 class _HTTPHandle:
     """Duck-typed result row for the HTTP replay (matches the `.status`
     surface the report counts)."""
@@ -368,6 +529,16 @@ def main(argv=None):
                     help="replay through the HTTP/SSE front-end over "
                          "localhost; records wire-level TTFT/ITL next "
                          "to the in-process numbers")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="spawn N replica subprocesses on ephemeral "
+                         "ports and replay the trace through the "
+                         "occupancy-aware FleetRouter; records "
+                         "per-replica occupancy + aggregate throughput")
+    ap.add_argument("--fleet-prefill", action="store_true",
+                    help="with --fleet: also spawn a prefill-pool "
+                         "worker and attach every replica to it "
+                         "(cross-process prefill/decode "
+                         "disaggregation)")
     ap.add_argument("--kv-compare", action="store_true",
                     help="run the paged trace twice — bf16 KV vs int8 "
                          "KV at an EQUAL page-arena byte budget — and "
@@ -390,6 +561,25 @@ def main(argv=None):
         server = start_metrics_server(port=args.metrics_port)
         print(f"serve_bench: metrics at {server.url}", file=sys.stderr)
     try:
+        if args.fleet:
+            out = run_fleet_bench(args)
+            if args.json:
+                print(json.dumps(out, indent=2, default=str))
+            else:
+                per = ", ".join(
+                    f"r{i}: {p['requests_routed']} reqs peak "
+                    f"{p['peak_active_sampled']}"
+                    for i, p in enumerate(out["per_replica"])
+                )
+                print(
+                    f"serve_bench --fleet {out['replicas']}: "
+                    f"{out['completed']}/{out['requests']} done in "
+                    f"{out['wall_s']}s — {out['decode_tok_s']} "
+                    f"decode tok/s aggregate ({per}); router "
+                    f"retries={out['router']['retries']} "
+                    f"shed={out['router']['shed']}"
+                )
+            return out
         if args.kv_compare:
             out = run_kv_compare(args)
             if args.json:
