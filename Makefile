@@ -5,7 +5,8 @@ PY ?= python
 ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
-	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke
+	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
+	reload-smoke
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -65,6 +66,17 @@ serve-smoke:
 # per-replica series.
 fleet-smoke:
 	$(ENV) $(PY) tools/fleet_smoke.py
+
+# Zero-downtime ops gate: a live 2-replica fleet (warmed through a
+# shared AOT compile cache) rides a rolling checkpoint reload under
+# concurrent SSE load with zero dropped requests and a bounded TTFT
+# spike; a replica is SIGKILLed mid-swap (survivor drains to zero
+# leaked pages) and relaunches warm from the cache — zero new
+# trace-guard compile entries at first traffic; a chaos-injected
+# kill-mid-swap leaves every stream terminal and the engine on the
+# last committed weights_version.
+reload-smoke:
+	$(ENV) $(PY) tools/reload_smoke.py
 
 # Quantized-execution gate: PTQ the tiny llama -> quantize_for_serving
 # (int8 weights, asserted idempotent) -> jit.save/predictor round trip

@@ -142,6 +142,37 @@ def _fallback_reason(problems):
     return "load_error"
 
 
+def _encode_extra_state(data):
+    """{key: array-like} -> JSON-safe manifest block. Floats travel as
+    JSON doubles (exact for <=fp32, e.g. the delayed-scaling
+    histories); integer/bool dtypes travel as Python ints — arbitrary
+    precision, so an int64 value past 2^53 is NOT squeezed through a
+    double and restores bit-identical."""
+    out = {}
+    for k, v in data.items():
+        a = np.asarray(v)
+        if a.dtype.kind in "iub":
+            vals = a.ravel().tolist()
+        else:
+            vals = a.astype(np.float64).ravel().tolist()
+        out[str(k)] = {
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+            "data": vals,
+        }
+    return out
+
+
+def _decode_extra_state(block):
+    out = {}
+    for k, rec in block.items():
+        dtype = np.dtype(rec.get("dtype", "float32"))
+        out[k] = np.asarray(rec["data"], dtype).reshape(
+            rec.get("shape", [-1])
+        )
+    return out
+
+
 class CheckpointManager:
     """Fault-tolerant checkpoint runtime over a checkpoint root dir.
 
@@ -171,6 +202,8 @@ class CheckpointManager:
         self.coordinator_rank = int(coordinator_rank)
         self._manifest_extra_fn = manifest_extra_fn
         self._serialize = save_state_dict  # test seam: wrap to slow/fault
+        self._extra_states = {}            # name -> (get_fn, set_fn)
+        self._last_restore_manifest = None
         self._lock = threading.Lock()
         self._last_step = 0
         self._last_saved_step = 0  # steps are 1-based: first save at N
@@ -316,6 +349,51 @@ class CheckpointManager:
             self.optimizer = optimizer
         return self
 
+    def register_extra_state(self, name, get_fn, set_fn):
+        """Attach a small named side-state that must survive a resume
+        but lives outside the model/optimizer state dicts — e.g. the
+        AMP O3 fp8 delayed-scaling amax histories. ``get_fn()`` returns
+        ``{key: array-like}`` (empty dict = nothing to persist this
+        save); it is captured at each save and stored in the commit
+        manifest's ``extra`` block (written LAST, so it is exactly as
+        crash-safe as the checkpoint itself). On restore, ``set_fn``
+        receives the decoded ``{key: np.float32 array}``. Registration
+        AFTER a restore applies the restored state immediately, so
+        ``restore_or_init()`` / ``attach_checkpoint()`` work in either
+        order."""
+        self._extra_states[name] = (get_fn, set_fn)
+        man = self._last_restore_manifest
+        if man:
+            data = ((man.get("extra") or {}).get("state") or {}).get(
+                name
+            )
+            if data is not None:
+                try:
+                    set_fn(_decode_extra_state(data))
+                except Exception as e:
+                    logger.warning(
+                        "checkpoint: restored extra state %r not "
+                        "applicable: %r", name, e,
+                    )
+        return self
+
+    def _collect_extra_state(self):
+        """Snapshot every registered extra state on the CALLER thread
+        (save-time semantics, like the device snapshot). Collection
+        errors are logged, never allowed to fail a save."""
+        out = {}
+        for name, (get_fn, _set) in self._extra_states.items():
+            try:
+                data = get_fn()
+                if data:
+                    out[name] = _encode_extra_state(data)
+            except Exception as e:
+                logger.warning(
+                    "checkpoint: extra state %r not captured: %r",
+                    name, e,
+                )
+        return out
+
     def _build_state(self, step):
         if self._state_fn is not None:
             return self._state_fn(step)
@@ -389,6 +467,7 @@ class CheckpointManager:
         mode = mode or ("sync" if blocking else "async")
         state = self._build_state(step)
         snap = snapshot_state(state)
+        extra_state = self._collect_extra_state()
         with self._lock:
             prev = (self._last_saved_step, self._last_saved_time)
             self._last_saved_step = step
@@ -400,7 +479,7 @@ class CheckpointManager:
             # it back so the next policy check — and an emergency save —
             # knows this step never landed
             try:
-                self._write_and_commit(step, snap, mode)
+                self._write_and_commit(step, snap, mode, extra_state)
             except BaseException:
                 with self._lock:
                     if self._last_saved_step == step:
@@ -419,7 +498,7 @@ class CheckpointManager:
                 self._note_blocked(blocked, reason="backpressure")
         return step
 
-    def _write_and_commit(self, step, snap, mode):
+    def _write_and_commit(self, step, snap, mode, extra_state=None):
         """Writer-side: serialize shards into step_N.tmp, write the
         manifest, barrier, rename. Runs on the background writer thread
         for async saves."""
@@ -480,6 +559,9 @@ class CheckpointManager:
                     self._manifest_extra_fn(step, snap)
                     if self._manifest_extra_fn is not None else None
                 )
+                if extra_state:
+                    extra = dict(extra or {})
+                    extra["state"] = extra_state
                 commit_mod.write_manifest(tmp, step, files, extra=extra)
                 path = commit_mod.commit(self.root, step)
                 self._apply_retention()
@@ -587,6 +669,21 @@ class CheckpointManager:
                 self._last_step = restored_step
                 self._last_saved_step = restored_step
                 self._last_saved_time = time.monotonic()
+            # extra side-states (fp8 amax histories, ...) ride in the
+            # manifest; keep it so attach-after-restore still applies
+            self._last_restore_manifest = manifest
+            extra = (manifest.get("extra") or {}).get("state") or {}
+            for name, (_get, set_fn) in self._extra_states.items():
+                data = extra.get(name)
+                if data is None:
+                    continue
+                try:
+                    set_fn(_decode_extra_state(data))
+                except Exception as e:
+                    logger.warning(
+                        "checkpoint: extra state %r from %s not "
+                        "applicable: %r", name, path, e,
+                    )
             self.restores_total.inc(outcome="restored")
             self._note_event(
                 "checkpoint_restore", step=restored_step, path=path

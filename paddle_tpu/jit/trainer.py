@@ -106,16 +106,20 @@ class CompiledTrainStep:
         to kick off an async save. The manager is bound to this
         trainer's network/optimizer if it was constructed bare.
 
-        AMP O3 caveat: the manager snapshots network + optimizer state
-        only — the fp8 delayed-scaling amax histories are NOT part of
-        either. A crash-resume therefore restarts them from zeros
-        (scale 1, exactly the cold-start behavior of step 1; the
-        window refills within ``fp8.HISTORY_LEN`` steps). Callers who
-        need the identical numerical trajectory across a resume can
-        persist :meth:`fp8_state_dict` alongside the checkpoint and
-        :meth:`load_fp8_state` it after restore."""
+        AMP O3: the fp8 delayed-scaling amax histories live outside the
+        network/optimizer state dicts, so attaching also registers them
+        as manager extra-state — each save persists
+        :meth:`fp8_state_dict` in the commit manifest and a restore
+        feeds it back through :meth:`load_fp8_state`, making O3
+        crash-resumes bit-identical instead of cold-starting scales at
+        1. Works in either order with ``restore_or_init()`` (a restore
+        that already happened applies at registration)."""
         manager.bind(self.network, self.optimizer)
         self._checkpoint = manager
+        if hasattr(manager, "register_extra_state"):
+            manager.register_extra_state(
+                "fp8", self.fp8_state_dict, self.load_fp8_state
+            )
         return manager
 
     def fp8_state_dict(self):

@@ -37,6 +37,7 @@ spawns replica subprocesses behind the router) and reports
 throughput/latency percentiles; ``make serve-smoke`` and
 ``make fleet-smoke`` gate the HTTP and cluster paths end to end.
 """
+from . import chaos  # noqa: F401
 from .engine import ServingEngine, StaticBatchEngine  # noqa: F401
 from .fleet import (  # noqa: F401
     FleetRouter,
@@ -60,6 +61,7 @@ from .kv_pool import (  # noqa: F401
 from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
 from .paged_engine import PagedServingEngine  # noqa: F401
 from .paged_pool import PagedKVPool, PagesExhausted  # noqa: F401
+from .reload import ReloadError, StagedReload  # noqa: F401
 from .scheduler import (  # noqa: F401
     REASON_ENGINE_CLOSED,
     REASON_QUEUE_FULL,

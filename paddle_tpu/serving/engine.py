@@ -124,7 +124,7 @@ class ServingEngine:
                  max_queue_size=64, max_tokens_in_flight=None,
                  scheduler=None, metrics=None, pool=None,
                  clock=time.monotonic, recompile_guard_max=None,
-                 weights_version=None):
+                 weights_version=None, reload_template=None):
         cfg = net.config
         self.net = net
         self.config = cfg
@@ -136,6 +136,18 @@ class ServingEngine:
         self.weights_version = (
             "v0" if weights_version is None else str(weights_version)
         )
+        # live reload state: a prepared swap waits here until no
+        # request is in flight (admission pauses meanwhile, so every
+        # request runs under exactly one weights version)
+        self._pending_swap = None
+        self.reload_in_progress = False
+        self.last_reload_step = None
+        self._reload_template = reload_template
+        # AOT warmup bookkeeping: programs compiled (or cache-loaded)
+        # before first traffic, and how many came from the persistent
+        # compile cache (the /healthz `compile_cache_hits` field)
+        self._warmed = set()
+        self.compile_cache_hits = 0
         self.max_batch_size = int(max_batch_size)
         self.max_seq_len = int(max_seq_len)
         self.clock = clock
@@ -267,6 +279,18 @@ class ServingEngine:
         )
         return fn
 
+    def _restore_net_state(self):
+        """Put the imperative net back in concrete serving state —
+        required after ANYTHING that traced a program body (execution
+        tracing or ``.lower()``), which swaps tracers into the Layer
+        objects, and after a weight swap, so later snapshots/templates
+        see what the engine serves."""
+        self.net.load_functional_state(self._params, self._buffers)
+        if self._was_training:
+            self.net.train()
+        else:
+            self.net.eval()
+
     def _run(self, trace_key, fn, *args):
         """Invoke a jitted program; after its FIRST trace, restore the
         net's concrete weights/mode (tracing swaps tracers into the
@@ -274,11 +298,7 @@ class ServingEngine:
         out = fn(*args)
         if trace_key not in self._traced:
             self._traced.add(trace_key)
-            self.net.load_functional_state(self._params, self._buffers)
-            if self._was_training:
-                self.net.train()
-            else:
-                self.net.eval()
+            self._restore_net_state()
         return out
 
     def _next_key(self):
@@ -420,6 +440,7 @@ class ServingEngine:
             raise
         self.pool.free(blk)
         handle.status = RUNNING
+        handle.weights_version = self.weights_version
         handle.admit_time = now
         handle.admitted_step = self.step_count
         handle.first_token_time = self.clock()
@@ -457,6 +478,8 @@ class ServingEngine:
         run one decode step over the whole resident KV state."""
         if self._closed:
             raise RuntimeError(f"{type(self).__name__} is closed")
+        # a staged weight swap applies the moment nothing is in flight
+        self._maybe_apply_reload()
         now = self.clock()
         # running sequences past their deadline free their slot NOW
         for i, seq in enumerate(self._seqs):
@@ -471,7 +494,12 @@ class ServingEngine:
         # in-flight token cap (and the per-step prefill cap, when set)
         cap = self._max_admissions_per_step()
         admitted = 0
-        while self._has_capacity() and (cap is None or admitted < cap):
+        # a pending reload pauses admission: in-flight requests drain
+        # on the OLD weights, queued ones wait for the swap — zero
+        # dropped, one weights version per request
+        while self._pending_swap is None and self._has_capacity() and (
+            cap is None or admitted < cap
+        ):
             handle = self.scheduler.pop_next(self._admission_budget())
             if handle is None:
                 break
@@ -494,6 +522,9 @@ class ServingEngine:
         for _ in self.scheduler.drain_timed_out():
             self.metrics.timeouts.inc()
         self._decode_once()
+        # the last in-flight request may have finished this step — a
+        # pending swap must not wait for another external step() call
+        self._maybe_apply_reload()
         self.step_count += 1
         # poll jit-internal compile caches (decode shape drift is
         # invisible to the bucket maps above); fires _on_guard_fire
@@ -551,12 +582,253 @@ class ServingEngine:
         self.run_until_idle()
         return handles
 
+    # ------------------------------------------------------- live reload
+    def prepare_reload(self, ckpt_dir, *, weights_version=None,
+                       template_net=None, verify_level="full"):
+        """Stage a weight swap from a committed checkpoint directory
+        (or a checkpoint root — newest committed step wins): verify the
+        manifest/CRCs, load into a template, quantize for serving when
+        this engine runs quantized weights, and validate against the
+        compiled programs' snapshot. Pure and thread-safe — run it OFF
+        the step loop; pass the result to :meth:`commit_reload`.
+        Failures come back as a non-ok :class:`~.reload.StagedReload`
+        (counted by outcome), never an exception — the engine keeps
+        serving the last committed weights."""
+        from .reload import prepare_state_swap
+
+        staged = prepare_state_swap(
+            self.net, self._params, self._buffers, ckpt_dir,
+            weights_version=weights_version,
+            template_net=template_net or self._reload_template,
+            verify_level=verify_level,
+        )
+        if not staged.ok:
+            self.metrics.reloads.inc(label=staged.outcome)
+        return staged
+
+    def commit_reload(self, staged):
+        """Hand a prepared swap to the step loop (same single-thread
+        discipline as :meth:`step` — the HTTP frontend calls this under
+        its driver lock). Applies immediately when nothing is in
+        flight; otherwise admission pauses and the swap lands at the
+        first step boundary with zero active requests. A staged swap
+        committed over a still-pending one supersedes it (newest
+        checkpoint wins)."""
+        if not staged.ok:
+            return staged
+        if self._closed:
+            staged.ok = False
+            staged.outcome = "engine_closed"
+            self.metrics.reloads.inc(label="engine_closed")
+            return staged
+        if self._pending_swap is not None:
+            self.metrics.reloads.inc(label="superseded")
+        staged.staged_at = self.clock()
+        self._pending_swap = staged
+        self.reload_in_progress = True
+        self._maybe_apply_reload()
+        return staged
+
+    def reload_weights(self, ckpt_dir, **kw):
+        """prepare + commit in one call (callers on the engine's own
+        thread — tests, benches, the launch entrypoint)."""
+        return self.commit_reload(self.prepare_reload(ckpt_dir, **kw))
+
+    def _maybe_apply_reload(self):
+        if self._pending_swap is not None and self.active_slots == 0:
+            self._apply_reload()
+
+    def _apply_reload(self):
+        from . import chaos as _chaos
+
+        staged = self._pending_swap
+        try:
+            # the deterministic kill-mid-swap seam: a fault here must
+            # leave the engine fully on the OLD weights (nothing below
+            # has mutated yet — the swap is all-or-nothing)
+            _chaos.poke("reload.apply", step=staged.step,
+                        version=staged.weights_version)
+        except BaseException as e:
+            self._pending_swap = None
+            self.reload_in_progress = False
+            staged.ok = False
+            staged.outcome = "error"
+            staged.error = repr(e)
+            self.metrics.reloads.inc(label="error")
+            return
+        self._params = staged.params
+        self._buffers = staged.buffers
+        self.weights_version = staged.weights_version
+        self.generation += 1
+        self.last_reload_step = staged.step
+        self._pending_swap = None
+        self.reload_in_progress = False
+        self._restore_net_state()
+        # disaggregation stays exact across the rotation: the prefill
+        # worker's version-skew refusal now rejects OLD-weights blocks
+        tr = getattr(self, "prefill_transport", None)
+        if tr is not None and getattr(tr, "expected_weights_version",
+                                      None) is not None:
+            tr.expected_weights_version = staged.weights_version
+        if staged.staged_at is not None:
+            self.metrics.reload_ttft_spike.observe(
+                self.clock() - staged.staged_at
+            )
+        self.metrics.reloads.inc(label="ok")
+        staged.outcome = "applied"
+        try:
+            from ..observability import get_flight_recorder
+
+            get_flight_recorder().note(
+                "weights_reload", step=staged.step,
+                version=staged.weights_version, path=staged.path,
+                generation=self.generation,
+            )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- AOT warmup
+    def _warmup_buckets(self):
+        """Every prompt bucket this engine can compile (the same
+        power-of-two ladder the pool admits)."""
+        mx = getattr(self.pool, "max_seq_len", None) or self.max_seq_len
+        out, L = [], getattr(self.pool, "min_bucket", 16)
+        while True:
+            b = self.pool.bucket_for(min(L, mx))
+            if b not in out:
+                out.append(b)
+            if L >= mx:
+                return out
+            L *= 2
+
+    def _decode_example_args(self):
+        B = self.max_batch_size
+        return (
+            self._params, self._buffers, jnp.zeros((B,), jnp.int32),
+            self._flat, *self._decode_extra(),
+            jnp.zeros((B,), jnp.int32),
+            jnp.float32(self.temperature), self._key,
+        )
+
+    def _adopt_example_args(self, flat_block, bucket):
+        return (self._flat, flat_block, jnp.int32(0))
+
+    def _program_signature(self, name):
+        cfg = self.config
+        return {
+            "program": name,
+            "engine": type(self).__name__,
+            "max_batch": self.max_batch_size,
+            "max_seq": self.max_seq_len,
+            "cache_dtype": str(self.cache_dtype),
+            "do_sample": self.do_sample,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "donate": self._donate,
+            "model": {
+                "vocab": int(cfg.vocab_size),
+                "hidden": int(cfg.hidden_size),
+                "inter": int(cfg.intermediate_size),
+                "layers": int(cfg.num_hidden_layers),
+                "heads": int(cfg.num_attention_heads),
+                "kv_heads": int(cfg.kv_heads),
+            },
+        }
+
+    def _warm_one(self, cache, name, trace_key, jitfn, args, install,
+                  stats):
+        if trace_key in self._warmed:
+            return  # idempotent: the installed executable stands
+        stats["programs"] += 1
+        key = meta = None
+        if cache is not None:
+            key, meta = cache.key_for(self._program_signature(name),
+                                      args)
+            comp = cache.load(key)
+            if comp is not None:
+                install(comp)
+                self._warmed.add(trace_key)
+                self.compile_cache_hits += 1
+                stats["aot_hits"] += 1
+                return
+        comp = jitfn.lower(*args).compile()
+        install(comp)
+        self._warmed.add(trace_key)
+        if cache is not None and cache.save(key, comp, meta):
+            stats["aot_saves"] += 1
+
+    def warmup(self, aot_cache=None, buckets=None):
+        """Compile every fixed-shape program — the decode step plus
+        prefill and adopt per prompt bucket — BEFORE first traffic, so
+        a fresh replica reaches READY with its full compiled inventory
+        and the first request pays sockets, not XLA.
+
+        With ``aot_cache`` (an ``jit.aot_cache.AOTProgramCache`` or a
+        directory path), finished executables are serialized there and
+        a relaunched engine with the same geometry loads them instead
+        of tracing or compiling ANYTHING — ``compile_cache_hits``
+        counts the loads, and the trace-guard inventory stays flat at
+        first traffic (the reload-smoke acceptance pin). Returns
+        ``{"programs", "aot_hits", "aot_saves"}``."""
+        if self._closed:
+            raise RuntimeError(f"{type(self).__name__} is closed")
+        from ..jit import aot_cache as aot_mod
+
+        cache = aot_mod.resolve(aot_cache)
+        if buckets is None:
+            buckets = self._warmup_buckets()
+        stats = {"programs": 0, "aot_hits": 0, "aot_saves": 0}
+        try:
+            decode_fresh = ("decode",) not in self._warmed
+            self._warm_one(
+                cache, "decode", ("decode",), self._decode_fn,
+                self._decode_example_args(),
+                lambda comp: setattr(self, "_decode_fn", comp), stats,
+            )
+            if decode_fresh:
+                self.trace_guard.record_compile(
+                    "serving::decode_step", "warmup", origin="warmup"
+                )
+            for b in buckets:
+                blk = self.pool.alloc(b)
+                try:
+                    flat = _flatten(blk.caches)
+                    pargs = (
+                        self._params, self._buffers,
+                        jnp.zeros((1, b), jnp.int32), jnp.int32(b),
+                        flat, jnp.float32(self.temperature), self._key,
+                    )
+                    self._warm_one(
+                        cache, f"prefill_b{b}", ("prefill", b),
+                        self._prefill_fn(b), pargs,
+                        lambda comp, b=b: self._prefill_fns
+                        .__setitem__(b, comp), stats,
+                    )
+                    self._warm_one(
+                        cache, f"adopt_b{b}", ("adopt", b),
+                        self._adopt_fn(b),
+                        self._adopt_example_args(flat, b),
+                        lambda comp, b=b: self._adopt_fns
+                        .__setitem__(b, comp), stats,
+                    )
+                finally:
+                    self.pool.free(blk)
+        finally:
+            # lowering traces the program bodies — skipping the
+            # restore leaks tracers into any LATER snapshot of the net
+            self._restore_net_state()
+        return stats
+
     def close(self):
         """Shut the engine down: cancel queued AND in-flight requests
         (their handles finish with status CANCELLED, partial tokens
         kept), release every slab slot so pool occupancy returns to 0,
         and drop all compiled programs."""
         self._closed = True
+        if self._pending_swap is not None:
+            self._pending_swap = None
+            self.reload_in_progress = False
+            self.metrics.reloads.inc(label="abandoned")
         while True:
             h = self.scheduler.pop_next()
             if h is None:

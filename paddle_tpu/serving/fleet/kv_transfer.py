@@ -48,6 +48,7 @@ import jax.numpy as jnp
 
 from ...models.generation import alloc_kv_caches, normalize_cache_dtype
 from ...quantization.kv import QuantizedKV, is_quantized
+from ..chaos import poke as _chaos_poke
 from ..engine import _flatten, build_prefill_body
 from ..metrics import Counter
 
@@ -67,6 +68,9 @@ class TransferError(RuntimeError):
 
 # ------------------------------------------------------------------ frames
 def send_frame(sock, header, blob=b""):
+    # chaos seam: a fault armed here IS a socket drop mid-exchange
+    _chaos_poke("kv.send_frame", kind=header.get("kind")
+                or header.get("part"))
     hj = json.dumps(header).encode("utf-8")
     payload = _HLEN.pack(len(hj)) + hj + bytes(blob)
     crc = zlib.crc32(payload) & 0xFFFFFFFF
@@ -90,6 +94,7 @@ def _recv_exact(sock, n):
 
 
 def recv_frame(sock):
+    _chaos_poke("kv.recv_frame")
     head = _recv_exact(sock, 4 + _HEAD.size)
     if head[:4] != MAGIC:
         raise TransferError(f"bad frame magic {head[:4]!r}")
@@ -234,6 +239,13 @@ class PrefillWorker:
                         send_frame(conn, {"kind": "pong",
                                           "stats": self.stats()})
                         continue
+                    if req.get("kind") == "reload":
+                        res = self.reload_weights(
+                            req["ckpt_dir"],
+                            weights_version=req.get("weights_version"),
+                        )
+                        send_frame(conn, {"kind": "reloaded", **res})
+                        continue
                     if req.get("kind") != "prefill":
                         raise ValueError(
                             f"unknown request kind {req.get('kind')!r}"
@@ -255,6 +267,28 @@ class PrefillWorker:
                 conn.close()
             except OSError:
                 pass
+
+    def reload_weights(self, ckpt_dir, weights_version=None):
+        """Rotate the PREFILL side onto a new committed checkpoint —
+        same verify/load/validate path as the engines' live reload, so
+        worker and replicas can be walked through one rotation and the
+        version-skew refusal closes the window in between. The swap
+        happens under the serving lock (never mid-prefill). Returns
+        the reload result as a plain dict (it travels over the wire as
+        the ``reloaded`` frame)."""
+        from ..reload import prepare_state_swap
+
+        staged = prepare_state_swap(
+            self.net, self._params, self._buffers, ckpt_dir,
+            weights_version=weights_version,
+        )
+        if staged.ok:
+            with self._lock:
+                self._params = staged.params
+                self._buffers = staged.buffers
+                self.weights_version = staged.weights_version
+                staged.outcome = "applied"
+        return staged.to_json()
 
     def _program(self, bucket, dtype_name):
         key = (bucket, dtype_name)
@@ -493,6 +527,71 @@ class RemotePrefillClient:
             self.close()
             raise TransferError(repr(e))
         return int(meta["first_token"]), flat, nbytes
+
+    def reload(self, ckpt_dir, weights_version=None,
+               reload_timeout_s=120.0):
+        """Ask the worker to rotate onto a committed checkpoint.
+        Returns the worker's reload-result dict; on success with a
+        version-pinned client, ``expected_weights_version`` follows the
+        worker so subsequent transfers match again. Raises
+        :class:`TransferError` on transport failure.
+
+        The reply only arrives after the worker has CRC-verified and
+        loaded the whole checkpoint synchronously, so the exchange runs
+        under its own ``reload_timeout_s`` budget (the prefill-sized
+        ``timeout_s`` would time a healthy rotation out and report a
+        swap that actually landed as failed — the router's HTTP reload
+        path uses its stream budget for the same reason). Like
+        :meth:`prefill`, a failure on a REUSED connection gets one
+        fresh-connection retry: the worker idle-closes sockets, and a
+        stale cached one must not report a rotation as failed (the
+        exchange is replay-safe — prepare is pure, apply idempotent)."""
+        reused = self._sock is not None
+        try:
+            meta = self._reload_once(ckpt_dir, weights_version,
+                                     reload_timeout_s)
+        except TransferError:
+            if not reused:
+                self._mark_down()
+                raise
+            self.close()
+            try:
+                meta = self._reload_once(ckpt_dir, weights_version,
+                                         reload_timeout_s)
+            except TransferError:
+                self._mark_down()
+                raise
+        if meta.get("ok") and \
+                self.expected_weights_version is not None:
+            self.expected_weights_version = meta.get("weights_version")
+        return meta
+
+    def _reload_once(self, ckpt_dir, weights_version, reload_timeout_s):
+        try:
+            sock = self._connection()
+            sock.settimeout(float(reload_timeout_s))
+            try:
+                send_frame(sock, {
+                    "kind": "reload", "ckpt_dir": str(ckpt_dir),
+                    "weights_version": weights_version,
+                })
+                meta, _ = recv_frame(sock)
+            finally:
+                try:
+                    sock.settimeout(self.timeout_s)
+                except OSError:
+                    pass
+            if meta.get("kind") != "reloaded":
+                raise TransferError(
+                    f"unexpected reload response {meta.get('kind')!r}"
+                )
+        except TransferError:
+            self.close()  # protocol state unknown; never reuse it
+            raise
+        except OSError as e:
+            self.close()
+            raise TransferError(repr(e))
+        return meta
 
     def ping(self):
         """Round-trip liveness probe; returns the worker's stats dict

@@ -47,45 +47,54 @@ def build_net(args):
     return net
 
 
-def _warmup_pass(engine, args):
+def _warmup(engine, args):
+    """Compile (or AOT-load) every fixed-shape program before the
+    READY line, so the first real requests pay sockets, not XLA.
+
+    ``engine.warmup`` builds the decode step plus prefill AND adopt
+    per prompt bucket directly — the local-fallback prefill programs
+    are warm even when a prefill transport is attached, so a worker
+    outage never stalls decode behind a compile. With ``--aot-cache``
+    the finished executables persist, and a relaunched replica loads
+    them instead of compiling: READY with zero traces, zero new
+    trace-guard entries at first traffic. One real request then runs
+    end-to-end (transport detached — warmup traffic must not consume
+    the prefill pool) as the serve-path sanity pass; with a transport
+    attached, one request per bucket additionally runs THROUGH it, so
+    the prefill worker's lazily-compiled per-bucket programs are warm
+    too — its first real remote prefill must not stall every replica
+    behind an XLA compile under the worker's serving lock."""
     import numpy as np
 
-    bucket = engine.pool.bucket_for(
-        min(args.min_bucket, args.max_seq - 2)
-    )
-    seen = set()
-    while bucket <= args.max_seq:
-        L = min(bucket, args.max_seq - 2)
-        b = engine.pool.bucket_for(L)
-        if b not in seen:
-            seen.add(b)
+    stats = engine.warmup(aot_cache=args.aot_cache)
+    print(f"FLEET_WARMUP programs={stats['programs']} "
+          f"aot_hits={stats['aot_hits']} "
+          f"aot_saves={stats['aot_saves']}", flush=True)
+    transport = engine.prefill_transport
+    engine.prefill_transport = None
+    try:
+        L = min(args.min_bucket, args.max_seq - 2)
+        h = engine.submit(np.zeros((1, L), np.int32), 2)
+        engine.run_until_idle()
+        assert h.status == "DONE", (
+            f"warmup request ended {h.status} ({h.reason})"
+        )
+    finally:
+        engine.prefill_transport = transport
+    if transport is not None:
+        bucket = engine.pool.bucket_for(min(args.min_bucket,
+                                            args.max_seq - 2))
+        while bucket <= args.max_seq:
+            L = min(bucket, args.max_seq - 2)
             h = engine.submit(np.zeros((1, L), np.int32), 2)
             engine.run_until_idle()
             assert h.status == "DONE", (
-                f"warmup for bucket {b} ended {h.status} ({h.reason})"
+                f"remote warmup for bucket {bucket} ended "
+                f"{h.status} ({h.reason})"
             )
-        if bucket >= args.max_seq:
-            break
-        bucket *= 2
-
-
-def _warmup(engine, args):
-    """Compile the decode step + every reachable prompt bucket before
-    the READY line, so the first real requests pay sockets, not XLA.
-
-    With a prefill transport attached, one pass runs with the
-    transport DETACHED first: the local fallback's per-bucket prefill
-    programs must be warm too, or a worker outage would stall decode
-    behind an XLA compile in the serving hot path (exactly when the
-    cooldown promises a cheap fallback)."""
-    transport = engine.prefill_transport
-    if transport is not None:
-        engine.prefill_transport = None
-        try:
-            _warmup_pass(engine, args)
-        finally:
-            engine.prefill_transport = transport
-    _warmup_pass(engine, args)
+            if bucket >= args.max_seq:
+                break
+            bucket *= 2
     engine.metrics = type(engine.metrics)()
     engine.remote_prefills = 0
     engine.local_prefills = 0
@@ -117,6 +126,10 @@ def main(argv=None):
     ap.add_argument("--prefill-worker", default=None, metavar="HOST:PORT",
                     help="attach this replica to a prefill pool worker "
                          "(disaggregated prefill with local fallback)")
+    ap.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="persistent AOT compile cache: warmup "
+                         "serializes compiled programs here; a "
+                         "relaunch loads them instead of compiling")
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     args = ap.parse_args(argv)
 

@@ -161,6 +161,9 @@ class ReplicaState:
             "active": st.get("active"),
             "generation": st.get("generation"),
             "weights_version": st.get("weights_version"),
+            "last_reload_step": st.get("last_reload_step"),
+            "reload_in_progress": st.get("reload_in_progress"),
+            "compile_cache_hits": st.get("compile_cache_hits"),
         }
 
 
@@ -201,6 +204,11 @@ class FleetRouter:
         self.stream_timeout_s = float(stream_timeout_s)
         self.clock = clock
         self._lock = threading.Lock()
+        # one rolling reload at a time: overlapping walks would drain
+        # multiple replicas at once, breaking the at-most-one-out-of-
+        # rotation invariant (a retried admin POST must get a 409, not
+        # a second concurrent walk)
+        self._reload_walk_lock = threading.Lock()
         self._stop = threading.Event()
         self._httpd = None
         self._http_thread = None
@@ -402,6 +410,9 @@ class FleetRouter:
                 or path.startswith("/admin/undrain/"):
             self._handle_admin_drain(h, path)
             return
+        if path == "/admin/reload":
+            self._handle_admin_reload(h)
+            return
         if path != "/v1/generate":
             self._send_json(h, 404, {"error": "not found"})
             return
@@ -455,6 +466,174 @@ class FleetRouter:
         self._send_json(h, 200, {"replica": idx,
                                  "draining": not undo,
                                  "replica_response": replica_resp})
+
+    # ----------------------------------------------------- rolling reload
+    def _replica_call(self, r, method, path, body=None, timeout=None):
+        """One HTTP exchange with a replica; raises OSError-family on
+        transport trouble. Returns ``(status, parsed_json)``."""
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            r.host, r.port,
+            timeout=timeout if timeout is not None
+            else self.connect_timeout_s,
+        )
+        try:
+            payload = None if body is None else json.dumps(body)
+            conn.request(
+                method, path, body=payload,
+                headers={"Content-Type": "application/json"}
+                if payload is not None else {},
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+        try:
+            parsed = json.loads(raw or b"{}")
+        except ValueError:
+            parsed = {"raw": raw.decode("utf-8", "replace")}
+        return resp.status, parsed
+
+    def _reload_replica(self, r, ckpt_dir, version, drain_timeout_s):
+        """drain -> wait idle -> /reload -> undrain, for one replica.
+        The undrain runs in ``finally`` so a failed reload leaves the
+        replica back in rotation (on its OLD weights) instead of
+        silently out of the fleet."""
+        import http.client
+
+        _err = (OSError, http.client.HTTPException)
+        out = {"replica": r.index, "ok": False}
+        # a replica the operator ALREADY drained (maintenance, debug)
+        # stays drained after its reload — the walk only undoes its
+        # own drain, never a deliberate prior one. When the probe
+        # itself fails, fall back to the router's own view (the scrape
+        # loop mirrors the replica's flag) rather than assuming False
+        # and undraining a deliberately-removed replica.
+        try:
+            _, st0 = self._replica_call(r, "GET", "/healthz")
+            was_draining = bool(st0.get("draining", False))
+        except _err:
+            was_draining = bool(r.draining)
+        with self._lock:
+            r.draining = True
+        try:
+            # the drain POST runs INSIDE the undrain guard: if it was
+            # applied but its response got lost, the finally still
+            # puts the replica back in rotation (an undrain the
+            # replica never needed is harmless)
+            try:
+                self._replica_call(r, "POST", "/drain")
+            except _err as e:
+                out.update(stage="drain", error=repr(e))
+                return out
+            # admin-walk deadline on REAL time: the injectable clock
+            # drives placement/breaker logic (tests advance it
+            # manually), and pacing below sleeps real seconds — mixing
+            # the two would make the timeout unreachable
+            deadline = time.monotonic() + float(drain_timeout_s)
+            idle = False
+            while time.monotonic() < deadline:
+                try:
+                    _, st = self._replica_call(r, "GET", "/healthz")
+                except _err:
+                    st = {}
+                if (st.get("active", 1) == 0
+                        and st.get("queue_depth", 1) == 0):
+                    idle = True
+                    break
+                time.sleep(0.05)
+            if not idle:
+                out.update(stage="drain_timeout",
+                           error=f"replica {r.index} not idle within "
+                                 f"{drain_timeout_s}s")
+                return out
+            try:
+                # reload prepare reads + verifies the checkpoint from
+                # disk — give it the stream budget, not the connect one
+                code, res = self._replica_call(
+                    r, "POST", "/reload",
+                    body={"ckpt_dir": ckpt_dir,
+                          "weights_version": version},
+                    timeout=self.stream_timeout_s,
+                )
+            except _err as e:
+                out.update(stage="reload", error=repr(e))
+                return out
+            if code != 200 or not res.get("ok", False):
+                out.update(stage="reload", status=code,
+                           error=res.get("error") or res)
+                out["outcome"] = res.get("outcome")
+                return out
+            out.update(
+                ok=True, outcome=res.get("outcome"),
+                weights_version=res.get("weights_version"),
+                step=res.get("step"), applied=res.get("applied"),
+            )
+            return out
+        finally:
+            if was_draining:
+                out["kept_drained"] = True
+            else:
+                try:
+                    self._replica_call(r, "POST", "/undrain")
+                except _err as e:
+                    # a replica stuck draining IS a failed rotation
+                    # step: the walk must STOP (out is mutated after
+                    # the return — the caller sees ok=False), or it
+                    # would drain the next replica with this one
+                    # still out of rotation
+                    out["undrain_error"] = repr(e)
+                    out["ok"] = False
+                    out.setdefault("stage", "undrain")
+                with self._lock:
+                    r.draining = False
+                # re-scrape NOW: the walk must not drain the next
+                # replica while this one still carries its stale
+                # draining/unhealthy status — that window is the one
+                # place a 2-replica rotation could shed no_replicas
+                self._scrape_one(r)
+
+    def _handle_admin_reload(self, h):
+        """``POST /admin/reload {"ckpt_dir": ...}`` — the zero-downtime
+        rotation: walk the fleet one replica at a time, drain -> swap
+        -> undrain. At most ONE replica is ever out of rotation, so
+        in-flight streams finish where they run and new requests place
+        on the rest of the fleet — zero dropped requests. Stops at the
+        first failed replica (a bad checkpoint must not take the whole
+        fleet); already-rotated replicas keep the new weights, the
+        failed one is undrained on its old weights."""
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(n) or b"{}")
+            ckpt_dir = body["ckpt_dir"]
+            if not isinstance(ckpt_dir, str) or not ckpt_dir:
+                raise ValueError("ckpt_dir must be a non-empty string")
+            version = body.get("weights_version")
+            drain_timeout_s = float(body.get("drain_timeout_s", 120.0))
+        except Exception as e:
+            self._send_json(h, 400, {"error": f"bad request: {e}"})
+            return
+        if not self._reload_walk_lock.acquire(blocking=False):
+            self._send_json(h, 409, {
+                "error": "rejected",
+                "reason": "reload_in_progress",
+            })
+            return
+        try:
+            results = []
+            for r in self.replicas:
+                res = self._reload_replica(r, ckpt_dir, version,
+                                           drain_timeout_s)
+                results.append(res)
+                if not res["ok"]:
+                    break
+            ok = all(res["ok"] for res in results) and \
+                len(results) == len(self.replicas)
+        finally:
+            self._reload_walk_lock.release()
+        self._send_json(h, 200 if ok else 500,
+                        {"ok": ok, "results": results})
 
     # ------------------------------------------------------------ routing
     def _route(self, h, body, stream):

@@ -256,9 +256,21 @@ class ServingFrontend:
             "engine": type(eng).__name__,
             "generation": getattr(eng, "generation", 0),
             "weights_version": getattr(eng, "weights_version", None),
+            "last_reload_step": getattr(eng, "last_reload_step", None),
+            "reload_in_progress": bool(
+                getattr(eng, "reload_in_progress", False)
+            ),
+            "compile_cache_hits": getattr(eng, "compile_cache_hits", 0),
             "max_queue_size": getattr(eng.scheduler, "max_queue_size",
                                       None),
         }
+        guard = getattr(eng, "trace_guard", None)
+        if guard is not None:
+            # total compiled-program inventory: a warm-started replica
+            # must show this number UNCHANGED across first traffic
+            out["compile_entries"] = int(
+                sum(guard.compile_counts().values())
+            )
         pool = getattr(eng, "pool", None)
         if pool is not None:
             out["pool"] = pool.stats()
@@ -289,6 +301,9 @@ class ServingFrontend:
             # the moment the replica is idle via the status fields
             self.draining = path == "/drain"
             self._send_json(h, 200, self.health())
+            return
+        if path == "/reload":
+            self._handle_reload(h)
             return
         if path != "/v1/generate":
             self._send_json(h, 404, {"error": "not found"})
@@ -370,6 +385,43 @@ class ServingFrontend:
         else:
             self._blocking_response(h, handle, events)
 
+    def _handle_reload(self, h):
+        """Live weight reload over the wire: heavy work (disk reads,
+        CRC verify, quantization) runs on THIS handler thread with no
+        lock held — the driver keeps decoding; only the commit takes
+        the lock. 200 = staged or applied, 409 = refused (torn/
+        incompatible checkpoint; the engine keeps its weights)."""
+        eng = self.engine
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            body = json.loads(h.rfile.read(n) or b"{}")
+            ckpt_dir = body["ckpt_dir"]
+            if not isinstance(ckpt_dir, str) or not ckpt_dir:
+                raise ValueError("ckpt_dir must be a non-empty string")
+            version = body.get("weights_version")
+        except Exception as e:
+            self._send_json(h, 400, {"error": f"bad request: {e}"})
+            return
+        if not hasattr(eng, "prepare_reload"):
+            self._send_json(h, 400, {
+                "error": f"{type(eng).__name__} does not support live "
+                         f"reload"})
+            return
+        try:
+            staged = eng.prepare_reload(
+                ckpt_dir, weights_version=version
+            )
+            if staged.ok:
+                with self._lock:
+                    eng.commit_reload(staged)
+        except Exception as e:
+            self._send_json(h, 500, {"error": repr(e)})
+            return
+        out = staged.to_json()
+        out["applied"] = staged.applied
+        out["health"] = self.health()
+        self._send_json(h, 200 if staged.ok else 409, out)
+
     def _terminal_payload(self, handle):
         return {
             "status": handle.status,
@@ -377,6 +429,7 @@ class ServingFrontend:
             "tokens": list(handle.tokens),
             "prompt_len": handle.request.prompt_len,
             "ttft_s": handle.ttft,
+            "weights_version": getattr(handle, "weights_version", None),
         }
 
     def _blocking_response(self, h, handle, events):

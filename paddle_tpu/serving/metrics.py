@@ -112,6 +112,17 @@ class ServingMetrics:
             "guard_fires", labelname="fn",
             prom_name=f"{ns}_guard_fires_total",
             help="trace-guard recompile-storm fires seen by the engine")
+        self.reloads = Counter(           # labeled by outcome
+            "reloads", labelname="outcome",
+            prom_name=f"{ns}_reloads_total",
+            help="live weight reloads, by outcome (ok|verify_failed|"
+                 "load_error|incompatible|error|...)")
+        self.reload_ttft_spike = Histogram(
+            "reload_ttft_spike",
+            prom_name=f"{ns}_reload_ttft_spike_seconds",
+            help="admission pause of one live reload (staged -> "
+                 "applied): the worst-case extra TTFT a request queued "
+                 "during the swap window saw")
         self.ttft = Histogram(            # submit -> first token
             "ttft", prom_name=f"{ns}_ttft_seconds",
             help="time to first token")
@@ -140,7 +151,8 @@ class ServingMetrics:
         reg.register_all([
             self.submitted, self.admitted, self.completed, self.rejected,
             self.timeouts, self.tokens_out, self.prefill_tokens,
-            self.guard_fires, self.ttft, self.itl, self.e2e,
+            self.guard_fires, self.reloads, self.reload_ttft_spike,
+            self.ttft, self.itl, self.e2e,
             self.queue_wait, self.queue_depth, self.slot_occupancy,
         ])
 
@@ -162,7 +174,10 @@ class ServingMetrics:
                 "prefill_tokens": self.prefill_tokens.value,
                 "guard_fires": self.guard_fires.value,
                 "guard_fires_by_fn": self.guard_fires.by_label(),
+                "reloads": self.reloads.value,
+                "reloads_by_outcome": self.reloads.by_label(),
             },
+            "reload_ttft_spike": self.reload_ttft_spike.snapshot(),
             "ttft": self.ttft.snapshot(),
             "itl": self.itl.snapshot(),
             "e2e": self.e2e.snapshot(),

@@ -74,7 +74,8 @@ class PagedServingEngine(ServingEngine):
                  max_tokens_in_flight=None, max_prefills_per_step=1,
                  scheduler=None, metrics=None, pool=None, page_pool=None,
                  clock=time.monotonic, recompile_guard_max=None,
-                 weights_version=None, prefill_transport=None):
+                 weights_version=None, prefill_transport=None,
+                 reload_template=None):
         ps = int(page_size)
         if ps < 1 or (ps & (ps - 1)):
             raise ValueError(
@@ -117,6 +118,7 @@ class PagedServingEngine(ServingEngine):
             scheduler=scheduler, metrics=metrics, pool=pool, clock=clock,
             recompile_guard_max=recompile_guard_max,
             weights_version=weights_version,
+            reload_template=reload_template,
         )
 
     # ------------------------------------------------------- KV backend
@@ -236,6 +238,19 @@ class PagedServingEngine(ServingEngine):
         )
         return fn
 
+    def _adopt_example_args(self, flat_block, bucket):
+        return (
+            self._flat, flat_block,
+            jnp.zeros((bucket // self.page_size,), jnp.int32),
+        )
+
+    def _program_signature(self, name):
+        sig = super()._program_signature(name)
+        sig["page_size"] = self.page_size
+        sig["num_pages"] = self.page_pool.num_pages
+        sig["table_width"] = self.table_width
+        return sig
+
     # ---------------------------------------------------------- requests
     def _drop_block(self, blk):
         """Return a prefill block after a failed admission. Under
@@ -333,6 +348,7 @@ class PagedServingEngine(ServingEngine):
             self.pool.free(blk)
         self._row_pages[row] = pages
         handle.status = RUNNING
+        handle.weights_version = self.weights_version
         handle.admit_time = now
         handle.admitted_step = self.step_count
         handle.first_token_time = self.clock()

@@ -1,0 +1,251 @@
+"""Live weight reload — checkpoint rotation on a RUNNING engine.
+
+Pushing a new checkpoint used to mean restarting every engine (and
+re-paying every compile). This module swaps weights in place instead,
+with the same integrity discipline the checkpoint runtime already
+enforces on restore:
+
+1. **Verify** — the candidate directory must pass the PR 5
+   manifest/CRC protocol (``checkpoint.commit.verify_checkpoint``); a
+   torn or bit-rotted publish is refused and the engine keeps serving
+   the last committed weights.
+2. **Load** — the checkpoint's ``model`` state loads into a template
+   net (a deepcopy of the serving net by default, or a caller-supplied
+   float-architecture template). When the engine serves QUANTIZED
+   weights, ``quantization.serving.quantize_for_serving`` runs inside
+   the swap — a bf16 training checkpoint publishes as int8 serving
+   weights without the training side knowing serving's format.
+3. **Validate** — the harvested param/buffer trees must match the
+   engine's current snapshot key-for-key in shape and dtype. The
+   compiled programs are shape-specialized; an incompatible checkpoint
+   is refused outright rather than recompiled into silently.
+4. **Apply at a step boundary** — the staged swap is committed only
+   when NO request is in flight: admission pauses, in-flight requests
+   finish on the old weights, then params/buffers/``weights_version``
+   swap in one host-side assignment block and admission resumes. A
+   request therefore always runs start-to-finish under ONE weights
+   version (stamped on its handle at admission), and the attached
+   prefill transport's ``expected_weights_version`` moves with the
+   swap so the worker's version-skew refusal keeps disaggregation
+   exact during the rotation window.
+
+Steps 1–3 (``prepare``) are pure and run OFF the engine's step loop —
+an HTTP handler thread does the disk reads and quantization while the
+driver keeps decoding; only step 4 needs the engine's single-threaded
+discipline. Every outcome lands in
+``paddle_serving_reloads_total{outcome}``; the admission-pause window
+(the worst-case TTFT a queued request gained) lands in
+``paddle_serving_reload_ttft_spike_seconds``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from . import chaos as _chaos
+
+logger = logging.getLogger("paddle_tpu.serving.reload")
+
+
+class ReloadError(RuntimeError):
+    """Programming-error side of reload (bad arguments); operational
+    failures (torn checkpoint, incompatible state) come back as a
+    failed :class:`StagedReload`, never an exception — a bad publish
+    must degrade to "keep serving", not to a crashed replica."""
+
+
+class StagedReload:
+    """A prepared (verified, loaded, validated) weight swap, plus its
+    outcome trail once committed/applied."""
+
+    __slots__ = ("ok", "outcome", "error", "params", "buffers",
+                 "weights_version", "step", "path", "staged_at")
+
+    def __init__(self, ok, outcome, *, error=None, params=None,
+                 buffers=None, weights_version=None, step=None,
+                 path=None):
+        self.ok = bool(ok)
+        self.outcome = outcome
+        self.error = error
+        self.params = params
+        self.buffers = buffers
+        self.weights_version = weights_version
+        self.step = step
+        self.path = path
+        self.staged_at = None
+
+    @property
+    def applied(self):
+        return self.outcome == "applied"
+
+    def to_json(self):
+        return {
+            "ok": self.ok,
+            "outcome": self.outcome,
+            "error": self.error,
+            "weights_version": self.weights_version,
+            "step": self.step,
+            "path": self.path,
+        }
+
+    def __repr__(self):
+        return (f"StagedReload(ok={self.ok}, outcome={self.outcome!r}, "
+                f"version={self.weights_version!r}, step={self.step})")
+
+
+def resolve_checkpoint_dir(path):
+    """``path`` may be a committed step directory (has a manifest) or a
+    checkpoint ROOT — then the newest committed step is chosen, exactly
+    like restore. Returns None when nothing committed exists."""
+    from ..checkpoint import commit as commit_mod
+
+    path = str(path)
+    if commit_mod.read_manifest(path) is not None:
+        return path
+    if os.path.isdir(path):
+        return commit_mod.latest_committed(path)
+    return None
+
+
+def _is_quantized(net):
+    from ..quantization.serving import QuantizedLinear
+
+    return any(
+        isinstance(m, QuantizedLinear) for _, m in net.named_sublayers()
+    )
+
+
+def _harvest(net):
+    return (
+        {k: p.value for k, p in net.named_parameters()},
+        {k: b.value for k, b in net.named_buffers()},
+    )
+
+
+def _validate(cur_params, cur_buffers, new_params, new_buffers):
+    """Key/shape/dtype compatibility of the new snapshot against the
+    one the compiled programs were built for. Returns a problem string
+    or None."""
+    import jax.numpy as jnp
+
+    for kind, cur, new in (("param", cur_params, new_params),
+                           ("buffer", cur_buffers, new_buffers)):
+        missing = sorted(set(cur) - set(new))
+        extra = sorted(set(new) - set(cur))
+        if missing or extra:
+            return (f"{kind} keys differ: missing {missing[:3]}, "
+                    f"unexpected {extra[:3]}")
+        for k, v in cur.items():
+            nv = new[k]
+            if tuple(getattr(nv, "shape", ())) != tuple(
+                getattr(v, "shape", ())
+            ):
+                return (f"{kind} {k}: shape {tuple(nv.shape)} != "
+                        f"serving {tuple(v.shape)}")
+            if jnp.dtype(nv.dtype) != jnp.dtype(v.dtype):
+                return (f"{kind} {k}: dtype {nv.dtype} != serving "
+                        f"{v.dtype}")
+    return None
+
+
+def prepare_state_swap(net, cur_params, cur_buffers, ckpt_dir, *,
+                       weights_version=None, template_net=None,
+                       verify_level="full"):
+    """The shared prepare path (serving engines AND the fleet prefill
+    worker): verify → load → (quantize) → harvest → validate. Pure —
+    touches neither ``net`` nor the current snapshot; returns a
+    :class:`StagedReload` either way."""
+    from ..checkpoint import commit as commit_mod
+    from ..distributed.checkpoint.save_load import load_state_dict
+
+    try:
+        _chaos.poke("reload.prepare", path=str(ckpt_dir))
+    except BaseException as e:
+        return StagedReload(False, "error", error=repr(e),
+                            path=str(ckpt_dir))
+    path = resolve_checkpoint_dir(ckpt_dir)
+    if path is None:
+        return StagedReload(
+            False, "no_checkpoint",
+            error=f"no committed checkpoint under {ckpt_dir!r}",
+            path=str(ckpt_dir),
+        )
+    problems = commit_mod.verify_checkpoint(path, level=verify_level)
+    if problems:
+        logger.warning("reload: refusing %s: %s", path, problems[:4])
+        return StagedReload(
+            False, "verify_failed",
+            error="; ".join(problems[:4]), path=path,
+        )
+    manifest = commit_mod.read_manifest(path)
+    step = int(manifest["step"])
+    quantized = _is_quantized(net)
+    try:
+        # a template may be a net INSTANCE or a zero-arg factory; a
+        # Layer is itself callable (its forward), so only non-Layer
+        # callables are factories. Resolution sits inside the try: a
+        # throwing factory is a load_error outcome, never an escape
+        # from the never-raises contract.
+        from ..nn.layer.layers import Layer
+
+        if template_net is not None and callable(template_net) \
+                and not isinstance(template_net, Layer):
+            template = template_net()
+        else:
+            template = template_net
+        if template is None:
+            # serving-format template built from the SNAPSHOT arrays,
+            # not the live net: state_dict keys are exactly
+            # named_parameters + named_buffers, and fresh Tensor
+            # wrappers around the current snapshot give load_state_dict
+            # the right shapes/dtypes/shardings with zero copies.
+            # Crucially this never touches the net object — the engine
+            # may be TRACING on its own thread right now (tracers
+            # swapped into the Layer attrs), and a deepcopy would race
+            # it. Works whenever the checkpoint was saved from the
+            # same (possibly quantized) structure.
+            from ..core.tensor import Tensor
+
+            tmpl = {
+                k: Tensor(v, stop_gradient=True)
+                for k, v in {**cur_params, **cur_buffers}.items()
+            }
+            load_state_dict({"model": tmpl}, path)
+            new_params = {k: tmpl[k].value for k in cur_params}
+            new_buffers = {k: tmpl[k].value for k in cur_buffers}
+        else:
+            state = {"model": template.state_dict()}
+            load_state_dict(state, path)
+            src = template
+            if quantized and not _is_quantized(template):
+                # the int8 publish path: a float training checkpoint
+                # becomes serving-format weights inside the swap
+                from ..quantization.serving import quantize_for_serving
+
+                src = quantize_for_serving(template)
+            new_params, new_buffers = _harvest(src)
+    except KeyError as e:
+        hint = (" (engine serves quantized weights — pass a "
+                "float-architecture template_net so the checkpoint "
+                "can be quantized inside the swap)"
+                if quantized and template_net is None else "")
+        return StagedReload(
+            False, "incompatible",
+            error=f"checkpoint does not match serving net: {e}{hint}",
+            path=path, step=step,
+        )
+    except Exception as e:
+        return StagedReload(
+            False, "load_error", error=repr(e), path=path, step=step,
+        )
+    problem = _validate(cur_params, cur_buffers, new_params, new_buffers)
+    if problem is not None:
+        return StagedReload(
+            False, "incompatible", error=problem, path=path, step=step,
+        )
+    version = (str(weights_version) if weights_version is not None
+               else f"ckpt-{step}")
+    return StagedReload(
+        True, "staged", params=new_params, buffers=new_buffers,
+        weights_version=version, step=step, path=path,
+    )

@@ -101,6 +101,7 @@ class RequestHandle:
         self.first_token_time = None
         self.admitted_step = None   # engine step index at admission
         self.finished_step = None
+        self.weights_version = None  # engine weights at admission
         self.on_token = on_token
         self.on_event = on_event
         self._terminal_fired = False

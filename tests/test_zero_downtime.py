@@ -1,0 +1,687 @@
+"""Zero-downtime production ops: live weight reload, AOT warmup +
+persistent compile cache, and the deterministic chaos harness.
+
+The strong pins:
+
+- **Swap-boundary exactness**: requests admitted before a reload
+  finish token-exact on the OLD weights, requests after it on the NEW
+  weights, each stamped with its own ``weights_version`` — including
+  the int8 publish path (bf16 training checkpoint -> int8 serving
+  weights inside the swap) and the prefill-worker version-skew refusal
+  during the rotation window.
+- **Integrity**: a torn checkpoint (every PR 5 corruption mode,
+  produced deterministically by the chaos helpers) is refused and the
+  engine keeps serving; a chaos-injected fault mid-apply ("kill
+  mid-swap") leaves the engine fully on the last committed weights.
+- **AOT warmup**: after ``engine.warmup`` the trace-guard compile
+  inventory stays FLAT across first traffic; with a persistent cache a
+  second engine loads every program (``compile_cache_hits``) and its
+  streams stay exact-equal to ``net.generate``.
+- **fp8 crash-resume**: the AMP O3 delayed-scaling histories ride the
+  commit manifest and restore bit-identical (the PR 8 caveat closed).
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.checkpoint import CheckpointManager
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    PagedServingEngine,
+    PrefillWorker,
+    RemotePrefillClient,
+    ServingEngine,
+    ServingFrontend,
+    chaos,
+)
+
+
+def build_net(seed=5, hidden=32):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=hidden, intermediate_size=2 * hidden,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def ref_tokens(net, ids, max_new):
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray(np.asarray(ids).reshape(1, -1))),
+        max_new_tokens=max_new,
+    ).numpy())
+    return [int(t) for t in out[0][np.asarray(ids).size:]]
+
+
+def save_checkpoint(root, net, step=1):
+    """One committed checkpoint of ``net`` under ``root``."""
+    mgr = CheckpointManager(str(root), network=net, async_saves=False)
+    mgr.save(step, blocking=True)
+    mgr.close()
+    return str(root)
+
+
+def make_engine(net, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("page_size", 8)
+    return PagedServingEngine(net, **kw)
+
+
+IDS = [3, 7, 11, 2]
+
+
+# ------------------------------------------------------------ chaos unit
+def test_chaos_monkey_schedules_deterministically():
+    m = chaos.ChaosMonkey()
+    m.fail("site", times=2, after=1)
+    with chaos.chaos(m):
+        chaos.poke("site")  # skipped by after=1
+        with pytest.raises(chaos.ChaosError):
+            chaos.poke("site")
+        with pytest.raises(chaos.ChaosError):
+            chaos.poke("site")
+        chaos.poke("site")  # times exhausted
+        chaos.poke("other")  # unarmed site never fires
+    assert m.poked("site") == 4 and m.fired("site") == 2
+    assert m.fired("other") == 0
+    chaos.poke("site")  # uninstalled: no-op
+
+
+def test_chaos_clock_advances_manually():
+    clk = chaos.ChaosClock(start=10.0)
+    assert clk() == 10.0
+    clk.advance(2.5)
+    clk.sleep(0.5)
+    assert clk() == 13.0
+
+
+def test_tear_checkpoint_every_mode_detected(tmp_path):
+    from paddle_tpu.checkpoint import commit as commit_mod
+
+    for mode in ("truncate_shard", "bitflip_shard", "delete_shard",
+                 "delete_manifest"):
+        root = tmp_path / mode
+        save_checkpoint(root, build_net(5), step=1)
+        step_dir = commit_mod.latest_committed(str(root))
+        assert commit_mod.verify_checkpoint(step_dir) == []
+        chaos.tear_checkpoint(step_dir, mode)
+        if mode == "delete_manifest":
+            assert commit_mod.read_manifest(step_dir) is None
+        else:
+            assert commit_mod.verify_checkpoint(step_dir), mode
+
+
+def test_wedged_writer_driven_by_chaos(tmp_path):
+    """The wedged-writer helper blocks an async save until released;
+    the save then commits normally."""
+    net = build_net(5)
+    mgr = CheckpointManager(str(tmp_path), network=net)
+    release = threading.Event()
+    undo = chaos.wedged_serializer(mgr, release)
+    try:
+        mgr.save(1)  # async: writer blocks on the event
+        assert not mgr.wait(timeout=0.2)
+        release.set()
+        assert mgr.wait(timeout=30)
+    finally:
+        undo()
+        mgr.close()
+    from paddle_tpu.checkpoint import commit as commit_mod
+
+    assert commit_mod.latest_committed(str(tmp_path)) is not None
+
+
+# ------------------------------------------------------------ live reload
+def test_reload_exactness_before_and_after(tmp_path):
+    netB = build_net(9)
+    refB = ref_tokens(netB, IDS, 6)
+    root = save_checkpoint(tmp_path, netB, step=3)
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    eng = make_engine(netA)
+    h1 = eng.generate([IDS], 6)[0]
+    assert h1.tokens == refA and h1.weights_version == "v0"
+    res = eng.reload_weights(root)
+    assert res.applied, res.to_json()
+    assert eng.weights_version == "ckpt-3"
+    assert eng.generation == 1 and eng.last_reload_step == 3
+    assert not eng.reload_in_progress
+    h2 = eng.generate([IDS], 6)[0]
+    assert h2.tokens == refB and h2.weights_version == "ckpt-3"
+    assert eng.metrics.reloads.by_label() == {"ok": 1}
+    assert eng.metrics.reload_ttft_spike.snapshot()["count"] == 1
+
+
+def test_reload_drains_inflight_on_old_weights(tmp_path):
+    """The swap-boundary pin: a request in flight when the reload is
+    committed finishes ENTIRELY on the old weights; a request queued
+    during the swap window runs entirely on the new ones."""
+    netA, netB = build_net(5), build_net(9)
+    refA = ref_tokens(netA, IDS, 10)
+    refB = ref_tokens(netB, [4, 9, 1], 6)
+    root = save_checkpoint(tmp_path, netB, step=1)
+    eng = make_engine(netA)
+    h_old = eng.submit(IDS, 10)
+    eng.step()  # admit + first decode: h_old now mid-flight
+    assert h_old.status == "RUNNING"
+    staged = eng.prepare_reload(root)
+    assert staged.ok
+    eng.commit_reload(staged)
+    assert eng.reload_in_progress          # in flight -> pending
+    assert eng.weights_version == "v0"     # nothing swapped yet
+    h_new = eng.submit([4, 9, 1], 6)       # queued behind the swap
+    eng.step()
+    assert h_new.status == "QUEUED"        # admission paused
+    eng.run_until_idle()
+    assert h_old.tokens == refA and h_old.weights_version == "v0"
+    assert h_new.tokens == refB and h_new.weights_version == "ckpt-1"
+    assert not eng.reload_in_progress
+    assert eng.metrics.reloads.by_label() == {"ok": 1}
+
+
+def test_reload_refuses_torn_checkpoint(tmp_path):
+    from paddle_tpu.checkpoint import commit as commit_mod
+
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    root = save_checkpoint(tmp_path, build_net(9), step=1)
+    chaos.tear_checkpoint(commit_mod.latest_committed(root),
+                          "bitflip_shard")
+    eng = make_engine(netA)
+    res = eng.reload_weights(root)
+    assert not res.ok and res.outcome == "verify_failed"
+    assert eng.weights_version == "v0" and eng.generation == 0
+    assert eng.generate([IDS], 6)[0].tokens == refA
+    assert eng.metrics.reloads.by_label() == {"verify_failed": 1}
+
+
+def test_reload_refuses_incompatible_architecture(tmp_path):
+    root = save_checkpoint(tmp_path, build_net(9, hidden=16), step=1)
+    eng = make_engine(build_net(5))
+    res = eng.reload_weights(root)
+    assert not res.ok
+    assert res.outcome in ("incompatible", "load_error"), res.to_json()
+    assert eng.weights_version == "v0"
+
+
+def test_reload_no_checkpoint(tmp_path):
+    eng = make_engine(build_net(5))
+    res = eng.reload_weights(str(tmp_path / "empty"))
+    assert not res.ok and res.outcome == "no_checkpoint"
+
+
+def test_chaos_kill_mid_swap_keeps_last_committed_weights(tmp_path):
+    """Deterministic kill-mid-swap: a fault injected at the apply seam
+    must leave the engine serving the last committed weights_version —
+    the swap is all-or-nothing. A later clean reload succeeds."""
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    netB = build_net(9)
+    refB = ref_tokens(netB, IDS, 6)
+    root = save_checkpoint(tmp_path, netB, step=2)
+    eng = make_engine(netA)
+    with chaos.chaos() as m:
+        m.fail("reload.apply")
+        res = eng.reload_weights(root)
+        assert m.fired("reload.apply") == 1
+    assert not res.ok and res.outcome == "error"
+    assert eng.weights_version == "v0" and not eng.reload_in_progress
+    assert eng.generate([IDS], 6)[0].tokens == refA
+    assert eng.metrics.reloads.by_label() == {"error": 1}
+    res2 = eng.reload_weights(root)
+    assert res2.applied
+    assert eng.generate([IDS], 6)[0].tokens == refB
+
+
+def test_reload_int8_publish_path(tmp_path):
+    """A float training checkpoint publishes as int8 serving weights:
+    the reloaded quantized engine matches a reference engine built by
+    quantizing the new checkpoint directly."""
+    from paddle_tpu.quantization.serving import quantize_for_serving
+
+    netB = build_net(9)
+    root = save_checkpoint(tmp_path, netB, step=4)
+    ref_eng = make_engine(quantize_for_serving(build_net(9)),
+                          cache_dtype="int8")
+    ref_toks = ref_eng.generate([IDS], 6)[0].tokens
+    ref_eng.close()
+    eng = make_engine(quantize_for_serving(build_net(5)),
+                      cache_dtype="int8",
+                      reload_template=lambda: build_net(5))
+    pre = eng.generate([IDS], 6)[0]
+    res = eng.reload_weights(root)
+    assert res.applied, res.to_json()
+    post = eng.generate([IDS], 6)[0]
+    assert post.tokens == ref_toks
+    assert post.tokens != pre.tokens  # the weights really moved
+    # buffers (weight_q/scale) swapped in serving format
+    assert eng.weights_version == "ckpt-4"
+
+
+def test_reload_template_accepts_net_instance(tmp_path):
+    """A net INSTANCE works as template_net (Layers are callable, but
+    must not be invoked as zero-arg factories)."""
+    from paddle_tpu.serving.reload import prepare_state_swap
+
+    root = save_checkpoint(tmp_path, build_net(9), step=1)
+    netA = build_net(5)
+    cur_p = {k: p.value for k, p in netA.named_parameters()}
+    staged = prepare_state_swap(netA, cur_p, {}, root,
+                                template_net=build_net(5))
+    assert staged.ok, staged.to_json()
+    assert staged.weights_version == "ckpt-1"
+
+
+def test_reload_quantized_without_template_is_refused(tmp_path):
+    from paddle_tpu.quantization.serving import quantize_for_serving
+
+    root = save_checkpoint(tmp_path, build_net(9), step=1)
+    eng = make_engine(quantize_for_serving(build_net(5)),
+                      cache_dtype="int8")
+    res = eng.reload_weights(root)
+    assert not res.ok and res.outcome == "incompatible"
+    assert "template_net" in (res.error or "")
+
+
+def test_reload_version_skew_refuses_remote_prefill(tmp_path):
+    """During the rotation window the engine expects the NEW version
+    while the worker still serves the old one: remote prefill is
+    refused, the clean local fallback keeps streams exact, and
+    rotating the worker (over the wire) closes the window."""
+    netA, netB = build_net(5), build_net(9)
+    refB = ref_tokens(netB, IDS, 6)
+    root = save_checkpoint(tmp_path, netB, step=1)
+    worker = PrefillWorker(build_net(5), weights_version="v0").start()
+    client = RemotePrefillClient(
+        "127.0.0.1", worker.port, expected_weights_version="v0",
+        cooldown_s=0.0,
+    )
+    eng = make_engine(netA, prefill_transport=client)
+    h0 = eng.generate([IDS], 6)[0]
+    assert h0.status == "DONE" and eng.remote_prefills == 1
+    res = eng.reload_weights(root)
+    assert res.applied
+    assert client.expected_weights_version == "ckpt-1"
+    h1 = eng.generate([IDS], 6)[0]
+    assert h1.tokens == refB                 # exact via local fallback
+    assert eng.remote_prefill_fallbacks == 1  # skew refused
+    # rotate the worker too — over a STALE cached socket (the worker
+    # idle-closes connections; reload must retry on a fresh one, not
+    # report a healthy rotation as failed)
+    import socket as socket_mod
+
+    dead_a, dead_b = socket_mod.socketpair()
+    dead_b.close()
+    client.close()
+    client._sock = dead_a
+    out = client.reload(root)
+    assert out["ok"] and out["weights_version"] == "ckpt-1"
+    assert worker.weights_version == "ckpt-1"
+    h2 = eng.generate([IDS], 6)[0]
+    assert h2.tokens == refB and eng.remote_prefills == 2
+    eng.close()
+    worker.stop()
+
+
+def test_chaos_socket_drop_falls_back_with_cooldown():
+    """An armed kv-transfer fault = a dropped socket: the admission
+    falls back to LOCAL prefill (stream exact), the cooldown window
+    opens, and the injected clock re-opens it deterministically."""
+    from paddle_tpu.serving.fleet.kv_transfer import TransferError
+
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    clk = chaos.ChaosClock()
+    worker = PrefillWorker(build_net(5)).start()
+    client = RemotePrefillClient("127.0.0.1", worker.port,
+                                 cooldown_s=5.0, clock=clk)
+    eng = make_engine(netA, prefill_transport=client)
+    with chaos.chaos() as m:
+        # the client retries a failed REUSED socket once on a fresh
+        # connection, and each send_frame pokes — arm enough fires to
+        # kill the initial attempt and the retry
+        m.fail("kv.send_frame", times=2,
+               exc=TransferError("chaos: socket drop"))
+        h = eng.generate([IDS], 6)[0]
+    assert h.tokens == refA                  # local fallback, exact
+    assert eng.remote_prefill_fallbacks == 1
+    assert not client.available()            # cooldown open
+    clk.advance(5.1)
+    assert client.available()
+    h2 = eng.generate([IDS], 6)[0]
+    assert h2.tokens == refA and eng.remote_prefills == 1
+    eng.close()
+    worker.stop()
+
+
+# ------------------------------------------------------------ AOT warmup
+def test_warmup_inventory_flat_at_first_traffic(tmp_path):
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    eng = make_engine(netA)
+    stats = eng.warmup()
+    # decode + (prefill + adopt) per bucket 8..64
+    assert stats["programs"] == 1 + 2 * 4
+    before = sum(eng.trace_guard.compile_counts().values())
+    h = eng.generate([IDS], 6)[0]
+    assert h.tokens == refA
+    after = sum(eng.trace_guard.compile_counts().values())
+    assert before == after, (before, after)
+
+
+def test_aot_cache_relaunch_hits_every_program(tmp_path):
+    from paddle_tpu.jit.aot_cache import AOTProgramCache
+
+    cache_dir = str(tmp_path / "aot")
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    e1 = make_engine(netA)
+    s1 = e1.warmup(aot_cache=cache_dir)
+    assert s1["aot_saves"] == s1["programs"] and s1["aot_hits"] == 0
+    e1.close()
+    # the "relaunched replica": same geometry, fresh process stand-in
+    e2 = make_engine(build_net(5))
+    s2 = e2.warmup(aot_cache=cache_dir)
+    assert s2["aot_hits"] == s2["programs"] == s1["programs"]
+    assert e2.compile_cache_hits == s2["programs"]
+    before = sum(e2.trace_guard.compile_counts().values())
+    assert e2.generate([IDS], 6)[0].tokens == refA
+    assert sum(e2.trace_guard.compile_counts().values()) == before
+    # the manifest inventories every serialized program
+    assert len(AOTProgramCache(cache_dir).entries()) == s1["programs"]
+    e2.close()
+
+
+def test_aot_cache_geometry_and_corruption_miss(tmp_path):
+    import os
+
+    cache_dir = str(tmp_path / "aot")
+    e1 = make_engine(build_net(5))
+    s1 = e1.warmup(aot_cache=cache_dir)
+    e1.close()
+    # different geometry -> clean miss, never a wrong executable
+    e2 = make_engine(build_net(5), max_batch_size=3)
+    s2 = e2.warmup(aot_cache=cache_dir)
+    assert s2["aot_hits"] == 0
+    e2.close()
+    # corrupt one entry -> that program recompiles, rest still hit
+    victim = sorted(
+        f for f in os.listdir(cache_dir) if f.endswith(".aotx")
+    )[0]
+    with open(os.path.join(cache_dir, victim), "wb") as f:
+        f.write(b"garbage")
+    e3 = make_engine(build_net(5))
+    s3 = e3.warmup(aot_cache=cache_dir)
+    assert s3["aot_hits"] >= s1["programs"] - 1
+    assert s3["aot_hits"] < s3["programs"] + s2["programs"]
+    e3.close()
+
+
+def test_warmup_slab_engine_too():
+    netA = build_net(5)
+    refA = ref_tokens(netA, IDS, 6)
+    eng = ServingEngine(netA, max_batch_size=2, max_seq_len=64,
+                        min_bucket=8)
+    stats = eng.warmup()
+    assert stats["programs"] == 9
+    before = sum(eng.trace_guard.compile_counts().values())
+    assert eng.generate([IDS], 6)[0].tokens == refA
+    assert sum(eng.trace_guard.compile_counts().values()) == before
+    eng.close()
+
+
+# ------------------------------------------------------- HTTP/fleet layer
+def test_frontend_reload_endpoint_and_health_fields(tmp_path):
+    import http.client
+
+    netB = build_net(9)
+    refB = ref_tokens(netB, IDS, 6)
+    root = save_checkpoint(tmp_path, netB, step=7)
+    eng = make_engine(build_net(5))
+    with ServingFrontend(eng, port=0) as fe:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        st = json.loads(conn.getresponse().read())
+        assert st["weights_version"] == "v0"
+        assert st["last_reload_step"] is None
+        assert st["reload_in_progress"] is False
+        assert st["compile_cache_hits"] == 0
+        assert "compile_entries" in st
+        conn.request(
+            "POST", "/reload",
+            body=json.dumps({"ckpt_dir": root}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200 and out["ok"], out
+        assert out["applied"] and out["weights_version"] == "ckpt-7"
+        assert out["health"]["last_reload_step"] == 7
+        from paddle_tpu.serving.http_frontend import stream_generate
+
+        events, _ = stream_generate(
+            "127.0.0.1", fe.port,
+            {"input_ids": IDS, "max_new_tokens": 6},
+        )
+        toks = [d["token"] for e, d in events if e == "token"]
+        done = [d for e, d in events if e == "done"][0]
+        assert toks == refB
+        assert done["weights_version"] == "ckpt-7"
+        # a bad body is a 400, a torn dir a 409 — engine untouched
+        conn.request("POST", "/reload", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.request(
+            "POST", "/reload",
+            body=json.dumps({"ckpt_dir": str(tmp_path / "nope")}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 409
+        assert json.loads(resp.read())["outcome"] == "no_checkpoint"
+        conn.close()
+
+
+def test_router_rolling_reload_zero_dropped(tmp_path):
+    """Two in-process replicas behind the router: a stream is running
+    when the rolling reload walks the fleet. The stream finishes DONE
+    on its admission-time weights, both replicas come out serving the
+    new version, and a post-rotation stream matches the new net."""
+    from paddle_tpu.serving import FleetRouter
+    from paddle_tpu.serving.http_frontend import stream_generate
+
+    netB = build_net(9)
+    refB = ref_tokens(netB, [2, 5], 4)
+    root = save_checkpoint(tmp_path, netB, step=9)
+    engines = [make_engine(build_net(5)) for _ in range(2)]
+    for e in engines:
+        e.warmup()  # rotation must not stall behind compiles
+    fes = [ServingFrontend(e, port=0).start() for e in engines]
+    router = FleetRouter(
+        [("127.0.0.1", fe.port) for fe in fes], port=0,
+        health_interval_s=0.05,
+    ).start()
+    try:
+        results = []
+
+        def one_stream():
+            ev, _ = stream_generate(
+                "127.0.0.1", router.port,
+                {"input_ids": [2, 5], "max_new_tokens": 4},
+            )
+            results.append(ev)
+
+        t = threading.Thread(target=one_stream)
+        t.start()
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=120)
+        # a concurrent second walk is refused, never interleaved
+        with router._reload_walk_lock:
+            conn.request(
+                "POST", "/admin/reload",
+                body=json.dumps({"ckpt_dir": root}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 409
+            assert body["reason"] == "reload_in_progress"
+        # an operator-drained replica is reloaded but KEPT drained
+        conn.request("POST", "/admin/drain/1")
+        conn.getresponse().read()
+        conn.request(
+            "POST", "/admin/reload",
+            body=json.dumps({"ckpt_dir": root}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200 and out["ok"], out
+        assert [r["weights_version"] for r in out["results"]] == \
+            ["ckpt-9", "ckpt-9"]
+        assert out["results"][1].get("kept_drained") is True
+        assert router.replicas[1].draining  # still out of rotation
+        conn.request("POST", "/admin/undrain/1")
+        conn.getresponse().read()
+        t.join(timeout=120)
+        assert not t.is_alive()
+        ev = results[0]
+        assert [e for e, _ in ev][-1] == "done"  # zero dropped
+        # post-rotation stream runs on the new weights, router-wide
+        ev2, _ = stream_generate(
+            "127.0.0.1", router.port,
+            {"input_ids": [2, 5], "max_new_tokens": 4},
+        )
+        toks = [d["token"] for e, d in ev2 if e == "token"]
+        done = [d for e, d in ev2 if e == "done"][0]
+        assert toks == refB and done["weights_version"] == "ckpt-9"
+        # /replicas carries the ops fields (wait out scrape staleness:
+        # the summary reflects the last health poll, not the reload)
+        deadline = time.monotonic() + 10
+        while True:
+            conn.request("GET", "/replicas")
+            reps = json.loads(conn.getresponse().read())["replicas"]
+            if all(r["weights_version"] == "ckpt-9" for r in reps):
+                break
+            assert time.monotonic() < deadline, reps
+            time.sleep(0.05)
+        assert all(r["reload_in_progress"] is False for r in reps)
+        conn.close()
+    finally:
+        router.stop()
+        for fe in fes:
+            fe.stop(close_engine=True)
+
+
+# ------------------------------------------------------- fp8 crash-resume
+def _o3_harness(tmp_path, steps, resume):
+    """Train the tiny llama under AMP O3 with a checkpoint manager;
+    optionally stop at ``resume`` steps and restart from the
+    checkpoint in fresh objects. Returns (losses, trainer)."""
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+
+    def build():
+        paddle.seed(11)
+        cfg = LlamaConfig.tiny(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+        )
+        net = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-3, parameters=net.parameters()
+        )
+
+        def loss_fn(logits, labels):
+            return paddle.nn.functional.cross_entropy(
+                logits.reshape([-1, 64]), labels.reshape([-1])
+            )
+
+        return net, opt, CompiledTrainStep(net, loss_fn, opt,
+                                           amp_level="O3")
+
+    rng = np.random.RandomState(3)
+    batches = [
+        (rng.randint(0, 64, (2, 16)), rng.randint(0, 64, (2, 16)))
+        for _ in range(steps)
+    ]
+    net, opt, trainer = build()
+    mgr = CheckpointManager(str(tmp_path), network=net, optimizer=opt,
+                            async_saves=False)
+    trainer.attach_checkpoint(mgr)
+    losses = []
+    for i, (x, y) in enumerate(batches):
+        if resume is not None and i == resume:
+            # "crash": rebuild everything from the committed checkpoint
+            mgr.close()
+            net, opt, trainer = build()
+            # prime optimizer moments so the opt state restores (the
+            # documented restore requirement); restore then overwrites
+            # params/moments/step/RNG and the fp8 histories
+            px, py = batches[0]
+            trainer([Tensor(jnp.asarray(px, jnp.int32))],
+                    [Tensor(jnp.asarray(py, jnp.int32))])
+            mgr = CheckpointManager(str(tmp_path), network=net,
+                                    optimizer=opt, async_saves=False)
+            res = mgr.restore_or_init()
+            assert res.restored and res.step == resume
+            trainer.attach_checkpoint(mgr)  # attach AFTER restore
+        loss, _ = trainer([Tensor(jnp.asarray(x, jnp.int32))],
+                          [Tensor(jnp.asarray(y, jnp.int32))])
+        losses.append(float(loss.numpy()))
+        mgr.save(i + 1, blocking=True)
+    mgr.close()
+    return losses, trainer
+
+
+def test_fp8_state_resumes_bit_identical(tmp_path):
+    """The PR 8 caveat, closed: an O3 resume carries the delayed-
+    scaling histories through the manifest, so the loss trajectory is
+    identical to the uninterrupted run (previously the scales
+    cold-started at 1 and the curves diverged for HISTORY_LEN steps)."""
+    gold, gold_tr = _o3_harness(tmp_path / "gold", steps=6, resume=None)
+    res, res_tr = _o3_harness(tmp_path / "res", steps=6, resume=3)
+    assert res == gold, (res, gold)
+    for k, v in gold_tr.fp8_state_dict().items():
+        np.testing.assert_array_equal(v, res_tr.fp8_state_dict()[k])
+
+
+def test_extra_state_registration_after_restore(tmp_path):
+    """register_extra_state applies an already-restored manifest
+    immediately — attach/restore work in either order."""
+    net = build_net(5)
+    mgr = CheckpointManager(str(tmp_path), network=net,
+                            async_saves=False)
+    payload = {
+        "h": np.arange(4, dtype=np.float32),
+        # int64 past 2^53: must NOT round-trip through a JSON double
+        "seed": np.asarray([(1 << 62) + 12345], dtype=np.int64),
+    }
+    mgr.register_extra_state("thing", lambda: payload,
+                             lambda d: None)
+    mgr.save(1, blocking=True)
+    mgr.close()
+    got = {}
+    mgr2 = CheckpointManager(str(tmp_path), network=build_net(5),
+                             async_saves=False)
+    res = mgr2.restore_or_init()
+    assert res.restored
+    mgr2.register_extra_state("thing", lambda: {}, got.update)
+    np.testing.assert_array_equal(got["h"], payload["h"])
+    assert got["h"].dtype == np.float32
+    np.testing.assert_array_equal(got["seed"], payload["seed"])
+    assert got["seed"].dtype == np.int64
+    mgr2.close()

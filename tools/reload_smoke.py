@@ -1,0 +1,443 @@
+"""reload-smoke — end-to-end gate for zero-downtime production ops.
+
+Drives a REAL subprocess fleet through a checkpoint rotation and a
+crash, plus a deterministic chaos scenario in-process:
+
+0. **Chaos kill-mid-swap (in-process)**: streams in flight, a fault
+   armed at the reload-apply seam — every stream must end terminal
+   (DONE, token-exact) and the engine must keep serving the last
+   committed ``weights_version``.
+1. **Rolling reload, zero dropped**: two replica subprocesses (warmed
+   through a shared AOT compile cache) behind the router; a new
+   checkpoint is committed and ``POST /admin/reload`` walks the fleet
+   drain -> swap -> undrain while concurrent SSE streams run. Every
+   stream must finish DONE, token-exact under the ``weights_version``
+   stamped at its admission, with a bounded TTFT spike; the replica
+   ``paddle_serving_reloads_total``/``reload_ttft_spike_seconds``
+   series must be live.
+2. **SIGKILL mid-swap**: a second checkpoint commits, a direct
+   ``/reload`` is fired at one replica and the process is SIGKILLed
+   while it runs. Every in-flight stream must end terminal (DONE
+   streams exact), the survivor serves on and drains to ZERO leaked
+   pages.
+3. **Warm relaunch from the AOT cache**: the killed replica relaunches
+   with the same cache dir — it must report ``compile_cache_hits > 0``
+   and its trace-guard compile inventory must stay FLAT across first
+   traffic (no tracing, no compiling), then rotate onto the latest
+   checkpoint and serve it token-exact.
+
+Exit 0 = gate passed. Wired as ``make reload-smoke``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SEED_A, SEED_B, SEED_C = 7, 11, 13
+MODEL = ["--vocab", "64", "--hidden", "32", "--layers", "2",
+         "--heads", "4", "--seed", str(SEED_A)]
+ENGINE = ["--max-batch", "2", "--max-seq", "64", "--min-bucket", "8",
+          "--page-size", "8"]
+TTFT_BOUND_S = 60.0  # generous CPU bound; typical is well under 1s
+
+
+def _build_net(seed):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _ref(net, ids, max_new):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray(np.asarray(ids)[None, :])),
+        max_new_tokens=max_new,
+    ).numpy())
+    return [int(t) for t in out[0][len(ids):]]
+
+
+def _stream(port, ids, max_new):
+    """(status, reason, tokens, weights_version, ttft_s)"""
+    from paddle_tpu.serving import HTTPRejected, stream_generate
+
+    try:
+        events, timings = stream_generate(
+            "127.0.0.1", port,
+            {"input_ids": [int(t) for t in ids],
+             "max_new_tokens": int(max_new)},
+        )
+    except HTTPRejected as e:
+        return ("REJECTED", (e.body or {}).get("reason"), [], None,
+                None)
+    toks = [d["token"] for ev, d in events if ev == "token"]
+    last = events[-1] if events else ("error", {})
+    version = (last[1] or {}).get("weights_version")
+    if last[0] == "done":
+        return ("DONE", None, toks, version, timings.get("ttft_s"))
+    return ("ERROR", (last[1] or {}).get("reason"), toks, version,
+            timings.get("ttft_s"))
+
+
+def _concurrent(port, reqs, stagger_s=0.0):
+    results = [None] * len(reqs)
+
+    def one(i):
+        results[i] = _stream(port, *reqs[i])
+
+    threads = []
+    for i in range(len(reqs)):
+        t = threading.Thread(target=one, args=(i,), daemon=True)
+        threads.append(t)
+        t.start()
+        if stagger_s:
+            time.sleep(stagger_s)
+    for t in threads:
+        t.join(timeout=300)
+    return results
+
+
+def _http(port, method, path, body=None, timeout=120):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    payload = None if body is None else json.dumps(body)
+    conn.request(method, path, body=payload,
+                 headers={"Content-Type": "application/json"}
+                 if payload else {})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    try:
+        return resp.status, json.loads(raw)
+    except ValueError:
+        return resp.status, {"raw": raw.decode("utf-8", "replace")}
+
+
+def _save_ckpt(root, seed, step):
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    net = _build_net(seed)
+    mgr = CheckpointManager(root, network=net, async_saves=False)
+    mgr.save(step, blocking=True)
+    mgr.close()
+    return net
+
+
+def _phase0_chaos(failures):
+    """Deterministic kill-mid-swap on a live in-process engine."""
+    from paddle_tpu.serving import (
+        PagedServingEngine,
+        ServingFrontend,
+        chaos,
+    )
+
+    root = tempfile.mkdtemp(prefix="reload_smoke_chaos_")
+    try:
+        _save_ckpt(root, SEED_B, 1)
+        netA = _build_net(SEED_A)
+        want = _ref(_build_net(SEED_A), [4, 9, 1, 6], 8)
+        eng = PagedServingEngine(netA, max_batch_size=2, max_seq_len=64,
+                                 min_bucket=8, page_size=8)
+        with ServingFrontend(eng, port=0) as fe:
+            with chaos.chaos() as m:
+                m.fail("reload.apply")
+                results = [None, None]
+
+                def one(i):
+                    results[i] = _stream(fe.port, [4, 9, 1, 6], 8)
+
+                threads = [threading.Thread(target=one, args=(i,))
+                           for i in range(2)]
+                for t in threads:
+                    t.start()
+                time.sleep(0.1)  # streams in flight
+                code, out = _http(fe.port, "POST", "/reload",
+                                  {"ckpt_dir": root})
+                for t in threads:
+                    t.join(timeout=120)
+            # the fault fired at apply (after the drain) — every
+            # stream terminal + exact, engine on the OLD weights
+            if m.fired("reload.apply") != 1:
+                failures.append(
+                    f"chaos: apply seam fired {m.fired('reload.apply')}"
+                )
+            for i, r in enumerate(results):
+                if r is None or r[0] != "DONE" or r[2] != want:
+                    failures.append(f"chaos: stream {i} not exact: {r}")
+            st = _http(fe.port, "GET", "/healthz")[1]
+            if st["weights_version"] != "v0" or st["reload_in_progress"]:
+                failures.append(f"chaos: engine left inconsistent: {st}")
+            by = eng.metrics.reloads.by_label()
+            if by.get("error") != 1:
+                failures.append(f"chaos: reload outcome not error: {by}")
+        print("reload_smoke: chaos kill-mid-swap — streams terminal + "
+              "exact, engine kept weights_version=v0, outcome=error")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main():
+    import numpy as np
+
+    from paddle_tpu.observability import parse_prometheus_text
+    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.fleet.launch import spawn, spawn_all
+
+    failures = []
+    rng = np.random.RandomState(5)
+    _phase0_chaos(failures)
+
+    work = tempfile.mkdtemp(prefix="reload_smoke_")
+    root = os.path.join(work, "ckpts")
+    aot = os.path.join(work, "aot_cache")
+    os.makedirs(root)
+    netA = _build_net(SEED_A)
+    netB = _save_ckpt(root, SEED_B, 1)
+
+    print("reload_smoke: spawning 2 replicas (shared AOT cache)...")
+    rep0, rep1 = spawn_all([
+        ("replica", MODEL + ENGINE + ["--aot-cache", aot]),
+        ("replica", MODEL + ENGINE + ["--aot-cache", aot]),
+    ])
+    procs = [rep0, rep1]
+    router = FleetRouter(
+        [("127.0.0.1", rep0.port), ("127.0.0.1", rep1.port)],
+        health_interval_s=0.05, breaker_cooldown_s=0.5,
+    ).start()
+    try:
+        # -- 1. rolling reload under load, zero dropped ---------------
+        mk = lambda n, m: [  # noqa: E731
+            (list(map(int, rng.randint(0, 64, (6,)))), m)
+            for _ in range(n)
+        ]
+        pre = _concurrent(router.port, mk(6, 8))
+        base_ttft = sorted(r[4] for r in pre if r and r[4])[len(pre) // 2]
+        for i, r in enumerate(pre):
+            if r is None or r[0] != "DONE" or r[3] != "v0":
+                failures.append(f"pre-reload stream {i}: {r}")
+
+        reqs = mk(12, 24)
+        results = [None] * len(reqs)
+
+        def one(i):
+            results[i] = _stream(router.port, *reqs[i])
+
+        threads = [threading.Thread(target=one, args=(i,), daemon=True)
+                   for i in range(len(reqs))]
+        for t in threads[:6]:
+            t.start()
+        time.sleep(0.2)
+        reload_resp = [None]
+
+        def rolling():
+            reload_resp[0] = _http(router.port, "POST", "/admin/reload",
+                                   {"ckpt_dir": root})
+
+        rt = threading.Thread(target=rolling, daemon=True)
+        rt.start()
+        for t in threads[6:]:
+            t.start()
+            time.sleep(0.05)
+        rt.join(timeout=300)
+        for t in threads:
+            t.join(timeout=300)
+        code, out = reload_resp[0] or (None, None)
+        if code != 200 or not (out or {}).get("ok"):
+            failures.append(f"rolling reload failed: {code} {out}")
+        else:
+            vs = [r.get("weights_version") for r in out["results"]]
+            if vs != ["ckpt-1", "ckpt-1"]:
+                failures.append(f"rolling reload versions: {vs}")
+        refs = {"v0": netA, "ckpt-1": netB}
+        n_old = n_new = 0
+        worst_ttft = 0.0
+        for i, r in enumerate(results):
+            if r is None or r[0] != "DONE":
+                failures.append(f"reload-window stream {i} dropped: {r}")
+                continue
+            status, _, toks, version, ttft = r
+            worst_ttft = max(worst_ttft, ttft or 0.0)
+            net_for = refs.get(version)
+            if net_for is None:
+                failures.append(f"stream {i}: unknown version {version}")
+                continue
+            if toks != _ref(net_for, reqs[i][0], reqs[i][1]):
+                failures.append(
+                    f"stream {i} not exact under {version}"
+                )
+            n_old += version == "v0"
+            n_new += version == "ckpt-1"
+        if worst_ttft > TTFT_BOUND_S:
+            failures.append(
+                f"TTFT spike unbounded: {worst_ttft:.1f}s"
+            )
+        post = _concurrent(router.port, mk(4, 6))
+        for i, r in enumerate(post):
+            if r is None or r[0] != "DONE" or r[3] != "ckpt-1":
+                failures.append(f"post-reload stream {i}: {r}")
+        print(f"reload_smoke: rolling reload zero dropped "
+              f"({len(reqs)} streams: {n_old} on v0, {n_new} on "
+              f"ckpt-1, all exact; worst ttft {worst_ttft * 1e3:.0f}ms"
+              f" vs baseline {base_ttft * 1e3:.0f}ms)")
+
+        # replica metrics: the reload series are live
+        _, mtext = _http(rep1.port, "GET", "/metrics")
+        parsed = parse_prometheus_text(
+            mtext["raw"] if "raw" in mtext else ""
+        )
+        names = set(parsed)
+        if not any("paddle_serving_reloads_total" in k for k in names):
+            failures.append("no paddle_serving_reloads_total series")
+        if not any("paddle_serving_reload_ttft_spike_seconds" in k
+                   for k in names):
+            failures.append("no reload_ttft_spike series")
+
+        # -- 2. SIGKILL mid-swap --------------------------------------
+        netC = _save_ckpt(root, SEED_C, 2)
+        reqs2 = mk(8, 32)
+        results2 = [None] * len(reqs2)
+
+        def one2(i):
+            results2[i] = _stream(router.port, *reqs2[i])
+
+        baseline = dict(router.metrics.requests.by_label())
+        threads2 = [threading.Thread(target=one2, args=(i,),
+                                     daemon=True)
+                    for i in range(len(reqs2))]
+        for t in threads2:
+            t.start()
+        # kill only once BOTH replicas carry live streams of THIS
+        # batch (poll, not sleep — the point is a mid-run kill)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            routed = router.metrics.requests.by_label()
+            if all(routed.get(k, 0) - baseline.get(k, 0) >= 2
+                   for k in ("0", "1")):
+                break
+            time.sleep(0.01)
+        time.sleep(0.1)  # let tokens flow on the doomed replica
+
+        def doomed_reload():
+            try:
+                _http(rep0.port, "POST", "/reload",
+                      {"ckpt_dir": root}, timeout=30)
+            except OSError:
+                pass  # killed under us — the point
+
+        dr = threading.Thread(target=doomed_reload, daemon=True)
+        dr.start()
+        time.sleep(0.05)  # land the kill inside the reload
+        rep0.kill()
+        print("reload_smoke: SIGKILLed replica 0 mid-reload")
+        for t in threads2:
+            t.join(timeout=300)
+        hangs = sum(1 for r in results2 if r is None)
+        if hangs:
+            failures.append(f"{hangs} streams never terminated")
+        done2 = [i for i, r in enumerate(results2)
+                 if r is not None and r[0] == "DONE"]
+        for i in done2:
+            _, _, toks, version, _ = results2[i]
+            net_for = {"v0": netA, "ckpt-1": netB,
+                       "ckpt-2": netC}.get(version)
+            if net_for is None or toks != _ref(net_for, reqs2[i][0],
+                                               reqs2[i][1]):
+                failures.append(
+                    f"post-kill stream {i} not exact under {version}"
+                )
+        shed = [r for r in results2 if r is not None and r[0] != "DONE"]
+        for r in shed:
+            if r[1] not in ("replica_failed", "replicas_unavailable",
+                            "fleet_saturated"):
+                failures.append(f"unexpected shed reason: {r[:2]}")
+        st1 = _http(rep1.port, "GET", "/healthz")[1]
+        pp = st1.get("page_pool") or {}
+        if pp.get("pages_in_use") != 0:
+            failures.append(f"survivor leaked pages: {pp}")
+        print(f"reload_smoke: {len(done2)} streams DONE exact, "
+              f"{len(shed)} shed terminal, survivor zero leaked pages")
+
+        # -- 3. warm relaunch from the AOT cache ----------------------
+        rep0b = spawn("replica",
+                      MODEL + ENGINE + ["--aot-cache", aot])
+        procs.append(rep0b)
+        st = _http(rep0b.port, "GET", "/healthz")[1]
+        if not st.get("compile_cache_hits"):
+            failures.append(
+                f"relaunch did not hit the AOT cache: {st}"
+            )
+        entries_before = st.get("compile_entries")
+        warm = _concurrent(rep0b.port, mk(6, 8))
+        for i, r in enumerate(warm):
+            if r is None or r[0] != "DONE":
+                failures.append(f"relaunch stream {i}: {r}")
+        st = _http(rep0b.port, "GET", "/healthz")[1]
+        if st.get("compile_entries") != entries_before:
+            failures.append(
+                f"warm replica compiled at first traffic: "
+                f"{entries_before} -> {st.get('compile_entries')}"
+            )
+        # rotate the relaunched replica onto the latest checkpoint
+        code, out = _http(rep0b.port, "POST", "/reload",
+                          {"ckpt_dir": root})
+        if code != 200 or not out.get("ok") or \
+                out.get("weights_version") != "ckpt-2":
+            failures.append(f"relaunch reload failed: {code} {out}")
+        ids = [int(t) for t in rng.randint(0, 64, (5,))]
+        r = _stream(rep0b.port, ids, 6)
+        if r[0] != "DONE" or r[2] != _ref(netC, ids, 6) or \
+                r[3] != "ckpt-2":
+            failures.append(f"relaunch not exact on ckpt-2: {r}")
+        st = _http(rep0b.port, "GET", "/healthz")[1]
+        if (st.get("page_pool") or {}).get("pages_in_use") != 0:
+            failures.append(f"relaunch leaked pages: {st}")
+        print(f"reload_smoke: relaunch warm-started "
+              f"(compile_cache_hits={st.get('compile_cache_hits')}, "
+              f"compile inventory flat at {entries_before}), rotated "
+              f"to ckpt-2 and serving it exact")
+    finally:
+        router.stop()
+        for p in procs:
+            p.terminate()
+        shutil.rmtree(work, ignore_errors=True)
+
+    if failures:
+        print("\nreload_smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        for p in procs:
+            tail = list(p.tail)[-12:]
+            if tail:
+                print(f"--- {p.role} tail ---")
+                print("\n".join(tail))
+        return 1
+    print("reload_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
