@@ -6,7 +6,7 @@ ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
-	reload-smoke
+	reload-smoke train-chaos-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -95,6 +95,22 @@ quant-smoke:
 # plus the S=8192 long-context flagship and refreshes LOWER_7B.json.
 layout-smoke:
 	$(ENV) $(PY) tools/layout_smoke.py
+
+# Resilient-training gate: subprocess train runs driven by the shared
+# chaos harness — an injected NaN at step k rolls back to the last
+# commit and the replayed loss trajectory exactly equals an
+# uninterrupted reference (bf16 O1 and fp8 O3); a wedged step fires
+# the watchdog within budget with a flight bundle on disk; a hard-
+# exited rank is relaunched by the elastic supervisor and resumes from
+# the last committed step with zero duplicated log steps.
+train-chaos-smoke:
+	$(ENV) $(PY) tools/train_chaos_smoke.py
+
+# Every smoke gate in sequence (the full pre-merge battery).
+smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
+		quant-smoke layout-smoke fleet-smoke reload-smoke \
+		train-chaos-smoke
+	@echo "smoke-all: every gate green"
 
 test:
 	$(ENV) $(PY) -m pytest tests/ -q

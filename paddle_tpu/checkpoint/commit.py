@@ -202,9 +202,12 @@ def verify_checkpoint(path, level="full"):
     """Integrity problems of a checkpoint directory, [] when intact.
 
     Checks, in order: manifest present + parsable; every manifest file
-    present with the recorded size (and, at ``level="full"``, the
-    recorded CRC32); serializer metadata parsable and referencing only
-    manifest-covered shard files."""
+    present with the recorded size (and, at ``level="full"`` /
+    ``"files"``, the recorded CRC32); serializer metadata parsable and
+    referencing only manifest-covered shard files. ``level="files"``
+    stops after the per-file checks — the discovery mode
+    (``fleet.elastic.latest_checkpoint``) for directories that carry a
+    commit manifest but no serializer metadata."""
     problems = []
     manifest = read_manifest(path)
     if manifest is None:
@@ -221,13 +224,15 @@ def verify_checkpoint(path, level="full"):
                 f"manifest says {rec['bytes']}"
             )
             continue
-        if level == "full":
+        if level in ("full", "files"):
             crc, _ = crc32_file(fpath)
             if crc != int(rec["crc32"]):
                 problems.append(
                     f"checksum mismatch: {fname} crc32 {crc} != "
                     f"manifest {rec['crc32']}"
                 )
+    if level == "files":
+        return problems
     try:
         with open(metadata_path(path)) as f:
             meta = Metadata.from_json(f.read())

@@ -804,6 +804,30 @@ class CheckpointManager:
                 seconds=time.perf_counter() - t0,
                 step=self._last_step,
             )
+            # alongside the emergency save: the last-K step records,
+            # written NEXT TO the checkpoints (the post-mortem reader
+            # already looks there). Only the NaN hook and the
+            # excepthook used to dump — a PREEMPTED run lost its
+            # flight ring entirely. Nonblocking materialization: a
+            # step may still be in flight inside the grace window, and
+            # this must never stall past it.
+            try:
+                from ..observability import get_flight_recorder
+
+                # under root/flight/ — a plain file in the root would
+                # read as a legacy step-numbered checkpoint to
+                # latest_checkpoint's file discovery
+                path = get_flight_recorder().dump(
+                    path=os.path.join(
+                        self.root, "flight",
+                        f"preemption_{os.getpid()}.json",
+                    ),
+                    reason="preemption", sync=False,
+                )
+                self._note_event("flight_dump", path=path,
+                                 reason="preemption")
+            except Exception:
+                pass
 
     # -------------------------------------------------------------- context
     def __enter__(self):

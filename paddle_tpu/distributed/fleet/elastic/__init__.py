@@ -6,5 +6,6 @@ mount empty). See manager.py for the TPU redesign notes.
 from .manager import (  # noqa: F401
     ElasticManager,
     ElasticStatus,
+    ElasticSupervisor,
     latest_checkpoint,
 )

@@ -135,6 +135,203 @@ class ElasticManager:
         return ElasticStatus.HOLD
 
 
+class ElasticSupervisor:
+    """Single-host supervisor loop: rank subprocesses that SURVIVE a
+    dead or wedged member.
+
+    The launcher's ``_watch`` restarts a pod when a child exits; this
+    grows that into the elastic recovery loop the training runtime
+    needs: spawn ``nprocs`` rank subprocesses, watch for a rank DYING
+    (nonzero exit / signal) or WEDGING (its watchdog heartbeat file
+    under ``heartbeat_dir`` goes stale — the ``TrainWatchdog`` writes
+    one per dispatch), tear the remaining ranks down cleanly, re-form
+    the world at the relaunched (or, with ``shrink_on_failure``, the
+    surviving) size, and relaunch — each child resumes from the last
+    COMMITTED checkpoint via its own ``CheckpointManager``/
+    ``latest_checkpoint`` discovery, with the dedup-across-restarts
+    log discipline keeping step records exactly-once.
+
+    ``cmd`` is the argv list every rank runs, or a callable
+    ``cmd(rank, world) -> argv``. Children get the launcher env
+    contract (``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM``) plus
+    ``PADDLE_TPU_HEARTBEAT_DIR`` so an in-child ``TrainWatchdog``
+    heartbeats without extra wiring. Restart events are counted in
+    ``paddle_training_elastic_restarts_total{reason}`` and land in the
+    flight ring."""
+
+    def __init__(self, cmd, nprocs, *, min_procs=1, max_restarts=3,
+                 heartbeat_dir=None, heartbeat_timeout_s=None,
+                 shrink_on_failure=False, grace_seconds=5.0,
+                 poll_interval_s=0.1, env=None, log_dir=None):
+        self.cmd = cmd
+        self.nprocs = int(nprocs)
+        self.min_procs = int(min_procs)
+        self.max_restarts = int(max_restarts)
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_timeout_s = (
+            float(heartbeat_timeout_s)
+            if heartbeat_timeout_s is not None else None
+        )
+        self.shrink_on_failure = bool(shrink_on_failure)
+        self.grace_seconds = float(grace_seconds)
+        self.poll_interval_s = float(poll_interval_s)
+        self.env = dict(env) if env is not None else None
+        self.log_dir = log_dir
+        self.restarts = 0
+        self.events = []  # [(reason, rank, world)]
+        self._metric = None
+        try:
+            from ....observability import Counter, get_registry
+
+            self._metric = Counter(
+                "training_elastic_restarts",
+                prom_name="paddle_training_elastic_restarts_total",
+                help="supervisor pod restarts, by trigger "
+                     "(rank_failed|rank_wedged)",
+            )
+            get_registry().register_all([self._metric])
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- plumbing
+    def _note(self, reason, rank, world):
+        self.events.append((reason, rank, world))
+        if self._metric is not None:
+            self._metric.inc(reason=reason)
+        try:
+            from ....observability import get_flight_recorder
+
+            get_flight_recorder().note(
+                "elastic_event", reason=reason, rank=rank, world=world,
+            )
+        except Exception:
+            pass
+
+    def _argv(self, rank, world):
+        return self.cmd(rank, world) if callable(self.cmd) \
+            else list(self.cmd)
+
+    def _spawn(self, world):
+        import subprocess
+
+        procs = []
+        for rank in range(world):
+            env = dict(self.env if self.env is not None else os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+            })
+            if self.heartbeat_dir:
+                env["PADDLE_TPU_HEARTBEAT_DIR"] = self.heartbeat_dir
+            logf = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                logf = open(os.path.join(
+                    self.log_dir, f"rank.{rank}.log"), "a")
+            procs.append((rank, subprocess.Popen(
+                self._argv(rank, world), env=env, stdout=logf,
+                stderr=subprocess.STDOUT if logf else None,
+            ), logf))
+        return procs
+
+    def _teardown(self, procs):
+        import signal as _signal
+        import subprocess
+
+        for _rank, p, _f in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.time() + self.grace_seconds
+        for _rank, p, logf in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            if logf:
+                logf.close()
+
+    def _stale_rank(self, procs):
+        """A LIVE rank whose heartbeat file went stale (wedged, not
+        dead) — the straggler the watchdog heartbeats exist for."""
+        if not (self.heartbeat_dir and self.heartbeat_timeout_s):
+            return None
+        now = time.time()
+        for rank, p, _f in procs:
+            if p.poll() is not None:
+                continue
+            hb = os.path.join(self.heartbeat_dir, str(rank))
+            try:
+                age = now - os.stat(hb).st_mtime
+            except OSError:
+                continue  # never beat yet: startup, not a wedge
+            if age > self.heartbeat_timeout_s:
+                return rank
+        return None
+
+    # ------------------------------------------------------------------ run
+    def run(self):
+        """Supervise until the pod completes (all ranks exit 0 →
+        returns 0) or the restart budget is spent (returns the last
+        failing rank's exit code, or 1 for a wedge)."""
+        world = self.nprocs
+        last_code = 0
+        while True:
+            procs = self._spawn(world)
+            reason = None
+            failed_rank = None
+            while True:
+                exited_clean = 0
+                for rank, p, _f in procs:
+                    code = p.poll()
+                    if code == 0:
+                        exited_clean += 1
+                    elif code is not None:
+                        reason, failed_rank, last_code = (
+                            "rank_failed", rank, code
+                        )
+                        break
+                if reason is not None:
+                    break
+                if exited_clean == len(procs):
+                    self._teardown(procs)
+                    return 0
+                wedged = self._stale_rank(procs)
+                if wedged is not None:
+                    reason, failed_rank, last_code = (
+                        "rank_wedged", wedged, 1
+                    )
+                    break
+                time.sleep(self.poll_interval_s)
+            self._teardown(procs)
+            self._clear_heartbeats()
+            self._note(reason, failed_rank, world)
+            if self.restarts >= self.max_restarts:
+                return last_code or 1
+            self.restarts += 1
+            if self.shrink_on_failure and world - 1 >= self.min_procs:
+                world -= 1  # re-form at the surviving world size
+            # else: relaunch the failed rank at the same world size
+
+    def _clear_heartbeats(self):
+        """Stale beats from the torn-down pod must not instantly trip
+        the next one's staleness check."""
+        if not self.heartbeat_dir:
+            return
+        try:
+            for name in os.listdir(self.heartbeat_dir):
+                if name.isdigit():
+                    try:
+                        os.remove(os.path.join(self.heartbeat_dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+
 _STEP_PAT = re.compile(r"(\d+)")
 
 
@@ -143,18 +340,27 @@ def latest_checkpoint(ckpt_dir):
 
     Discovery is manifest-based for checkpoint-runtime saves
     (``paddle_tpu.checkpoint``): a directory only counts once its
-    commit manifest parses, and the step comes FROM the manifest — a
-    directory name is never trusted on its own, so a torn save (killed
-    mid-write, before the commit rename) can never be picked up.
+    commit manifest parses, the step comes FROM the manifest — a
+    directory name is never trusted on its own — and the generation
+    must additionally VERIFY against its manifest (checksums, sizes,
+    shard coverage), so neither a torn save (killed mid-write, before
+    the commit rename) nor a torn GENERATION (committed, then
+    truncated/bit-rotted/short a shard) can ever be picked up:
+    discovery falls back to the next-newest intact one instead.
     Legacy layouts remain discoverable: bare distributed-checkpoint
     dirs need a parsable metadata.json; paddle.save files are plain
     files ordered by the trailing step number in the name (else
     mtime). Returns a path or None."""
     if not os.path.isdir(ckpt_dir):
         return None
-    from ....checkpoint.commit import TMP_SUFFIX, read_manifest
+    from ....checkpoint.commit import (
+        _STEP_DIR_RE,
+        TMP_SUFFIX,
+        read_manifest,
+        verify_checkpoint,
+    )
 
-    candidates = []
+    candidates = []  # (step, mtime, path, needs_verify)
     for name in os.listdir(ckpt_dir):
         p = os.path.join(ckpt_dir, name)
         if os.path.isdir(p):
@@ -163,8 +369,17 @@ def latest_checkpoint(ckpt_dir):
             manifest = read_manifest(p)
             if manifest is not None:
                 candidates.append(
-                    (int(manifest["step"]), os.path.getmtime(p), p)
+                    (int(manifest["step"]), os.path.getmtime(p), p, True)
                 )
+                continue
+            if _STEP_DIR_RE.fullmatch(name):
+                # runtime-layout name (commit.step_dir_name's shape —
+                # ONE regex, shared with the commit module, so a
+                # >8-digit step can't slip past) WITHOUT its commit
+                # manifest: the commit protocol writes the manifest
+                # last, so this is a torn/rotted generation
+                # masquerading as a legacy dir (its serializer
+                # metadata.json would parse) — never trust it
                 continue
             meta = os.path.join(p, "metadata.json")
             try:
@@ -176,7 +391,13 @@ def latest_checkpoint(ckpt_dir):
                 continue  # torn save: absent or unparsable metadata
         nums = _STEP_PAT.findall(name)
         step = int(nums[-1]) if nums else -1
-        candidates.append((step, os.path.getmtime(p), p))
-    if not candidates:
-        return None
-    return max(candidates)[-1]
+        candidates.append((step, os.path.getmtime(p), p, False))
+    for step, _mtime, path, needs_verify in sorted(candidates,
+                                                   reverse=True):
+        # "files" level: per-file size + CRC against the manifest (the
+        # serializer metadata coverage check needs the full runtime
+        # layout, which bare manifest dirs legitimately lack)
+        if needs_verify and verify_checkpoint(path, level="files"):
+            continue  # torn generation: fall back to the previous one
+        return path
+    return None

@@ -195,6 +195,48 @@ class FaultTolerantCheckpoint(Callback):
         self.manager.finalize()
 
 
+class ResilientTraining(Callback):
+    """Attach the resilient-training runtime to a fitted model.
+
+    Wires a ``training.AnomalySentinel`` (and optionally a
+    ``training.TrainWatchdog``) into the model's compiled train step
+    as soon as it exists — ``Model.fit(sentinel=...)`` is sugar for
+    appending this callback. The sentinel's skip/abort rungs work
+    as in the raw trainer; ROLLBACK inside ``fit`` is
+    rollback-without-replay: a DataLoader cannot rewind, so the fit
+    loop restores the last committed checkpoint and continues with the
+    NEXT batch (the batches between commit and anomaly are lost, the
+    run is not). For bit-identical replay semantics drive the trainer
+    with ``training.run_resilient`` instead.
+
+    Works only on the jit fast path (``prepare(jit_compile=True)``):
+    the eager path applies its optimizer update before any loss value
+    exists to judge, so there is nothing for the ladder to undo there.
+    """
+
+    def __init__(self, sentinel, watchdog=None):
+        super().__init__()
+        self.sentinel = sentinel
+        self.watchdog = watchdog
+
+    def on_train_begin(self, logs=None):
+        # the compiled step is built lazily on the first fit step; the
+        # model attaches these the moment it constructs the trainer
+        self.model._sentinel = self.sentinel
+        self.model._watchdog = self.watchdog
+        jit_step = getattr(self.model, "_jit_step", None)
+        if jit_step is not None:
+            jit_step.attach_sentinel(self.sentinel)
+            if self.watchdog is not None:
+                self.watchdog.attach(jit_step)
+        if self.watchdog is not None:
+            self.watchdog.start()
+
+    def on_train_end(self, logs=None):
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):

@@ -204,6 +204,12 @@ class Model:
             except NotImplementedError:
                 self._jit_enabled = False
                 return None, None
+            s = getattr(self, "_sentinel", None)
+            if s is not None:
+                self._jit_step.attach_sentinel(s)
+            w = getattr(self, "_watchdog", None)
+            if w is not None:
+                w.attach(self._jit_step)
         loss, outputs = self._jit_step(inputs, labels)
         return outputs, loss
 
@@ -295,18 +301,27 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, checkpoint=None):
+            accumulate_grad_batches=1, num_iters=None, checkpoint=None,
+            sentinel=None):
         assert train_data is not None
+        if checkpoint is not None or sentinel is not None:
+            cb = callbacks if isinstance(callbacks, (list, tuple)) else (
+                [callbacks] if callbacks is not None else []
+            )
+            callbacks = list(cb)
         if checkpoint is not None:
             # fault-tolerant path: a checkpoint.CheckpointManager rides
             # the callback stream (per-step policy, async atomic saves,
             # drained at train end)
-            cb = callbacks if isinstance(callbacks, (list, tuple)) else (
-                [callbacks] if callbacks is not None else []
-            )
-            callbacks = list(cb) + [
+            callbacks.append(
                 cbks_mod.FaultTolerantCheckpoint(checkpoint)
-            ]
+            )
+        if sentinel is not None:
+            # resilience path: a training.AnomalySentinel attaches to
+            # the compiled step; a rollback inside fit continues with
+            # the NEXT batch (a loader cannot rewind — see
+            # callbacks.ResilientTraining for the semantics)
+            callbacks.append(cbks_mod.ResilientTraining(sentinel))
         loader = self._make_loader(train_data, batch_size, shuffle,
                                    num_workers, drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False,
@@ -339,7 +354,17 @@ class Model:
                 inputs, labels = self._split_batch(batch)
                 accum += 1
                 update = accum % max(1, accumulate_grad_batches) == 0
-                res = self._fit_step(inputs, labels, update)
+                try:
+                    res = self._fit_step(inputs, labels, update)
+                except Exception as e:
+                    from ..training.resilience import RollbackAndReplay
+
+                    if isinstance(e, RollbackAndReplay):
+                        # rollback-without-replay: params/optimizer/RNG
+                        # are back at the last commit; the loader can't
+                        # rewind, so continue with the next batch
+                        continue
+                    raise
                 if res is not None:
                     loss, outputs, lbls = res
                     if self._metrics:

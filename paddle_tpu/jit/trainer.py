@@ -19,6 +19,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from .. import chaos as _chaos
 from ..core import random as random_mod
 from ..core import tape
 from ..core.tensor import Tensor
@@ -78,6 +79,8 @@ class CompiledTrainStep:
         self._step_fn = None
         self._param_names = [k for k, _ in network.named_parameters()]
         self._checkpoint = None
+        self._sentinel = None
+        self._watchdog = None
         # sharding layout: an explicit LayoutPolicy (or registry name)
         # pins this trainer; None captures the ACTIVE parallel.layout
         # policy NOW, at construction — so the documented pattern
@@ -121,6 +124,70 @@ class CompiledTrainStep:
                 "fp8", self.fp8_state_dict, self.load_fp8_state
             )
         return manager
+
+    def attach_sentinel(self, sentinel):
+        """Wire a ``training.AnomalySentinel`` into the step loop: the
+        sentinel sees every step's loss as a lazy device ref and walks
+        its skip/rollback/abort policy ladder on NaN/inf or loss
+        spikes. The sentinel's checkpoint manager (when it has one) is
+        how rollback restores; attach_checkpoint wires saving
+        separately."""
+        sentinel.bind(self)
+        self._sentinel = sentinel
+        return sentinel
+
+    def attach_watchdog(self, watchdog):
+        """Wire a ``training.TrainWatchdog``: each step's dispatch is
+        timestamped (one host clock read) so a wedged step or a
+        straggling peer fires before the job dies silently."""
+        self._watchdog = watchdog
+        return watchdog
+
+    # -------------------------------------------------- sentinel snapshots
+    def _memory_snapshot(self):
+        """One pre-step on-device snapshot for the sentinel's
+        skip-step rung: ``jnp.copy`` per leaf (donation-immune, the
+        checkpoint snapshot discipline — no host sync), plus the small
+        host-side counters the restore must rewind. The RNG stream is
+        deliberately NOT captured: a skipped batch keeps the key
+        sequence advancing."""
+        snap = {
+            "params": {
+                k: jnp.copy(p.value)
+                for k, p in self.network.named_parameters()
+            },
+            "buffers": {
+                k: jnp.copy(b.value)
+                for k, b in self.network.named_buffers()
+            },
+            "opt_state": {
+                k: tuple(jnp.copy(a) for a in accs)
+                for k, accs in self._gather_opt_state({}).items()
+            },
+            "fp8": (
+                {k: jnp.copy(v) for k, v in self._fp8_state.items()}
+                if self._fp8_state is not None else None
+            ),
+            "step_count": self.optimizer._step_count,
+        }
+        if self.scaler is not None:
+            sc = self.scaler
+            snap["scaler"] = (sc._scale, sc._good_steps, sc._bad_steps)
+        return snap
+
+    def _restore_memory_snapshot(self, snap):
+        """Undo the step(s) since ``snap`` was taken (skip-step)."""
+        lookup = dict(self.network.named_parameters())
+        for k, v in snap["params"].items():
+            lookup[k].value = v
+        self.network.load_functional_state(buffers=snap["buffers"])
+        self._scatter_opt_state(snap["opt_state"])
+        if snap["fp8"] is not None:
+            self._fp8_state = dict(snap["fp8"])
+        self.optimizer._step_count = snap["step_count"]
+        if self.scaler is not None and "scaler" in snap:
+            (self.scaler._scale, self.scaler._good_steps,
+             self.scaler._bad_steps) = snap["scaler"]
 
     def fp8_state_dict(self):
         """The AMP O3 delayed-scaling state as host numpy arrays
@@ -642,6 +709,16 @@ class CompiledTrainStep:
                     "networks can only be lowered (jit(...).lower), "
                     "not executed."
                 )
+        step_next = self.optimizer._step_count + 1
+        if self._sentinel is not None:
+            # pre-step snapshot for the skip rung — BEFORE the gather
+            # below hands these arrays to the donating jit
+            self._sentinel.before_step(step_next)
+        if self._watchdog is not None:
+            self._watchdog.note_dispatch(step_next)
+        # chaos seams: a blocking callback here is the deterministic
+        # wedged step, an os._exit callback the deterministic dead rank
+        _chaos.poke("train.step_begin", step=step_next)
         buffers = {k: b.value for k, b in self.network.named_buffers()}
         opt_state = self._gather_opt_state(params)
         if self._step_fn is None:  # (compile happens on first _invoke)
@@ -694,6 +771,13 @@ class CompiledTrainStep:
             # device arrays in, device arrays out — the histories never
             # touch the host (the step stays sync-free)
             self._fp8_state = new_fp8
+        # chaos value seam: a callback returning float("nan") is the
+        # deterministic anomaly the sentinel ladder must recover from
+        injected = _chaos.poke_value(
+            "train.loss", loss, step=self.optimizer._step_count
+        )
+        if injected is not loss:
+            loss = jnp.asarray(injected, jnp.float32)
         # write back: imperative objects stay the source of truth
         lookup = dict(self.network.named_parameters())
         for k, v in new_params.items():
@@ -702,9 +786,18 @@ class CompiledTrainStep:
         self._scatter_opt_state(new_state)
         self._record_telemetry(time.perf_counter() - _t0, in_vals, loss,
                                _warmup)
-        if self._checkpoint is not None:
-            # after write-back: the snapshot must see the POST-step
-            # params. Policy check + on-device snapshot only — the
-            # write happens on the manager's background thread
+        action = None
+        if self._sentinel is not None:
+            # may raise RollbackAndReplay (state already restored to
+            # the last commit) or TrainingAborted (bundle dumped);
+            # returns the Action when the ladder chose skip-step
+            action = self._sentinel.after_step(
+                self.optimizer._step_count, loss
+            )
+        if self._checkpoint is not None and action is None:
+            # after write-back AND the sentinel verdict: a step the
+            # sentinel just undid must not be checkpointed. Policy
+            # check + on-device snapshot only — the write happens on
+            # the manager's background thread
             self._checkpoint.on_step(self.optimizer._step_count)
         return Tensor(loss), [Tensor(o) for o in out_vals]

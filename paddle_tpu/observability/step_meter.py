@@ -240,6 +240,15 @@ class StepMeter:
             prom_name=f"{ns}_batch_tokens",
             help="tokens per step",
         )
+        self.run_breaks = Counter(
+            "run_breaks", prom_name=f"{ns}_run_breaks_total",
+            help="dispatch gaps past MAX_STEP_GAP_S, by cause: "
+                 "checkpoint_stall (writer backpressure / emergency "
+                 "save reported via note_blocked), watchdog_fire (a "
+                 "train watchdog flagged the step wedged), unknown "
+                 "(eval phase, operator pause, or a genuine hang "
+                 "nothing instrumented)",
+        )
         self.fp8_bytes_saved = Gauge(
             "amp_fp8_matmul_bytes_saved", unit="bytes",
             prom_name=f"{ns}_amp_fp8_matmul_bytes_saved",
@@ -268,7 +277,7 @@ class StepMeter:
             self.examples, self.tokens,
             self.tokens_per_second, self.examples_per_second, self.mfu,
             self.loss, self.grad_norm, self.batch_tokens,
-            self.fp8_bytes_saved,
+            self.run_breaks, self.fp8_bytes_saved,
             self.device_bytes_in_use, self.device_peak_bytes,
             self.device_live_arrays,
         ])
@@ -279,6 +288,8 @@ class StepMeter:
         self._mem_high_water = 0
         self._last_step_t = None
         self._blocked_pending = 0.0
+        self._wedge_pending = False
+        self._blocked_listeners = []
         cfg = getattr(model, "config", None) or config
         if self._flops_per_token is None and cfg is not None and \
                 hasattr(cfg, "hidden_size"):
@@ -343,9 +354,38 @@ class StepMeter:
         subtracted from the next dispatch-to-dispatch interval so
         step_time / tokens-per-sec / MFU are not silently deflated by
         save stalls (the caller publishes the stall itself, e.g. into
-        ``paddle_ckpt_blocked_seconds``)."""
+        ``paddle_ckpt_blocked_seconds``). Attached blocked-listeners
+        (the training watchdog's wedge detector) see the same stall so
+        they can exclude it from their own gap accounting."""
         with self._lock:
             self._blocked_pending += float(seconds)
+            listeners = list(self._blocked_listeners)
+        for fn in listeners:
+            try:
+                fn(seconds)
+            except Exception:
+                pass
+
+    def add_blocked_listener(self, fn):
+        """Forward every ``note_blocked`` stall to ``fn(seconds)`` too
+        (the train watchdog registers here, so checkpoint-blocked time
+        never reads as a wedged step). Returns an ``undo()``."""
+        with self._lock:
+            self._blocked_listeners.append(fn)
+
+        def undo():
+            with self._lock:
+                if fn in self._blocked_listeners:
+                    self._blocked_listeners.remove(fn)
+
+        return undo
+
+    def note_wedged(self):
+        """A watchdog flagged the CURRENT gap as a wedged step: the
+        next run break is attributed to ``watchdog_fire`` instead of
+        ``unknown`` in ``paddle_training_run_breaks_total``."""
+        with self._lock:
+            self._wedge_pending = True
 
     def observe_step(self, step_time, *, examples=0, tokens=0, loss=None,
                      grad_norm=None, warmup=False):
@@ -375,6 +415,7 @@ class StepMeter:
         with self._lock:
             last, self._last_step_t = self._last_step_t, now
             blocked, self._blocked_pending = self._blocked_pending, 0.0
+            wedged, self._wedge_pending = self._wedge_pending, False
         broke = False
         if not warmup and last is not None:
             # checkpoint (and similar) stalls are excluded: they are
@@ -387,8 +428,19 @@ class StepMeter:
                 # run break: the dispatch-only host dt is wrong-LOW on
                 # accelerators — publishing it would spike the
                 # throughput/MFU gauges and pollute the histogram's
-                # running mean, so this step only counts volume
+                # running mean, so this step only counts volume.
+                # Attribution makes the exposition actionable: a stall
+                # note_blocked reported is a checkpoint stall, a
+                # watchdog flag is a wedged step, anything else is an
+                # eval/pause/genuine hang.
                 broke = True
+                if wedged:
+                    reason = "watchdog_fire"
+                elif blocked > 0:
+                    reason = "checkpoint_stall"
+                else:
+                    reason = "unknown"
+                self.run_breaks.inc(reason=reason)
         self.steps.inc()
         if warmup:
             self.compile_time.observe(step_time)

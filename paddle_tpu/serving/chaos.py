@@ -1,243 +1,32 @@
-"""Deterministic fault-injection harness for the serving/checkpoint stack.
+"""Serving-side alias of the shared chaos harness.
 
-Production failure modes — torn checkpoint directories, a process
-killed mid-weight-swap, a KV-transfer socket dropping mid-frame, a
-checkpoint writer thread that wedges — are all timing-dependent, which
-is why they historically only had subprocess-SIGKILL smoke coverage.
-This module makes them DETERMINISTIC so tier-1 tests can drive the
-retry/timeout/backoff and graceful-degradation paths directly:
-
-- :class:`ChaosMonkey` — a scripted fault plan. Production seams call
-  :func:`poke` with a site name (``"kv.send_frame"``,
-  ``"reload.apply"``, ...); when a monkey is installed and a plan is
-  armed for that site, the poke raises the armed exception (or runs a
-  callback) on exactly the scheduled invocations. With no monkey
-  installed a poke is one module-attribute read — the production cost
-  is nil and the seams stay in the real code path, not in test
-  monkeypatches.
-- :class:`ChaosClock` — a manual-advance monotonic clock. Every
-  timeout/cooldown/deadline surface in the stack takes ``clock=``
-  (engines, scheduler, router, :class:`~.fleet.kv_transfer.
-  RemotePrefillClient`, CheckpointManager policy), so tests step time
-  forward instead of sleeping.
-- checkpoint corruption helpers — :func:`tear_checkpoint` produces the
-  torn-directory shapes the PR 5 verify protocol must catch, picking
-  its victim file deterministically.
-- writer-thread faults — :func:`slow_serializer` /
-  :func:`wedged_serializer` wrap a ``CheckpointManager``'s serialize
-  seam so backpressure and drain-timeout paths run on demand.
-
-Instrumented sites (grep ``chaos.poke`` / ``_chaos_poke`` for the live
-list): ``kv.send_frame`` / ``kv.recv_frame`` (the KV-transfer wire),
-``reload.prepare`` / ``reload.apply`` (the live weight swap — arming
-``reload.apply`` is the deterministic "kill mid-swap": the engine must
-end the reload with outcome ``error`` and keep serving the last
-committed weights).
+The deterministic fault-injection harness grew up here (PR 11's
+serving chaos) and then generalized to the training runtime; the one
+implementation now lives in :mod:`paddle_tpu.chaos` and this module
+re-exports it VERBATIM — same function objects, same module-level
+monkey slot — so ``serving.chaos.install(...)`` and
+``paddle_tpu.chaos.poke(...)`` always see the same armed plan and
+every existing serving caller/import keeps working unchanged.
 """
 from __future__ import annotations
 
-import contextlib
-import os
-import threading
+from ..chaos import (  # noqa: F401
+    ChaosClock,
+    ChaosError,
+    ChaosMonkey,
+    active,
+    chaos,
+    install,
+    poke,
+    poke_value,
+    slow_serializer,
+    tear_checkpoint,
+    uninstall,
+    wedged_serializer,
+)
 
-
-class ChaosError(RuntimeError):
-    """Default exception an armed fault raises at its site."""
-
-
-class ChaosClock:
-    """Manual-advance monotonic clock (drop-in for ``time.monotonic``).
-
-    ``clock()`` returns the current value; ``advance(dt)`` moves it;
-    ``sleep(dt)`` advances without blocking (hand it to code that
-    sleeps so waits become deterministic)."""
-
-    def __init__(self, start=1000.0):
-        self._t = float(start)
-        self._lock = threading.Lock()
-
-    def __call__(self):
-        with self._lock:
-            return self._t
-
-    def advance(self, dt):
-        with self._lock:
-            self._t += float(dt)
-            return self._t
-
-    # alias so the clock can stand in for time.sleep in injected code
-    def sleep(self, dt):
-        self.advance(dt)
-
-
-class _Plan:
-    __slots__ = ("after", "times", "exc", "callback")
-
-    def __init__(self, after, times, exc, callback):
-        self.after = int(after)
-        self.times = times if times is None else int(times)
-        self.exc = exc
-        self.callback = callback
-
-
-class ChaosMonkey:
-    """A scripted set of faults keyed by site name.
-
-    ``fail(site)`` arms an exception; ``on(site, fn)`` arms a callback
-    (``fn(**ctx)`` — raise from it to fault, return to observe).
-    ``after=N`` skips the first N pokes; ``times=K`` fires on the next
-    K pokes then disarms (``times=None`` = every poke). ``fired(site)``
-    counts actual fires, ``poked(site)`` all pokes — tests assert on
-    these instead of sleeping and hoping."""
-
-    def __init__(self):
-        self._plans = {}
-        self._pokes = {}
-        self._fires = {}
-        self._lock = threading.Lock()
-
-    def fail(self, site, *, times=1, after=0, exc=None):
-        self._plans[site] = _Plan(
-            after, times, exc or ChaosError(f"chaos: {site}"), None
-        )
-        return self
-
-    def on(self, site, callback, *, times=None, after=0):
-        self._plans[site] = _Plan(after, times, None, callback)
-        return self
-
-    def disarm(self, site):
-        self._plans.pop(site, None)
-
-    def poked(self, site):
-        return self._pokes.get(site, 0)
-
-    def fired(self, site):
-        return self._fires.get(site, 0)
-
-    def poke(self, site, **ctx):
-        with self._lock:
-            self._pokes[site] = self._pokes.get(site, 0) + 1
-            plan = self._plans.get(site)
-            if plan is None:
-                return
-            if plan.after > 0:
-                plan.after -= 1
-                return
-            if plan.times is not None:
-                if plan.times <= 0:
-                    return
-                plan.times -= 1
-            self._fires[site] = self._fires.get(site, 0) + 1
-            exc, callback = plan.exc, plan.callback
-        if callback is not None:
-            callback(**ctx)
-        elif exc is not None:
-            raise exc
-
-
-# one optional process-wide monkey; poke() is a no-op attribute read
-# when none is installed, so the production seams cost nothing
-_ACTIVE = None
-
-
-def install(monkey):
-    global _ACTIVE
-    _ACTIVE = monkey
-    return monkey
-
-
-def uninstall():
-    global _ACTIVE
-    _ACTIVE = None
-
-
-def active():
-    return _ACTIVE
-
-
-def poke(site, **ctx):
-    """Production seam: fault here when a monkey armed this site."""
-    m = _ACTIVE
-    if m is not None:
-        m.poke(site, **ctx)
-
-
-@contextlib.contextmanager
-def chaos(monkey=None):
-    """``with chaos() as monkey: monkey.fail("reload.apply"); ...`` —
-    installs (a fresh) monkey for the block, always uninstalls."""
-    m = monkey or ChaosMonkey()
-    prev = _ACTIVE
-    install(m)
-    try:
-        yield m
-    finally:
-        install(prev) if prev is not None else uninstall()
-
-
-# ------------------------------------------------- checkpoint corruption
-def tear_checkpoint(step_dir, mode="truncate_shard"):
-    """Deterministically damage a committed checkpoint directory the way
-    real crashes/bit-rot do. Returns the damaged file's path (or the
-    removed one). Modes: ``truncate_shard`` (torn write),
-    ``bitflip_shard`` (silent corruption), ``delete_shard`` (lost
-    file), ``delete_manifest`` (commit marker gone). The victim shard
-    is the first ``.npy`` in sorted order — deterministic, so a test's
-    failure reproduces."""
-    if mode == "delete_manifest":
-        p = os.path.join(step_dir, "manifest.json")
-        os.remove(p)
-        return p
-    shards = sorted(
-        f for f in os.listdir(step_dir) if f.endswith(".npy")
-    )
-    if not shards:
-        raise ValueError(f"no shard files under {step_dir}")
-    p = os.path.join(step_dir, shards[0])
-    if mode == "delete_shard":
-        os.remove(p)
-    elif mode == "truncate_shard":
-        size = os.path.getsize(p)
-        with open(p, "r+b") as f:
-            f.truncate(max(size // 2, 1))
-    elif mode == "bitflip_shard":
-        with open(p, "r+b") as f:
-            f.seek(-1, os.SEEK_END)
-            last = f.read(1)
-            f.seek(-1, os.SEEK_END)
-            f.write(bytes([last[0] ^ 0xFF]))
-    else:
-        raise ValueError(f"unknown tear mode {mode!r}")
-    return p
-
-
-# --------------------------------------------------- writer-thread faults
-def slow_serializer(manager, seconds, sleep=None):
-    """Wrap ``manager``'s serialize seam with a fixed delay — drives the
-    async-saver backpressure path. Returns an ``undo()`` callable."""
-    import time as _time
-
-    sleep = sleep or _time.sleep
-    inner = manager._serialize
-
-    def slowed(state, path):
-        sleep(float(seconds))
-        return inner(state, path)
-
-    manager._serialize = slowed
-    return lambda: setattr(manager, "_serialize", inner)
-
-
-def wedged_serializer(manager, release):
-    """Wrap the serialize seam so the writer BLOCKS until ``release``
-    (a ``threading.Event``) is set — the wedged-writer scenario behind
-    emergency-save grace timeouts. Returns an ``undo()`` callable."""
-    inner = manager._serialize
-
-    def wedged(state, path):
-        release.wait()
-        return inner(state, path)
-
-    manager._serialize = wedged
-    return lambda: setattr(manager, "_serialize", inner)
+__all__ = [
+    "ChaosClock", "ChaosError", "ChaosMonkey", "active", "chaos",
+    "install", "poke", "poke_value", "slow_serializer",
+    "tear_checkpoint", "uninstall", "wedged_serializer",
+]

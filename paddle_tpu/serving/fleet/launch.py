@@ -104,8 +104,20 @@ def _warmup(engine, args):
 def main(argv=None):
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--role", choices=("replica", "prefill"),
+    ap.add_argument("--role", choices=("replica", "prefill", "router"),
                     default="replica")
+    # router role
+    ap.add_argument("--replicas", default=None,
+                    metavar="HOST:PORT,HOST:PORT",
+                    help="router: comma-separated replica frontends")
+    ap.add_argument("--watch-ckpt-root", default=None, metavar="DIR",
+                    help="router: poll this checkpoint root and run "
+                         "the rolling /admin/reload walk whenever a "
+                         "NEW manifest-committed step appears — "
+                         "publishing a checkpoint needs zero admin "
+                         "POSTs")
+    ap.add_argument("--watch-interval", type=float, default=1.0,
+                    help="router: checkpoint-root poll period, seconds")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     # model (must match across the fleet for exactness)
@@ -133,10 +145,28 @@ def main(argv=None):
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     args = ap.parse_args(argv)
 
-    net = build_net(args)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *a: stop.set())
+
+    if args.role == "router":
+        if not args.replicas:
+            ap.error("--role router requires --replicas")
+        from .router import FleetRouter
+
+        router = FleetRouter(
+            [s.strip() for s in args.replicas.split(",") if s.strip()],
+            host=args.host, port=args.port,
+            watch_ckpt_root=args.watch_ckpt_root,
+            watch_interval_s=args.watch_interval,
+        ).start()
+        print(f"FLEET_READY role=router port={router.port}",
+              flush=True)
+        stop.wait()
+        router.stop()
+        return 0
+
+    net = build_net(args)
 
     if args.role == "prefill":
         from .kv_transfer import PrefillWorker

@@ -186,7 +186,9 @@ class FleetRouter:
                  registry=None, health_interval_s=0.25,
                  status_ttl_s=3.0, breaker_threshold=3,
                  breaker_cooldown_s=2.0, connect_timeout_s=5.0,
-                 stream_timeout_s=120.0, clock=time.monotonic):
+                 stream_timeout_s=120.0, clock=time.monotonic,
+                 watch_ckpt_root=None, watch_interval_s=1.0,
+                 watch_drain_timeout_s=120.0):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = [
@@ -213,6 +215,17 @@ class FleetRouter:
         self._httpd = None
         self._http_thread = None
         self._scrape_thread = None
+        # checkpoint-root auto-rotation: poll latest_committed and run
+        # the rolling walk on a NEW commit — publishing a checkpoint
+        # then needs zero admin POSTs
+        self.watch_ckpt_root = (
+            str(watch_ckpt_root) if watch_ckpt_root else None
+        )
+        self.watch_interval_s = float(watch_interval_s)
+        self.watch_drain_timeout_s = float(watch_drain_timeout_s)
+        self._watch_thread = None
+        self._watched_step = None
+        self.last_watch_result = None
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -231,6 +244,16 @@ class FleetRouter:
             daemon=True,
         )
         self._scrape_thread.start()
+        if self.watch_ckpt_root:
+            # baseline = the newest commit ALREADY on disk: the fleet
+            # is assumed launched from it, only new commits rotate
+            found = self._latest_commit()
+            self._watched_step = found[0] if found else None
+            self._watch_thread = threading.Thread(
+                target=self._watch_ckpt_loop,
+                name="paddle-fleet-ckpt-watch", daemon=True,
+            )
+            self._watch_thread.start()
         return self
 
     def stop(self):
@@ -238,6 +261,9 @@ class FleetRouter:
         if self._scrape_thread is not None:
             self._scrape_thread.join(timeout=5)
             self._scrape_thread = None
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
         from ..httpd import stop_http_server
 
         stop_http_server(self._httpd, self._http_thread)
@@ -534,6 +560,14 @@ class FleetRouter:
             deadline = time.monotonic() + float(drain_timeout_s)
             idle = False
             while time.monotonic() < deadline:
+                if self._stop.is_set():
+                    # router shutting down mid-walk: unwind NOW so the
+                    # finally below undrains this replica before the
+                    # process exits — a drain-wait that outlives
+                    # stop()'s join would strand it out of rotation
+                    out.update(stage="router_stopped",
+                               error="router stopped during drain wait")
+                    return out
                 try:
                     _, st = self._replica_call(r, "GET", "/healthz")
                 except _err:
@@ -614,12 +648,25 @@ class FleetRouter:
         except Exception as e:
             self._send_json(h, 400, {"error": f"bad request: {e}"})
             return
-        if not self._reload_walk_lock.acquire(blocking=False):
+        out = self.reload_fleet(ckpt_dir, version=version,
+                                drain_timeout_s=drain_timeout_s)
+        if out is None:
             self._send_json(h, 409, {
                 "error": "rejected",
                 "reason": "reload_in_progress",
             })
             return
+        self._send_json(h, 200 if out["ok"] else 500, out)
+
+    def reload_fleet(self, ckpt_dir, version=None,
+                     drain_timeout_s=120.0):
+        """Run one rolling reload walk (drain -> swap -> undrain, one
+        replica at a time — the ``/admin/reload`` body). Returns the
+        ``{"ok": ..., "results": [...]}`` record, or None when a walk
+        is already in progress (the admin handler maps that to 409,
+        the checkpoint watcher just retries on its next poll)."""
+        if not self._reload_walk_lock.acquire(blocking=False):
+            return None
         try:
             results = []
             for r in self.replicas:
@@ -632,8 +679,48 @@ class FleetRouter:
                 len(results) == len(self.replicas)
         finally:
             self._reload_walk_lock.release()
-        self._send_json(h, 200 if ok else 500,
-                        {"ok": ok, "results": results})
+        return {"ok": ok, "results": results}
+
+    # ------------------------------------------------ checkpoint watching
+    def _latest_commit(self):
+        """Newest COMMITTED checkpoint under the watched root as
+        ``(step, path)``, or None. Manifest-committed generations only
+        (``latest_committed`` — a torn/in-flight save can never
+        trigger a rotation)."""
+        from ...checkpoint import commit as commit_mod
+
+        try:
+            path = commit_mod.latest_committed(self.watch_ckpt_root)
+            if path is None:
+                return None
+            manifest = commit_mod.read_manifest(path)
+            if manifest is None:
+                return None
+            return int(manifest["step"]), path
+        except Exception:
+            return None
+
+    def _watch_ckpt_loop(self):
+        while not self._stop.wait(self.watch_interval_s):
+            found = self._latest_commit()
+            if found is None:
+                continue
+            step, path = found
+            if self._watched_step is not None and \
+                    step <= self._watched_step:
+                continue
+            out = self.reload_fleet(
+                path, version=None,
+                drain_timeout_s=self.watch_drain_timeout_s,
+            )
+            if out is None:
+                continue  # a walk was in flight; retry next poll
+            self.last_watch_result = dict(out, step=step, path=path)
+            if out["ok"]:
+                # only a fully-rotated fleet advances the marker: a
+                # failed walk is retried on the next poll (replicas
+                # already rotated are version-idempotent)
+                self._watched_step = step
 
     # ------------------------------------------------------------ routing
     def _route(self, h, body, stream):

@@ -1,0 +1,24 @@
+"""paddle_tpu.training — the resilient training runtime.
+
+The training-side twin of the serving tier's zero-downtime ops: an
+anomaly sentinel with a skip/rollback/abort policy ladder, a
+hang/straggler watchdog, and the loop helper that makes
+rollback-and-replay bit-identical to an uninterrupted run. See
+:mod:`paddle_tpu.training.resilience`.
+"""
+from __future__ import annotations
+
+from .resilience import (
+    Action,
+    AnomalySentinel,
+    RollbackAndReplay,
+    SentinelPolicy,
+    TrainingAborted,
+    TrainWatchdog,
+    run_resilient,
+)
+
+__all__ = [
+    "Action", "AnomalySentinel", "RollbackAndReplay", "SentinelPolicy",
+    "TrainingAborted", "TrainWatchdog", "run_resilient",
+]

@@ -570,6 +570,50 @@ def test_sigterm_emergency_save(tmp_path):
         mgr.close()
 
 
+def test_preemption_dumps_flight_bundle(tmp_path):
+    """A preempted run must not lose its last-K step records: the
+    SIGTERM path dumps a flight-recorder bundle ALONGSIDE the
+    emergency save (only the NaN hook and the excepthook used to
+    dump)."""
+    from paddle_tpu.observability import (
+        FlightRecorder,
+        set_flight_recorder,
+    )
+
+    rec = FlightRecorder(dump_dir=str(tmp_path / "flight"))
+    rec.record_step({"step": 41, "loss": 0.5})
+    prev = set_flight_recorder(rec)
+    net, opt = _make(13)
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1000),
+    )
+    try:
+        mgr.on_step(41)
+        mgr.emergency_save(grace_seconds=10.0)
+        assert [s for s, _ in list_committed(str(tmp_path / "ck"))] \
+            == [41]
+        # the bundle lands under <root>/flight/ (a step-numbered FILE
+        # in the root would read as a legacy checkpoint to elastic
+        # discovery)
+        path = rec.last_dump_path
+        assert path and os.path.isfile(path)
+        assert os.path.dirname(path) == str(tmp_path / "ck" / "flight")
+        bundle = json.load(open(path))
+        assert bundle["reason"] == "preemption"
+        assert bundle["steps"][-1]["step"] == 41
+        from paddle_tpu.distributed.fleet.elastic import (
+            latest_checkpoint,
+        )
+
+        assert latest_checkpoint(str(tmp_path / "ck")).endswith(
+            "step_00000041"
+        )
+    finally:
+        set_flight_recorder(prev)
+        mgr.close()
+
+
 def test_preemption_chains_prev_handler_on_main_thread(tmp_path):
     """A previous Python handler is honored by re-raising the signal
     with it restored — it must run on the MAIN thread in real signal

@@ -13,6 +13,7 @@ import textwrap
 import time
 
 import numpy as np
+import pytest
 
 from paddle_tpu.distributed.fleet.elastic import (
     ElasticManager,
@@ -134,6 +135,66 @@ def test_latest_checkpoint_manifest_step_beats_name(tmp_path):
     moved.mkdir()
     write_manifest(str(moved), 7, {})
     assert latest_checkpoint(str(tmp_path)).endswith("restored_copy")
+
+
+def _commit_real_checkpoints(root, steps):
+    """Real committed generations (shards + CRC manifests) via the
+    checkpoint runtime."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    mgr = CheckpointManager(
+        str(root), network=net, async_saves=False,
+        policy=CheckpointPolicy(keep_last_k=100),
+    )
+    for s in steps:
+        mgr.save(s, blocking=True)
+    mgr.close()
+
+
+@pytest.mark.parametrize("mode", [
+    "truncate_shard", "bitflip_shard", "delete_shard",
+    "delete_manifest",
+])
+def test_latest_checkpoint_skips_torn_generations(tmp_path, mode):
+    """Discovery must never hand back a torn COMMITTED generation:
+    every tear mode on the newest step falls back to the previous
+    intact one (truncate = torn write, bitflip = silent rot, missing
+    shard, missing manifest)."""
+    from paddle_tpu.chaos import tear_checkpoint
+    from paddle_tpu.checkpoint.commit import step_dir
+
+    _commit_real_checkpoints(tmp_path, [3, 7])
+    tear_checkpoint(step_dir(str(tmp_path), 7), mode)
+    found = latest_checkpoint(str(tmp_path))
+    assert found is not None and found.endswith("step_00000003"), found
+
+
+def test_latest_checkpoint_torn_next_to_legacy_and_tmp(tmp_path):
+    """The full matrix in one directory: a torn runtime generation, a
+    ``.tmp`` orphan with the highest step in its name, a legacy
+    metadata.json dir, and an intact runtime generation — discovery
+    picks the intact runtime save, never the torn/.tmp ones."""
+    from paddle_tpu.chaos import tear_checkpoint
+    from paddle_tpu.checkpoint.commit import step_dir
+
+    _commit_real_checkpoints(tmp_path, [5, 9])
+    tear_checkpoint(step_dir(str(tmp_path), 9), "bitflip_shard")
+    torn = tmp_path / "step_00000099.tmp"  # never committed
+    torn.mkdir()
+    (torn / "w.p0.s0.npy").write_bytes(b"half a shard")
+    legacy = tmp_path / "ckpt_step2"
+    legacy.mkdir()
+    (legacy / "metadata.json").write_text("{}")
+    found = latest_checkpoint(str(tmp_path))
+    assert found.endswith("step_00000005"), found
+    # with BOTH runtime generations torn, the legacy dir is the
+    # newest trustworthy candidate left
+    tear_checkpoint(step_dir(str(tmp_path), 5), "truncate_shard")
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt_step2")
 
 
 # -------------------------------------------- kill-one-worker integration

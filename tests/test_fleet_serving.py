@@ -566,6 +566,63 @@ def test_router_drain_rotates_replica_out(net, two_replicas):
         router.stop()
 
 
+def test_router_watch_ckpt_root_auto_rotates(tmp_path):
+    """``watch_ckpt_root=``: committing a NEW checkpoint triggers the
+    existing rolling-reload walk with zero admin POSTs. Commits that
+    predate router start are the baseline (no rotation); a torn
+    in-flight ``.tmp`` save never triggers; after the new commit both
+    replicas report the ckpt-step weights_version and serve its
+    tokens."""
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    def save_ckpt(net, step):
+        mgr = CheckpointManager(str(tmp_path), network=net,
+                                async_saves=False)
+        mgr.save(step, blocking=True)
+        mgr.close()
+
+    save_ckpt(build_net(5), 1)  # pre-start baseline: must NOT rotate
+    netB = build_net(9)
+    refB = ref_tokens(netB, [2, 5], 4)
+    engines = [make_engine(build_net(5)) for _ in range(2)]
+    for e in engines:
+        e.warmup()
+    fes = [ServingFrontend(e).start() for e in engines]
+    router = FleetRouter(
+        [("127.0.0.1", fe.port) for fe in fes],
+        health_interval_s=0.05, watch_ckpt_root=str(tmp_path),
+        watch_interval_s=0.05,
+    ).start()
+    try:
+        assert router._watched_step == 1
+        time.sleep(0.3)
+        assert router.last_watch_result is None  # baseline: no walk
+        # an in-flight (never committed) save must not trigger either
+        torn = tmp_path / "step_00000099.tmp"
+        torn.mkdir()
+        (torn / "w.p0.s0.npy").write_bytes(b"half")
+        save_ckpt(netB, 9)  # the real publish
+        deadline = time.monotonic() + 30
+        while router._watched_step != 9:
+            assert time.monotonic() < deadline, router.last_watch_result
+            time.sleep(0.05)
+        out = router.last_watch_result
+        assert out["ok"] and out["step"] == 9
+        assert [r["weights_version"] for r in out["results"]] == \
+            ["ckpt-9", "ckpt-9"]
+        # the fleet now serves the published weights, router-wide
+        ev, _ = stream_generate(
+            "127.0.0.1", router.port,
+            {"input_ids": [2, 5], "max_new_tokens": 4})
+        toks = [d["token"] for e, d in ev if e == "token"]
+        done = [d for e, d in ev if e == "done"][0]
+        assert toks == refB and done["weights_version"] == "ckpt-9"
+    finally:
+        router.stop()
+        for fe in fes:
+            fe.stop(close_engine=True)
+
+
 def test_router_no_replicas_sheds_503():
     router = FleetRouter([("127.0.0.1", free_port())],
                          health_interval_s=30.0).start()

@@ -1,0 +1,512 @@
+"""paddle_tpu.training — the resilient training runtime.
+
+The contract, CPU-testable deterministically through the shared chaos
+harness (no timing races, no subprocess SIGKILLs — those live in
+``tools/train_chaos_smoke.py``):
+
+- a NaN injected into the loss at step k ROLLS BACK to the last
+  committed checkpoint and the replayed trajectory is EXACTLY equal to
+  an uninterrupted run (params, optimizer moments, RNG, step count all
+  restore; the data cursor replays the same batches);
+- the SKIP rung undoes exactly the bad step from the pre-step
+  on-device snapshot and drops its batch — equal to a run that never
+  saw that batch;
+- the ladder escalates honestly: no snapshot -> rollback, no
+  manager/commit -> abort (with a flight bundle on disk);
+- the watchdog fires on a wedged dispatch gap minus checkpoint-blocked
+  time, once per wedge, with a flight bundle; peer heartbeat staleness
+  fires per episode;
+- ``ElasticSupervisor`` relaunches a dead rank and gives up at the
+  restart budget.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import chaos
+from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.trainer import CompiledTrainStep
+from paddle_tpu.observability import (
+    FlightRecorder,
+    MetricsRegistry,
+    get_step_meter,
+)
+from paddle_tpu.training import (
+    AnomalySentinel,
+    RollbackAndReplay,
+    SentinelPolicy,
+    TrainingAborted,
+    TrainWatchdog,
+    run_resilient,
+)
+
+RNG = np.random.RandomState(0)
+BATCHES = {
+    s: (
+        Tensor(jnp.asarray(RNG.randn(8, 4), "float32")),
+        Tensor(jnp.asarray(RNG.randn(8, 4), "float32")),
+    )
+    for s in range(1, 10)
+}
+
+
+def batch_fn(step):
+    x, y = BATCHES[step]
+    return [x], [y]
+
+
+def make_trainer(lr=0.05):
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=lr, parameters=net.parameters()
+    )
+    trainer = CompiledTrainStep(
+        net, lambda o, y: ((o - y) ** 2).mean(), opt
+    )
+    return net, opt, trainer
+
+
+def reference_trajectory(steps=8, skip_batch=None):
+    net, opt, trainer = make_trainer()
+    out = {}
+    order = [s for s in range(1, steps + 1) if s != skip_batch]
+
+    def fn(step):
+        return batch_fn(order[step - 1])
+
+    run_resilient(
+        trainer, fn, steps=len(order),
+        on_step=lambda s, l, a: out.__setitem__(s, float(l.numpy())),
+    )
+    return [out[s] for s in sorted(out)]
+
+
+# ------------------------------------------------------------ chaos seams
+def test_poke_value_replaces_and_counts():
+    m = chaos.ChaosMonkey()
+    m.on("train.loss", lambda value=None, **_: value * 10,
+         after=1, times=1)
+    with chaos.chaos(m):
+        assert chaos.poke_value("train.loss", 2.0, step=1) == 2.0
+        assert chaos.poke_value("train.loss", 2.0, step=2) == 20.0
+        assert chaos.poke_value("train.loss", 2.0, step=3) == 2.0
+    assert m.poked("train.loss") == 3 and m.fired("train.loss") == 1
+    # a callback returning None observes without replacing
+    m2 = chaos.ChaosMonkey().on("s", lambda value=None, **_: None)
+    with chaos.chaos(m2):
+        assert chaos.poke_value("s", 7) == 7
+    # uninstalled: pass-through
+    assert chaos.poke_value("train.loss", 5.0) == 5.0
+
+
+def test_serving_chaos_is_the_shared_module():
+    """serving.chaos re-exports paddle_tpu.chaos VERBATIM — one monkey
+    slot, so serving seams and train seams share an armed plan."""
+    from paddle_tpu.serving import chaos as schaos
+
+    assert schaos.poke is chaos.poke
+    assert schaos.install is chaos.install
+    assert schaos.ChaosMonkey is chaos.ChaosMonkey
+    assert schaos.tear_checkpoint is chaos.tear_checkpoint
+    with chaos.chaos() as m:
+        assert schaos.active() is m
+
+
+# ------------------------------------------------------- sentinel: rollback
+def test_nan_rollback_replay_trajectory_exact(tmp_path):
+    ref = reference_trajectory(steps=8)
+    net, opt, trainer = make_trainer()
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=2, keep_last_k=100),
+    )
+    trainer.attach_checkpoint(mgr)
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="rollback"), manager=mgr, sync=True,
+    )
+    trainer.attach_sentinel(sentinel)
+    got = {}
+    with chaos.chaos() as m:
+        m.on("train.loss",
+             lambda value=None, **_: float("nan"), after=4, times=1)
+        summary = run_resilient(
+            trainer, batch_fn, steps=8,
+            on_step=lambda s, l, a: got.__setitem__(s, float(l.numpy())),
+        )
+    assert summary["replays"] == 1
+    assert summary["completed_steps"] == 8
+    # the replayed trajectory is EXACTLY the uninterrupted one: the
+    # restore is bit-identical and the data cursor re-fed the same
+    # batches under the restored RNG stream
+    assert [got[s] for s in sorted(got)] == ref
+    assert sentinel.anomalies.series() == {
+        (("action", "rollback"), ("kind", "naninf")): 1
+    }
+    mgr.close()
+
+
+def test_rollback_quarantines_poisoned_generations(tmp_path):
+    """Async detection lag can let a POISONED step be checkpointed
+    before the sentinel sees its loss (the trainer only gates the
+    synchronously-judged step). A rollback must therefore quarantine
+    every generation at step >= the anomalous step — restoring one
+    would replay from post-anomaly params forever."""
+    import glob
+
+    from paddle_tpu.checkpoint import commit as commit_mod
+
+    net, opt, trainer = make_trainer()
+    root = str(tmp_path / "ck")
+    mgr = CheckpointManager(
+        root, network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1, keep_last_k=100),
+    )
+    trainer.attach_checkpoint(mgr)
+    run_resilient(trainer, batch_fn, steps=5)  # commits 1..5
+    mgr.wait()
+    assert [s for s, _ in commit_mod.list_committed(root)] == \
+        [5, 4, 3, 2, 1]
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="rollback"), manager=mgr, sync=True,
+    )
+    trainer.attach_sentinel(sentinel)
+    # detection arrives LATE: the anomaly was at step 4, so the
+    # already-committed generations 4 and 5 hold post-anomaly params
+    with pytest.raises(RollbackAndReplay) as ei:
+        sentinel._respond("naninf", 4, float("nan"))
+    assert ei.value.action.resume_step == 4  # restored commit 3
+    assert trainer.optimizer._step_count == 3
+    assert [s for s, _ in commit_mod.list_committed(root)] == [3, 2, 1]
+    # quarantined generations sit on .tmp names (discovery-proof,
+    # reaped by startup GC), not deleted out from under a post-mortem
+    quarantined = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(root, "*.anomaly.tmp"))
+    )
+    assert quarantined == ["step_00000004.anomaly.tmp",
+                           "step_00000005.anomaly.tmp"]
+    from paddle_tpu.distributed.fleet.elastic import latest_checkpoint
+
+    assert latest_checkpoint(root).endswith("step_00000003")
+    mgr.close()
+
+
+def test_rollback_without_manager_escalates_to_abort(tmp_path):
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    net, opt, trainer = make_trainer()
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="rollback"), manager=None, sync=True,
+        recorder=rec,
+    )
+    trainer.attach_sentinel(sentinel)
+    with chaos.chaos() as m:
+        m.on("train.loss",
+             lambda value=None, **_: float("inf"), after=1, times=1)
+        with pytest.raises(TrainingAborted) as ei:
+            run_resilient(trainer, batch_fn, steps=4)
+    # abort is the ladder's bottom: a flight bundle landed first
+    path = ei.value.bundle_path
+    assert path and os.path.isfile(path)
+    bundle = json.load(open(path))
+    assert bundle["reason"] == "train_anomaly:naninf"
+    assert sentinel.anomalies.series() == {
+        (("action", "abort"), ("kind", "naninf")): 1
+    }
+
+
+# ----------------------------------------------------------- sentinel: skip
+def test_nan_skip_drops_exactly_the_bad_batch():
+    ref = reference_trajectory(steps=8, skip_batch=3)
+    net, opt, trainer = make_trainer()
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="skip"), sync=True
+    )
+    sentinel.attach(trainer)
+    got, acts = {}, {}
+    with chaos.chaos() as m:
+        m.on("train.loss",
+             lambda value=None, **_: float("nan"), after=2, times=1)
+        summary = run_resilient(
+            trainer, batch_fn, steps=8,
+            on_step=lambda s, l, a: (
+                got.__setitem__(s, float(l.numpy())),
+                acts.__setitem__(s, a),
+            ),
+        )
+    assert summary["skipped_steps"] == 1 and summary["replays"] == 0
+    assert [s for s, a in acts.items() if a is not None] == [3]
+    # healthy steps equal a run that never saw batch 3: the pre-step
+    # snapshot undid params/moments/step-count, the batch was dropped,
+    # and the RNG stream kept advancing deterministically
+    healthy = [got[s] for s in sorted(got) if acts[s] is None]
+    assert healthy == ref
+    assert trainer.optimizer._step_count == 7
+    assert sentinel.skips_taken == 1
+
+
+def test_skip_budget_escalates(tmp_path):
+    """Past max_skips the same anomaly escalates to rollback (here:
+    with a committed checkpoint available)."""
+    net, opt, trainer = make_trainer()
+    mgr = CheckpointManager(
+        str(tmp_path / "ck"), network=net, optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1, keep_last_k=100),
+    )
+    trainer.attach_checkpoint(mgr)
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="skip", max_skips=1),
+        manager=mgr, sync=True,
+    )
+    trainer.attach_sentinel(sentinel)
+    with chaos.chaos() as m:
+        m.on("train.loss",
+             lambda value=None, **_: float("nan"), after=2, times=2)
+        summary = run_resilient(trainer, batch_fn, steps=6)
+    assert summary["skipped_steps"] == 1 and summary["replays"] == 1
+    by = {dict(k)["action"]: v
+          for k, v in sentinel.anomalies.series().items()}
+    assert by == {"skip": 1, "rollback": 1}
+    mgr.close()
+
+
+def test_spike_detection_and_classify():
+    sentinel = AnomalySentinel(SentinelPolicy(
+        spike_action="abort", spike_factor=10.0, min_history=4,
+    ), sync=True)
+    for v in (1.0, 1.1, 0.9, 1.05):
+        assert sentinel._classify(v) is None
+        sentinel._history.append(v)
+    assert sentinel._classify(5.0) is None       # below factor
+    assert sentinel._classify(50.0) == "loss_spike"
+    assert sentinel._classify(float("nan")) == "naninf"
+    # absolute ceiling works without history
+    s2 = AnomalySentinel(SentinelPolicy(loss_ceiling=100.0), sync=True)
+    assert s2._classify(101.0) == "loss_spike"
+    assert s2._classify(99.0) is None
+
+
+def test_fit_sentinel_skips_and_run_completes():
+    """Model.fit(sentinel=) attaches to the compiled step; a NaN step
+    is skipped and the fit run completes."""
+    from paddle_tpu.io import TensorDataset
+
+    ds = TensorDataset([
+        paddle.to_tensor(RNG.randn(16, 4).astype("float32")),
+        paddle.to_tensor(RNG.randn(16, 4).astype("float32")),
+    ])
+    paddle.seed(0)
+    model = paddle.Model(nn.Linear(4, 4))
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.05, parameters=model.parameters()
+    )
+    model.prepare(optimizer=opt,
+                  loss=lambda o, y: ((o - y) ** 2).mean(),
+                  jit_compile=True)
+    sentinel = AnomalySentinel(
+        SentinelPolicy(nan_action="skip"), sync=True
+    )
+    with chaos.chaos() as m:
+        m.on("train.loss",
+             lambda value=None, **_: float("nan"), after=1, times=1)
+        model.fit(ds, batch_size=4, epochs=1, verbose=0, shuffle=False,
+                  sentinel=sentinel)
+    assert model._jit_step._sentinel is sentinel
+    assert sentinel.skips_taken == 1
+    assert opt._step_count == 3  # 4 batches, one undone
+
+
+# --------------------------------------------------------------- watchdog
+def test_watchdog_fires_once_per_wedge_and_dumps(tmp_path):
+    clk = chaos.ChaosClock()
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    reg = MetricsRegistry()
+    fired = []
+    wd = TrainWatchdog(
+        stall_seconds=5.0, clock=clk, registry=reg, recorder=rec,
+        on_fire=lambda kind, **info: fired.append((kind, info)),
+    )
+    assert wd.check() == []          # nothing dispatched yet
+    wd.note_dispatch(1)
+    clk.advance(3.0)
+    assert wd.check() == []          # inside budget
+    clk.advance(3.0)
+    out = wd.check()
+    assert [k for k, _ in out] == ["wedged_step"]
+    assert fired[0][1]["step"] == 1
+    # one fire per wedge: the SAME gap never refires
+    assert wd.check() == []
+    assert wd.fires.series() == {(("kind", "wedged_step"),): 1}
+    # the flight bundle landed before anyone died
+    assert wd.last_dump_path and os.path.isfile(wd.last_dump_path)
+    assert json.load(open(wd.last_dump_path))["reason"] == \
+        "watchdog:wedged_step"
+    # a new dispatch re-arms
+    wd.note_dispatch(2)
+    clk.advance(6.0)
+    assert [k for k, _ in wd.check()] == ["wedged_step"]
+
+
+def test_watchdog_excludes_checkpoint_blocked_time(tmp_path):
+    clk = chaos.ChaosClock()
+    wd = TrainWatchdog(stall_seconds=5.0, clock=clk,
+                       registry=MetricsRegistry(),
+                       recorder=FlightRecorder(dump_dir=str(tmp_path)))
+    wd.note_dispatch(1)
+    clk.advance(8.0)
+    wd.note_blocked(6.0)  # an emergency save is not a hang
+    assert wd.check() == []
+    clk.advance(4.0)      # now 12s gap - 6s blocked > 5s stall
+    assert [k for k, _ in wd.check()] == ["wedged_step"]
+
+
+def test_watchdog_peer_heartbeat_staleness(tmp_path):
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    wd = TrainWatchdog(
+        stall_seconds=60.0, heartbeat_dir=str(hb), rank=0,
+        heartbeat_timeout_s=5.0, registry=MetricsRegistry(),
+        recorder=FlightRecorder(dump_dir=str(tmp_path)),
+    )
+    wd.note_dispatch(1)
+    assert (hb / "0").exists()  # own heartbeat written on dispatch
+    # a peer whose beat went stale fires ONCE per episode
+    (hb / "1").write_text("7\n")
+    old = time.time() - 30.0
+    os.utime(hb / "1", (old, old))
+    out = wd.check()
+    assert [k for k, _ in out] == ["missed_heartbeat"]
+    assert out[0][1]["rank"] == 1
+    assert wd.check() == []     # same staleness episode: no refire
+    # the peer beats again, then goes stale again -> a NEW episode
+    (hb / "1").write_text("9\n")
+    os.utime(hb / "1", (old + 1, old + 1))
+    assert [k for k, _ in wd.check()] == ["missed_heartbeat"]
+
+
+def test_trainer_dispatch_feeds_watchdog():
+    clk = chaos.ChaosClock()
+    net, opt, trainer = make_trainer()
+    wd = TrainWatchdog(stall_seconds=300.0, clock=clk,
+                       registry=MetricsRegistry())
+    wd.attach(trainer)
+    run_resilient(trainer, batch_fn, steps=2)
+    assert wd._last_step == 2
+    wd.stop()
+
+
+# ----------------------------------------------- StepMeter run-break reasons
+def test_run_break_reason_attribution():
+    meter = get_step_meter()
+
+    def force_break():
+        with meter._lock:
+            meter._last_step_t = time.perf_counter() - 120.0
+
+    base = dict(meter.run_breaks.series())
+
+    def delta():
+        now = meter.run_breaks.series()
+        return {
+            dict(k)["reason"]: v - base.get(k, 0)
+            for k, v in now.items()
+            if v != base.get(k, 0)
+        }
+
+    meter.observe_step(0.01)  # arm _last_step_t
+    force_break()
+    meter.observe_step(0.01)
+    assert delta() == {"unknown": 1}
+    force_break()
+    meter.note_blocked(1.0)
+    meter.observe_step(0.01)
+    assert delta() == {"unknown": 1, "checkpoint_stall": 1}
+    force_break()
+    meter.note_wedged()
+    meter.observe_step(0.01)
+    assert delta() == {"unknown": 1, "checkpoint_stall": 1,
+                       "watchdog_fire": 1}
+
+
+# --------------------------------------------------------- elastic supervisor
+SUPERVISED = """
+import json, os, sys
+work = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+assert os.environ["PADDLE_TPU_HEARTBEAT_DIR"]
+# resume cursor: the "checkpoint" is a committed counter file
+ck = os.path.join(work, "cursor")
+start = int(open(ck).read()) + 1 if os.path.exists(ck) else 0
+# dedup-across-restarts: a teardown can land between log-N and
+# commit-N; the rerun recomputes N but must not re-log it
+logpath = os.path.join(work, f"steps.{rank}.log")
+lastlogged = -1
+if os.path.exists(logpath):
+    for line in open(logpath):
+        lastlogged = max(lastlogged, json.loads(line)["step"])
+log = open(logpath, "a")
+marker = os.path.join(work, "crashed_once")
+for step in range(start, 6):
+    if step > lastlogged:
+        print(json.dumps({"step": step, "rank": rank, "world": world}),
+              file=log, flush=True)
+    if rank == 0:
+        tmp = ck + ".tmp"
+        open(tmp, "w").write(str(step))
+        os.replace(tmp, ck)
+    if step == 2 and rank == 1 and not os.path.exists(marker):
+        open(marker, "w").close()
+        os._exit(17)
+"""
+
+
+def test_supervisor_relaunches_dead_rank(tmp_path):
+    import sys
+
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = tmp_path / "child.py"
+    script.write_text(SUPERVISED)
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    sup = ElasticSupervisor(
+        [sys.executable, str(script), str(tmp_path)], nprocs=2,
+        max_restarts=2, heartbeat_dir=str(hb), poll_interval_s=0.05,
+    )
+    rc = sup.run()
+    assert rc == 0
+    assert sup.restarts == 1
+    assert sup.events == [("rank_failed", 1, 2)]
+    # rank 0's log resumed past the committed cursor: steps 0..5 each
+    # exactly once (the dedup-across-restarts discipline holds because
+    # the relaunch resumes from the commit, no step re-logged)
+    steps = [json.loads(line)["step"]
+             for line in open(tmp_path / "steps.0.log")]
+    assert steps == list(range(6)), steps
+
+
+def test_supervisor_respects_restart_budget(tmp_path):
+    import sys
+
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = tmp_path / "bad.py"
+    script.write_text("import os; os._exit(9)\n")
+    sup = ElasticSupervisor(
+        [sys.executable, str(script)], nprocs=1, max_restarts=2,
+        poll_interval_s=0.02,
+    )
+    rc = sup.run()
+    assert rc == 9
+    assert sup.restarts == 2
+    assert [e[0] for e in sup.events] == ["rank_failed"] * 3

@@ -1,0 +1,428 @@
+"""train_chaos_smoke — CI gate for the resilient training runtime.
+
+Three recovery paths, each driven end-to-end in REAL subprocesses
+through the shared chaos harness (``paddle_tpu.chaos``):
+
+1. **Injected-NaN rollback** (bf16 "O1" and fp8 "O3"): a train run
+   gets a NaN injected into its loss at step k via the
+   ``train.loss`` chaos seam; the sentinel rolls back to the last
+   committed checkpoint and the replay-capable loop re-feeds the same
+   batches — the final loss trajectory must be EXACTLY equal (bit-for-
+   bit, compared as ``float.hex``) to an uninterrupted reference run.
+   For O3 that exactness includes the fp8 delayed-scaling amax
+   histories, which persist through ``register_extra_state``.
+2. **Wedged-step watchdog**: a chaos callback blocks ``train.step_begin``
+   for several seconds; the watchdog's monitor thread must fire within
+   the configured budget, with a flight bundle on disk BEFORE the run
+   would have died silently.
+3. **SIGKILL-one-rank elastic recovery**: an ``ElasticSupervisor``
+   drives two rank subprocesses; rank 1 hard-exits at step k (chaos
+   seam again); the supervisor tears down, relaunches, and the run
+   resumes from the last committed step with ZERO duplicated log steps
+   (the PR 5 dedup-across-restarts discipline).
+
+Exit 0 when every path recovers as specified, 1 with a named failure.
+
+    python tools/train_chaos_smoke.py      # or: make train-chaos-smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+NAN_STEP = 5
+TOTAL_STEPS = 8
+WEDGE_STEP = 4
+WEDGE_SECONDS = 3.0
+WATCHDOG_STALL_S = 1.0
+WATCHDOG_BUDGET_S = 2.5  # stall + poll + slack
+
+
+def fail(name, detail=""):
+    print(f"train-chaos-smoke FAIL [{name}] {detail}")
+    sys.exit(1)
+
+
+def run_child(script, work, *args, timeout=300):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, script, work, *map(str, args)], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if r.returncode != 0:
+        fail("child-died",
+             f"{os.path.basename(script)} {args}: rc={r.returncode}\n"
+             + r.stdout[-1000:] + r.stderr[-1500:])
+    return r.stdout
+
+
+# ------------------------------------------------------ 1. NaN -> rollback
+ROLLBACK_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import chaos
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+    from paddle_tpu.training import (
+        AnomalySentinel, SentinelPolicy, run_resilient,
+    )
+
+    work, mode, amp = sys.argv[1], sys.argv[2], sys.argv[3]
+    amp = None if amp == "none" else amp
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(16, 32)
+            self.l2 = nn.Linear(32, 16)
+        def forward(self, x):
+            return self.l2(F.relu(self.l1(x)))
+
+    paddle.seed(0)
+    net = MLP()
+    opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                 parameters=net.parameters())
+    trainer = CompiledTrainStep(
+        net, lambda o, y: ((o - y) ** 2).mean(), opt, amp_level=amp,
+    )
+    rng = np.random.RandomState(7)
+    batches = {{
+        s: (Tensor(jax.numpy.asarray(rng.randn(8, 16), "float32")),
+            Tensor(jax.numpy.asarray(rng.randn(8, 16), "float32")))
+        for s in range(1, {total} + 1)
+    }}
+    def batch_fn(s):
+        x, y = batches[s]
+        return [x], [y]
+
+    traj = {{}}
+    sentinel = None
+    if mode == "chaos":
+        mgr = CheckpointManager(
+            os.path.join(work, f"ck_{{amp}}"), network=net,
+            optimizer=opt,
+            policy=CheckpointPolicy(save_every_steps=2,
+                                    keep_last_k=100),
+        )
+        trainer.attach_checkpoint(mgr)
+        sentinel = AnomalySentinel(
+            SentinelPolicy(nan_action="rollback"), manager=mgr,
+            sync=True,
+        )
+        trainer.attach_sentinel(sentinel)
+        monkey = chaos.install(chaos.ChaosMonkey())
+        monkey.on("train.loss",
+                  lambda value=None, **_: float("nan"),
+                  after={nan_step} - 1, times=1)
+    summary = run_resilient(
+        trainer, batch_fn, steps={total},
+        on_step=lambda s, l, a: traj.__setitem__(
+            s, float(l.numpy()).hex()),
+    )
+    out = {{"traj": traj, "summary": summary}}
+    if sentinel is not None:
+        out["anomalies"] = {{
+            "|".join(f"{{k}}={{v}}" for k, v in sorted(dict(key).items())): n
+            for key, n in sentinel.anomalies.series().items()
+        }}
+        mgr.finalize()
+    print("RESULT " + json.dumps(out), flush=True)
+""")
+
+
+def scenario_rollback(work):
+    script = os.path.join(work, "rollback_child.py")
+    with open(script, "w") as f:
+        f.write(ROLLBACK_CHILD.format(
+            repo=REPO, total=TOTAL_STEPS, nan_step=NAN_STEP))
+    for amp in ("O1", "O3"):
+        results = {}
+        for mode in ("reference", "chaos"):
+            out = run_child(script, work, mode, amp)
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("RESULT ")]
+            if not line:
+                fail("rollback-no-result", f"amp={amp} mode={mode}")
+            results[mode] = json.loads(line[-1][len("RESULT "):])
+        ref, cha = results["reference"], results["chaos"]
+        if cha["summary"]["replays"] != 1:
+            fail("rollback-no-replay",
+                 f"amp={amp}: {cha['summary']}")
+        if cha.get("anomalies") != {"action=rollback|kind=naninf": 1}:
+            fail("rollback-counter",
+                 f"amp={amp}: {cha.get('anomalies')}")
+        if cha["traj"] != ref["traj"]:
+            diff = {
+                s: (ref["traj"].get(s), cha["traj"].get(s))
+                for s in set(ref["traj"]) | set(cha["traj"])
+                if ref["traj"].get(s) != cha["traj"].get(s)
+            }
+            fail("rollback-trajectory",
+                 f"amp={amp}: recovered run != uninterrupted: {diff}")
+        print(f"rollback[{amp}]: NaN at step {NAN_STEP} -> rollback -> "
+              f"replayed trajectory EXACTLY equals the uninterrupted "
+              f"run ({len(ref['traj'])} steps)")
+
+
+# ------------------------------------------------- 2. wedge -> watchdog
+WEDGE_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import chaos
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.observability import (
+        FlightRecorder, set_flight_recorder,
+    )
+    from paddle_tpu.training import TrainWatchdog, run_resilient
+
+    work = sys.argv[1]
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    trainer = CompiledTrainStep(
+        net, lambda o, y: ((o - y) ** 2).mean(), opt)
+    # process default: the StepMeter's per-step records and the
+    # watchdog's dump must land in the SAME ring
+    rec = FlightRecorder(dump_dir=os.path.join(work, "flight"))
+    set_flight_recorder(rec)
+    fires = []
+    wd = TrainWatchdog(
+        stall_seconds={stall}, poll_interval_s=0.1, recorder=rec,
+        on_fire=lambda kind, **info: fires.append(
+            {{"kind": kind, "t": time.monotonic(), **info}}),
+    )
+    wd.attach(trainer)
+    wd.start()
+    wedge_t = [None]
+    def wedge(step=None, **_):
+        wedge_t[0] = time.monotonic()
+        time.sleep({wedge_s})
+    monkey = chaos.install(chaos.ChaosMonkey())
+    monkey.on("train.step_begin", wedge, after={wedge_step} - 1,
+              times=1)
+    rng = np.random.RandomState(0)
+    x = Tensor(jax.numpy.asarray(rng.randn(8, 8), "float32"))
+    y = Tensor(jax.numpy.asarray(rng.randn(8, 8), "float32"))
+    run_resilient(trainer, lambda s: ([x], [y]), steps=6)
+    wd.stop()
+    print("RESULT " + json.dumps({{
+        "fires": fires, "wedge_t": wedge_t[0],
+        "series": {{str(dict(k)): v
+                    for k, v in wd.fires.series().items()}},
+        "bundle": wd.last_dump_path,
+    }}), flush=True)
+""")
+
+
+def scenario_wedge(work):
+    script = os.path.join(work, "wedge_child.py")
+    with open(script, "w") as f:
+        f.write(WEDGE_CHILD.format(
+            repo=REPO, stall=WATCHDOG_STALL_S, wedge_s=WEDGE_SECONDS,
+            wedge_step=WEDGE_STEP))
+    out = run_child(script, work)
+    line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+    if not line:
+        fail("wedge-no-result", out[-500:])
+    res = json.loads(line[-1][len("RESULT "):])
+    wedged = [f for f in res["fires"] if f["kind"] == "wedged_step"]
+    if len(wedged) != 1:
+        fail("wedge-fires", f"expected exactly 1 wedged_step fire: "
+                            f"{res['fires']}")
+    latency = wedged[0]["t"] - res["wedge_t"]
+    # note_dispatch lands microseconds before the wedge callback, so
+    # the fire can arrive a hair under the stall threshold
+    if not (WATCHDOG_STALL_S - 0.2 <= latency <= WATCHDOG_BUDGET_S):
+        fail("wedge-latency",
+             f"fired {latency:.2f}s after the wedge began "
+             f"(budget: {WATCHDOG_STALL_S}..{WATCHDOG_BUDGET_S}s)")
+    if res["series"].get("{'kind': 'wedged_step'}") != 1:
+        fail("wedge-counter", f"{res['series']}")
+    bundle = res["bundle"]
+    if not (bundle and os.path.isfile(bundle)):
+        fail("wedge-bundle", "no flight bundle on disk")
+    parsed = json.load(open(bundle))
+    if parsed["reason"] != "watchdog:wedged_step":
+        fail("wedge-bundle-reason", parsed["reason"])
+    if not parsed["steps"]:
+        fail("wedge-bundle-steps", "bundle carries no step records")
+    print(f"wedge: watchdog fired {latency:.2f}s into a "
+          f"{WEDGE_SECONDS:.0f}s wedge (stall budget "
+          f"{WATCHDOG_STALL_S:.0f}s) with a flight bundle on disk")
+
+
+# -------------------------------------- 3. kill-rank -> elastic resume
+ELASTIC_CHILD = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import chaos
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointPolicy
+    from paddle_tpu.training import TrainWatchdog, run_resilient
+
+    work = sys.argv[1]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    trainer = CompiledTrainStep(
+        net, lambda o, y: ((o - y) ** 2).mean(), opt)
+    # per-rank roots: these ranks are independent single-process jax
+    # worlds (the launcher deployment shape), each resuming from its
+    # OWN last committed step
+    mgr = CheckpointManager(
+        os.path.join(work, f"ckpts.{{rank}}"), network=net,
+        optimizer=opt,
+        policy=CheckpointPolicy(save_every_steps=1, keep_last_k=100),
+        async_saves=False,
+    )
+    res = mgr.restore_or_init()
+    start = res.step + 1 if res.restored else 1
+    # heartbeats via the supervisor-exported dir (no extra wiring)
+    wd = TrainWatchdog(stall_seconds=60.0)
+    wd.attach(trainer)
+
+    # the chaos seam IS the dead rank: hard-exit mid-run, once
+    marker = os.path.join(work, "killed_once")
+    def kill(step=None, **_):
+        if rank == 1 and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(17)
+    monkey = chaos.install(chaos.ChaosMonkey())
+    monkey.on("train.step_begin", kill, after=3 - start, times=1)
+
+    rng = np.random.RandomState(0)
+    batches = {{
+        s: (Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32")),
+            Tensor(jax.numpy.asarray(rng.randn(8, 4), "float32")))
+        for s in range(1, 9)
+    }}
+    # dedup-across-restarts: a kill can land between log-N and
+    # commit-N; the rerun recomputes the identical step, only the log
+    # line needs dedup
+    logpath = os.path.join(work, f"steps.{{rank}}.log")
+    lastlogged = 0
+    if os.path.exists(logpath):
+        for line in open(logpath):
+            lastlogged = max(lastlogged, json.loads(line)["step"])
+    log = open(logpath, "a")
+    def on_step(s, loss, action):
+        # log BEFORE commit (the PR 5 discipline): a kill between the
+        # two makes the rerun recompute the identical step, and only
+        # the log line needs dedup — the reverse order would leave a
+        # committed-but-never-logged step (a permanent hole)
+        if s > lastlogged:
+            print(json.dumps({{"step": s,
+                               "loss": float(loss.numpy()).hex()}}),
+                  file=log, flush=True)
+        mgr.on_step(s)
+    if start <= 8:
+        run_resilient(trainer,
+                      lambda s: ([batches[s][0]], [batches[s][1]]),
+                      steps=8, start_step=start, on_step=on_step)
+    mgr.finalize()
+    print(f"DONE rank={{rank}} start={{start}}", flush=True)
+""")
+
+
+def scenario_elastic(work):
+    from paddle_tpu.distributed.fleet.elastic import ElasticSupervisor
+
+    script = os.path.join(work, "elastic_child.py")
+    with open(script, "w") as f:
+        f.write(ELASTIC_CHILD.format(repo=REPO))
+    hb = os.path.join(work, "hb")
+    os.makedirs(hb, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    sup = ElasticSupervisor(
+        [sys.executable, script, work], nprocs=2, max_restarts=2,
+        heartbeat_dir=hb, poll_interval_s=0.1, env=env,
+        log_dir=os.path.join(work, "log"),
+    )
+    t0 = time.time()
+    rc = sup.run()
+    if rc != 0:
+        tail = ""
+        for r in (0, 1):
+            p = os.path.join(work, "log", f"rank.{r}.log")
+            if os.path.isfile(p):
+                tail += f"\n--- rank {r} ---\n" + open(p).read()[-800:]
+        fail("elastic-rc", f"supervisor rc={rc}{tail}")
+    if sup.restarts != 1 or sup.events != [("rank_failed", 1, 2)]:
+        fail("elastic-events",
+             f"restarts={sup.restarts} events={sup.events}")
+    if not os.path.exists(os.path.join(work, "killed_once")):
+        fail("elastic-no-kill", "rank 1 never hard-exited")
+    for r in (0, 1):
+        steps = [json.loads(line)["step"]
+                 for line in open(os.path.join(work, f"steps.{r}.log"))]
+        if steps != list(range(1, 9)):
+            fail("elastic-log-dedup",
+                 f"rank {r} steps not exactly-once 1..8: {steps}")
+    print(f"elastic: rank 1 hard-exited at step 3, supervisor "
+          f"relaunched, both ranks resumed from the last commit with "
+          f"zero duplicated log steps ({time.time() - t0:.1f}s)")
+
+
+# ------------------------------------------------- serving-chaos parity
+def check_serving_reexport():
+    """The shared harness must be the SAME module serving callers
+    import — reload-smoke and the fleet tests ride on that."""
+    import paddle_tpu.chaos as shared
+    from paddle_tpu.serving import chaos as serving_chaos
+
+    for name in ("poke", "poke_value", "install", "ChaosMonkey",
+                 "ChaosClock", "tear_checkpoint", "wedged_serializer"):
+        if getattr(serving_chaos, name) is not getattr(shared, name):
+            fail("chaos-reexport", f"serving.chaos.{name} diverged")
+    with shared.chaos() as m:
+        if serving_chaos.active() is not m:
+            fail("chaos-reexport", "monkey slot not shared")
+    print("serving.chaos re-export: shared module verified")
+
+
+def main():
+    work = tempfile.mkdtemp(prefix="train_chaos_smoke_")
+    print(f"train-chaos-smoke workdir: {work}")
+    check_serving_reexport()
+    scenario_rollback(work)
+    scenario_wedge(work)
+    scenario_elastic(work)
+    print("train-chaos-smoke OK")
+
+
+if __name__ == "__main__":
+    main()
