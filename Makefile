@@ -6,7 +6,7 @@ ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
-	reload-smoke train-chaos-smoke smoke-all
+	reload-smoke train-chaos-smoke prefix-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step) + AST lint + API-surface audit, diffed
@@ -106,10 +106,20 @@ layout-smoke:
 train-chaos-smoke:
 	$(ENV) $(PY) tools/train_chaos_smoke.py
 
+# Prefix-cache gate: two HTTP/SSE waves over a shared prefix (wave 2
+# must HIT with streams exact vs net.generate), forced arena pressure
+# must LRU-evict cold prefixes with zero leaked pages and zero
+# refcount drift, a mid-run weight reload must flush the store (post-
+# swap waves miss cleanly, exact on the new weights), and the
+# shared-prefix serve_bench must show >= 5x p50 TTFT collapse
+# warm-vs-cold on the CPU smoke model.
+prefix-smoke:
+	$(ENV) $(PY) tools/prefix_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
-		train-chaos-smoke
+		train-chaos-smoke prefix-smoke
 	@echo "smoke-all: every gate green"
 
 test:

@@ -114,17 +114,23 @@ def alloc_kv_caches(cfg, B, S_max, cache_dtype=None):
     ]
 
 
-def prefill(net, ids, caches, length=None):
+def prefill(net, ids, caches, length=None, pos=0):
     """Run the prompt through the cache path in one pass (caches filled
-    [0, S)). ``ids`` may be right-padded to a bucket length: pass
-    ``length`` (scalar, traceable) and the returned logits row is taken
-    at position ``length - 1`` instead of the last column — pad tokens
-    only ever write cache slots that decode overwrites before reading
-    (causal masking), so bucketed prefill is numerically exact.
+    [pos, pos + S)). ``ids`` may be right-padded to a bucket length:
+    pass ``length`` (scalar, traceable) and the returned logits row is
+    taken at position ``length - 1`` instead of the last column — pad
+    tokens only ever write cache slots that decode overwrites before
+    reading (causal masking), so bucketed prefill is numerically exact.
+
+    ``pos`` (scalar, traceable; default 0) starts the chunk at an
+    offset: tokens land at cache positions [pos, pos + S) and attend to
+    everything already cached below ``pos`` — the CHUNKED prefill the
+    serving prefix cache uses to recompute only the uncached tail of a
+    prompt (tier-1-pinned bitwise-equal to the full-prompt prefill).
     Returns (next-token logits [B, V], caches)."""
     with tape.trace_scope(), tape.no_grad():
         logits, caches = net(
-            Tensor(ids), caches=caches, pos=jnp.int32(0)
+            Tensor(ids), caches=caches, pos=jnp.asarray(pos, jnp.int32)
         )
     lv = logits.value
     if length is None:

@@ -201,6 +201,25 @@ def adopt_into_slab(dst, blk, slot):
     )
 
 
+def gather_block_from_pages(arena, page_ids, n_pages, page_size):
+    """The inverse of :func:`adopt_into_pages`: materialize ``n_pages``
+    arena pages at traced ``page_ids`` as one prefill-layout block
+    ``[1, n_pages * page_size, ...]`` — the serving prefix cache uses it
+    to rebuild a request's cached-prefix KV so the chunked prefill can
+    attend over it (ids past the cached span point at the garbage page
+    0; its content sits behind the position mask like any stale slot)."""
+    if is_quantized(arena):
+        kvh = arena.q.shape[2]
+        d = arena.q.shape[3]
+        return QuantizedKV(
+            arena.q[page_ids].reshape(1, n_pages * page_size, kvh, d),
+            arena.scale[page_ids].reshape(1, n_pages * page_size, kvh),
+        )
+    return arena[page_ids].reshape(
+        1, n_pages * page_size, arena.shape[2], arena.shape[3]
+    )
+
+
 def adopt_into_pages(arena, blk, page_ids, n_pages, page_size):
     """One leaf of the paged engine's adopt program: scatter a
     prefilled ``[1, bucket, ...]`` block into the arena as ``n_pages``

@@ -61,9 +61,11 @@ from .kv_pool import (  # noqa: F401
 from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
 from .paged_engine import PagedServingEngine  # noqa: F401
 from .paged_pool import PagedKVPool, PagesExhausted  # noqa: F401
+from .prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from .reload import ReloadError, StagedReload  # noqa: F401
 from .scheduler import (  # noqa: F401
     REASON_ENGINE_CLOSED,
+    REASON_PAGES_EXHAUSTED,
     REASON_QUEUE_FULL,
     REASON_SHAPE_MISMATCH,
     REASON_TIMEOUT,

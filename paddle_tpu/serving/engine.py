@@ -90,6 +90,28 @@ def build_prefill_body(net, do_sample, top_k, top_p):
     return body
 
 
+def build_chunk_prefill_body(net, do_sample, top_k, top_p):
+    """The CHUNKED prefill body (prefix-cache warm path): run only the
+    uncached tail of a prompt — ``ids`` [1, tail_bucket] starting at
+    cache position ``pos`` over a block whose [0, pos) slots were
+    gathered from shared prefix pages. Same sampling head as the full
+    program; the logits row is ``length - 1`` relative to the chunk.
+    Tier-1-pinned bitwise-equal to the full-prompt prefill body."""
+
+    def body(params, buffers, ids, length, pos, flat_block, temperature,
+             key):
+        net.load_functional_state(params, buffers)
+        net.eval()
+        logits, caches = prefill(
+            net, ids, _unflatten(flat_block), length=length, pos=pos
+        )
+        nxt = _select_next(logits, do_sample, temperature, top_k, top_p,
+                           key)
+        return nxt, _flatten(caches)
+
+    return body
+
+
 class _Seq:
     """Host-side state of one running sequence (one slab row)."""
 
@@ -473,6 +495,14 @@ class ServingEngine:
         the prefill/decode disaggregation lever."""
         return None
 
+    def _admission_fits(self):
+        """Optional per-request feasibility predicate handed to the
+        scheduler's pop (None = budget-only admission). The prefix-
+        caching paged engine supplies one: a warm request's page need
+        depends on how much of its prompt the cache covers, which a
+        scalar token budget cannot express."""
+        return None
+
     def step(self):
         """One engine iteration: retire expired, admit into free slots,
         run one decode step over the whole resident KV state."""
@@ -500,7 +530,8 @@ class ServingEngine:
         while self._pending_swap is None and self._has_capacity() and (
             cap is None or admitted < cap
         ):
-            handle = self.scheduler.pop_next(self._admission_budget())
+            handle = self.scheduler.pop_next(self._admission_budget(),
+                                             fits=self._admission_fits())
             if handle is None:
                 break
             try:
@@ -664,6 +695,10 @@ class ServingEngine:
         self._pending_swap = None
         self.reload_in_progress = False
         self._restore_net_state()
+        # backend hook: the paged engine flushes its prefix cache here —
+        # a post-swap request must never adopt KV computed under the
+        # weights that just rotated out
+        self._on_weights_swapped()
         # disaggregation stays exact across the rotation: the prefill
         # worker's version-skew refusal now rejects OLD-weights blocks
         tr = getattr(self, "prefill_transport", None)
@@ -686,6 +721,11 @@ class ServingEngine:
             )
         except Exception:
             pass
+
+    def _on_weights_swapped(self):
+        """Post-swap hook, called with the new weights installed and
+        nothing in flight. Base engines have no derived-from-weights
+        state; the paged engine flushes its prefix cache here."""
 
     # ------------------------------------------------------- AOT warmup
     def _warmup_buckets(self):

@@ -13,6 +13,16 @@ request on the best replica:
   score ``(1 + queue_depth + active + routed_in_flight) / (1 +
   free_pages)`` — free pages are capacity, queue depth is pressure,
   and the router's own in-flight count covers scrape staleness.
+- **Cache affinity**: replicas running a prefix cache (the paged
+  engine's COW page sharing) serve a warm prefix with near-zero
+  prefill compute and near-zero marginal HBM — but only on the
+  replica that already holds the pages. The router remembers which
+  replica last served each prompt-prefix head (first
+  ``affinity_prefix_tokens`` input ids, bounded LRU map) and divides
+  that replica's load score by ``1 + affinity_bonus``: same-prefix
+  traffic converges onto the warm replica until real load outweighs
+  the bonus. Recorded at placement time so concurrent same-prefix
+  requests converge immediately.
 - **Circuit breaking**: request-path failures (connect errors, 5xx)
   count per replica; past ``breaker_threshold`` consecutive failures
   the breaker OPENS for ``breaker_cooldown_s`` (placement skips it),
@@ -41,6 +51,7 @@ out with zero dropped requests.
 """
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -114,12 +125,18 @@ class RouterMetrics:
             "fleet_replica_active",
             prom_name=f"{ns}_replica_active",
             help="in-flight decode rows from the replica's last status")
+        self.replica_prefix_hits = Gauge(
+            "fleet_replica_prefix_hits",
+            prom_name=f"{ns}_replica_prefix_hits",
+            help="prefix-cache hits from the replica's last status "
+                 "(absent series = replica runs no prefix cache)")
         reg = registry or get_registry()
         reg.register_all([
             self.requests, self.http_requests, self.retries, self.shed,
             self.breaker_opens, self.stream_aborts, self.ttft,
             self.replica_healthy, self.replica_free_pages,
             self.replica_queue_depth, self.replica_active,
+            self.replica_prefix_hits,
         ])
 
 
@@ -164,6 +181,7 @@ class ReplicaState:
             "last_reload_step": st.get("last_reload_step"),
             "reload_in_progress": st.get("reload_in_progress"),
             "compile_cache_hits": st.get("compile_cache_hits"),
+            "prefix_cache": st.get("prefix_cache"),
         }
 
 
@@ -188,7 +206,8 @@ class FleetRouter:
                  breaker_cooldown_s=2.0, connect_timeout_s=5.0,
                  stream_timeout_s=120.0, clock=time.monotonic,
                  watch_ckpt_root=None, watch_interval_s=1.0,
-                 watch_drain_timeout_s=120.0):
+                 watch_drain_timeout_s=120.0, affinity_bonus=0.5,
+                 affinity_prefix_tokens=32, affinity_map_size=4096):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = [
@@ -205,6 +224,13 @@ class FleetRouter:
         self.connect_timeout_s = float(connect_timeout_s)
         self.stream_timeout_s = float(stream_timeout_s)
         self.clock = clock
+        # cache-affinity placement: prompt-prefix head -> replica index
+        # that last served it (bounded LRU; a prefix-cache hit there is
+        # near-free, so its load score earns a bonus)
+        self.affinity_bonus = float(affinity_bonus)
+        self.affinity_prefix_tokens = int(affinity_prefix_tokens)
+        self.affinity_map_size = int(affinity_map_size)
+        self._affinity = collections.OrderedDict()
         self._lock = threading.Lock()
         # one rolling reload at a time: overlapping walks would drain
         # multiple replicas at once, breaking the at-most-one-out-of-
@@ -319,6 +345,9 @@ class FleetRouter:
             v = status.get(field)
             if v is not None:
                 gauge.set(float(v), replica=idx)
+        hits = (status.get("prefix_cache") or {}).get("hits")
+        if hits is not None:
+            m.replica_prefix_hits.set(float(hits), replica=idx)
 
     def _scrape_all(self):
         # one thread per replica: a few unreachable hosts hanging to
@@ -355,13 +384,41 @@ class FleetRouter:
                 out.append(r)
         return out
 
-    def _pick(self, exclude=()):
+    def _affinity_key(self, parsed):
+        """Prompt-prefix head used for cache-affinity placement (None
+        when the body carries no usable input_ids)."""
+        ids = parsed.get("input_ids") if isinstance(parsed, dict) else None
+        if not isinstance(ids, list) or not ids:
+            return None
+        try:
+            return tuple(int(t) for t in
+                         ids[:self.affinity_prefix_tokens])
+        except (TypeError, ValueError):
+            return None
+
+    def _note_affinity(self, key, index):
+        if key is None or self.affinity_bonus <= 0:
+            return
+        with self._lock:
+            self._affinity[key] = index
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self.affinity_map_size:
+                self._affinity.popitem(last=False)
+
+    def _pick(self, exclude=(), affinity_key=None):
         """Least-loaded eligible replica, or None. Load folds the
         scraped queue depth + active rows (pressure) against free
         pages (capacity), plus the router's own in-flight count so two
         back-to-back requests don't pile onto one replica between
-        scrapes."""
+        scrapes. The replica that last served this prompt-prefix head
+        gets its score divided by ``1 + affinity_bonus`` — a warm
+        prefix cache makes it strictly cheaper there, until real load
+        outweighs the bonus."""
         now = self.clock()
+        affine = None
+        if affinity_key is not None and self.affinity_bonus > 0:
+            with self._lock:
+                affine = self._affinity.get(affinity_key)
         best, best_score = None, None
         for r in self._eligible(now, exclude):
             st = r.status or {}
@@ -369,6 +426,8 @@ class FleetRouter:
                 + float(st.get("active") or 0) + float(r.in_flight)
             capacity = 1.0 + float(st.get("free_pages") or 0)
             score = pressure / capacity
+            if affine == r.index:
+                score /= 1.0 + self.affinity_bonus
             if best_score is None or score < best_score:
                 best, best_score = r, score
         return best
@@ -453,7 +512,7 @@ class FleetRouter:
             return
         stream = bool(parsed.get("stream", True))
         try:
-            self._route(h, body, stream)
+            self._route(h, body, stream, parsed)
         except Exception as e:
             # last-ditch: the client must get a status or a terminal
             # event, never a silently dropped connection
@@ -723,17 +782,21 @@ class FleetRouter:
                 self._watched_step = step
 
     # ------------------------------------------------------------ routing
-    def _route(self, h, body, stream):
+    def _route(self, h, body, stream, parsed=None):
         t_recv = self.clock()
         tried = set()
         saw_saturated = False
         saw_conn_error = False
+        akey = self._affinity_key(parsed or {})
         client = _ClientStream(h, self.metrics)
         while True:
-            r = self._pick(exclude=tried)
+            r = self._pick(exclude=tried, affinity_key=akey)
             if r is None:
                 break
             tried.add(r.index)
+            # recorded at placement, not completion: concurrent
+            # same-prefix requests converge on the warm replica now
+            self._note_affinity(akey, r.index)
             with self._lock:
                 r.in_flight += 1
             try:

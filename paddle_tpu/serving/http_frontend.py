@@ -241,7 +241,22 @@ class ServingFrontend:
         signal a fleet router scrapes, not just a liveness bit: free
         pages (capacity), queue depth + in-flight (pressure), engine
         generation/weights version (routing can pin a version during a
-        rollout), and the draining/accepting flags."""
+        rollout), and the draining/accepting flags.
+
+        Deliberately lock-free (taking the driver lock would queue
+        scrapes behind whole engine steps and age healthy replicas out
+        of the router's rotation under load), so the pool/prefix-cache
+        stats may race a driver-thread mutation mid-iteration — a
+        transient "dict changed size"/KeyError is retried rather than
+        500ing a healthy replica."""
+        for _ in range(5):
+            try:
+                return self._health_snapshot()
+            except (RuntimeError, KeyError):
+                continue
+        return self._health_snapshot()
+
+    def _health_snapshot(self):
         eng = self.engine
         queue_depth = getattr(eng.scheduler, "depth", 0)
         active = getattr(eng, "active_slots", 0)
@@ -278,6 +293,11 @@ class ServingFrontend:
         if page_pool is not None:
             out["page_pool"] = page_pool.stats()
             out["free_pages"] = page_pool.free_pages
+            prefix = getattr(eng, "prefix_cache", None)
+            if prefix is not None:
+                # warm-capacity signal for the fleet router: hit stats
+                # drive the cache-affinity bonus in its load score
+                out["prefix_cache"] = prefix.stats()
         else:
             slab = getattr(eng, "_slab", None)
             if slab is not None:

@@ -102,6 +102,12 @@ class ServingMetrics:
         self.timeouts = Counter(
             "timeouts", prom_name=f"{ns}_timeouts_total",
             help="requests expired past their deadline")
+        self.sheds = Counter(             # labeled by reason
+            "sheds", labelname="reason",
+            prom_name=f"{ns}_sheds_total",
+            help="in-flight requests shed by the engine, by reason "
+                 "(pages_exhausted = a demand-grown decode page claim "
+                 "that eviction could not satisfy)")
         self.tokens_out = Counter(
             "tokens_out", prom_name=f"{ns}_tokens_out_total",
             help="decode tokens emitted")
@@ -150,7 +156,8 @@ class ServingMetrics:
             reg = get_registry()
         reg.register_all([
             self.submitted, self.admitted, self.completed, self.rejected,
-            self.timeouts, self.tokens_out, self.prefill_tokens,
+            self.timeouts, self.sheds, self.tokens_out,
+            self.prefill_tokens,
             self.guard_fires, self.reloads, self.reload_ttft_spike,
             self.ttft, self.itl, self.e2e,
             self.queue_wait, self.queue_depth, self.slot_occupancy,
@@ -170,6 +177,8 @@ class ServingMetrics:
                 "rejected": self.rejected.value,
                 "rejected_by_reason": self.rejected.by_label(),
                 "timeouts": self.timeouts.value,
+                "sheds": self.sheds.value,
+                "sheds_by_reason": self.sheds.by_label(),
                 "tokens_out": self.tokens_out.value,
                 "prefill_tokens": self.prefill_tokens.value,
                 "guard_fires": self.guard_fires.value,

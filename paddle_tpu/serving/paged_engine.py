@@ -24,6 +24,12 @@ recompiles — the slab engine's core discipline carries over):
   page table -> next tokens; attention gathers K/V through the table
   (``models.llama`` paged path; a tuned Pallas paged-attention kernel
   replaces the HBM gather when the tune cache opts one in).
+- **gather-pages** (per bucket, prefix-cache mode): materializes a
+  request's cached-prefix pages as a prefill-layout block so the tail
+  program can attend over them.
+- **chunk-prefill** (per (bucket, tail-bucket) pair, prefix-cache
+  mode): runs ONLY the uncached tail of a prompt at a traced position
+  offset — the warm path's near-zero prefill compute.
 
 Prefill/decode disaggregation: prefill and decode are separate
 compiled units, and ``max_prefills_per_step`` (default 1) bounds how
@@ -33,10 +39,24 @@ bucket's prefill per step instead of stalling them behind the whole
 backlog. Prefilled requests enter the decode batch purely by having
 their pages written and their table row set.
 
-Token streams are exact-equal to ``net.generate`` and the slab engine:
-the default paged path gathers the table and runs the SAME masked-SDPA
-op order over it — extra masked columns contribute exact zeros through
-the fp32 softmax.
+PREFIX CACHING (``prefix_cache=True`` / a ``PrefixCache``): prefill
+pages are published under ``(weights_version, cache_dtype,
+token-prefix hash chain)`` keys at page granularity with refcounts; a
+new request adopts every matching full page BY REFERENCE into its page
+table, prefill runs only on the uncached tail, a recompute boundary
+inside a shared page copy-on-write clones it through the gather ->
+chunk -> adopt pipeline, cold refcount-zero prefixes are LRU-evicted
+under arena pressure, and a weight reload flushes the store. Prefix
+mode also switches decode pages to DEMAND GROWTH (``demand_paging=``
+to control it independently): admission claims only the prompt's
+pages, each decode step claims the next page as a row crosses a page
+boundary, and a growth failure sheds THAT request with reason
+``pages_exhausted`` — never a crash, never another row's pages.
+
+Token streams are exact-equal to ``net.generate`` and the slab engine
+— including warm prefix hits: adopted KV is prefill-provenance content
+for the identical token prefix under identical weights, and the
+chunked tail program is pinned bitwise-equal to the full prefill.
 """
 from __future__ import annotations
 
@@ -49,9 +69,15 @@ import jax.numpy as jnp
 
 from .. import profiler
 from ..models.generation import _select_next, decode_step
-from .engine import ServingEngine, _Seq, _flatten, _unflatten
+from .engine import (
+    ServingEngine,
+    _Seq,
+    _flatten,
+    _unflatten,
+    build_chunk_prefill_body,
+)
 from .paged_pool import PagedKVPool, PagesExhausted
-from .scheduler import RUNNING
+from .scheduler import CANCELLED, REASON_PAGES_EXHAUSTED, RUNNING
 
 
 class PagedServingEngine(ServingEngine):
@@ -65,7 +91,12 @@ class PagedServingEngine(ServingEngine):
     ``num_pages`` (usable pages, garbage page excluded) defaults to
     full-coverage ``max_batch_size * ceil(max_seq_len / page_size)`` —
     pass a smaller arena to trade concurrency headroom for HBM, the
-    whole point of paging."""
+    whole point of paging.
+
+    ``prefix_cache=True`` (or a :class:`~.prefix_cache.PrefixCache`
+    over the same pool) enables copy-on-write prefix page sharing;
+    ``demand_paging`` defaults to the prefix-cache setting and grows
+    decode pages per step instead of claiming them up front."""
 
     def __init__(self, net, *, max_batch_size=8, max_seq_len=256,
                  page_size=16, num_pages=None, cache_dtype=None,
@@ -75,7 +106,8 @@ class PagedServingEngine(ServingEngine):
                  scheduler=None, metrics=None, pool=None, page_pool=None,
                  clock=time.monotonic, recompile_guard_max=None,
                  weights_version=None, prefill_transport=None,
-                 reload_template=None):
+                 reload_template=None, prefix_cache=None,
+                 demand_paging=None):
         ps = int(page_size)
         if ps < 1 or (ps & (ps - 1)):
             raise ValueError(
@@ -95,6 +127,11 @@ class PagedServingEngine(ServingEngine):
         self.page_size = ps
         self._num_pages_arg = num_pages
         self._page_pool_arg = page_pool
+        self._prefix_cache_arg = prefix_cache
+        self._demand_paging = (
+            bool(demand_paging) if demand_paging is not None
+            else prefix_cache not in (None, False)
+        )
         self.max_prefills_per_step = (
             None if max_prefills_per_step is None
             else int(max_prefills_per_step)
@@ -104,10 +141,12 @@ class PagedServingEngine(ServingEngine):
         # ships the prompt to the prefill pool and adopts the returned
         # KV pages; any transfer failure falls back to LOCAL prefill on
         # this engine — disaggregation is an optimization, never a
-        # correctness dependency.
+        # correctness dependency. A prefix-cache hit skips the
+        # transport entirely (the tail chunk is cheaper than the wire).
         self.prefill_transport = prefill_transport
         self.remote_prefills = 0
         self.local_prefills = 0
+        self.chunk_prefills = 0
         self.remote_prefill_fallbacks = 0
         super().__init__(
             net, max_batch_size=max_batch_size, max_seq_len=max_seq_len,
@@ -120,6 +159,15 @@ class PagedServingEngine(ServingEngine):
             weights_version=weights_version,
             reload_template=reload_template,
         )
+        if self.prefix_cache is not None and recompile_guard_max is None:
+            # prefix mode legitimately compiles one gather program per
+            # bucket and one chunk program per (bucket, tail-bucket)
+            # pair — widen the storm bar to the real steady-state
+            # inventory instead of firing on warm-path compiles
+            nb = len(self._warmup_buckets())
+            self.trace_guard.max_compiles = max(
+                self.trace_guard.max_compiles, nb * (nb + 3) // 2 + 2
+            )
 
     # ------------------------------------------------------- KV backend
     def _init_kv_backend(self):
@@ -148,19 +196,52 @@ class PagedServingEngine(ServingEngine):
                 f"max_seq_len {self.max_seq_len}"
             )
         self.page_pool = pp
+        pc = self._prefix_cache_arg
+        if pc is True:
+            from .prefix_cache import PrefixCache
+
+            pc = PrefixCache(pp)
+        elif pc in (None, False):
+            pc = None
+        elif pc.pool is not pp:
+            raise ValueError(
+                "prefix_cache wraps a different PagedKVPool than this "
+                "engine's — pass the same pool to both"
+            )
+        self.prefix_cache = pc
         self.table_width = pp.table_width()
         self._flat = _flatten(pp.alloc_arena_arrays())
         self._tables = np.zeros(
             (self.max_batch_size, self.table_width), np.int32
         )
         self._row_pages = [None] * self.max_batch_size
+        self._row_meta = [None] * self.max_batch_size
         self._free_rows = list(range(self.max_batch_size))[::-1]
+        self._gather_fns = {}   # bucket -> jitted fn
+        self._chunk_fns = {}    # (bucket, tail_bucket) -> jitted fn
 
     def _release_slot(self, slot):
         pages = self._row_pages[slot]
+        meta = self._row_meta[slot]
+        if (pages and meta is not None and self.prefix_cache is not None
+                and not self._closed):
+            # publish-on-finish: the partial prompt-tail page becomes
+            # shareable the moment its owner stops writing it (a later
+            # same-prefix request COW-adopts it instead of re-running
+            # the tail) — prefill-valid slots only, decode KV never
+            prompt, prompt_len = meta
+            r = prompt_len % self.page_size
+            k = prompt_len // self.page_size
+            if r and k < len(pages):
+                self.prefix_cache.publish_partial(
+                    prompt, prompt_len, pages[k], self.weights_version
+                )
         if pages:
             self.page_pool.release(pages)
+        if self.prefix_cache is not None:
+            self.prefix_cache.update_gauges()
         self._row_pages[slot] = None
+        self._row_meta[slot] = None
         self._tables[slot, :] = 0  # free row reads/writes garbage page
         self._free_rows.append(slot)
 
@@ -179,15 +260,75 @@ class PagedServingEngine(ServingEngine):
                 or self.page_pool.pages_for(req.total_tokens)
                 > self.page_pool.num_pages)
 
+    def _pages_at_admission(self, prompt_len, total_tokens):
+        """Pages a request's table needs when admitted: the whole span
+        up front classically; only the prompt's pages under demand
+        growth (decode pages are claimed per step as rows cross page
+        boundaries)."""
+        return self.page_pool.pages_for(
+            prompt_len if self._demand_paging else total_tokens
+        )
+
     def _admission_budget(self):
         """Head must fit BOTH the in-flight token cap and the free
         pages. ``total <= free_pages * page_size`` is exactly
         ``ceil(total / page_size) <= free_pages``, so the token-budget
         gate doubles as the page gate — strict FIFO is preserved (a big
-        head waits, nothing overtakes it)."""
-        base = super()._admission_budget()
+        head waits, nothing overtakes it). In prefix/demand mode the
+        page side moves to :meth:`_admission_fits` (a warm request's
+        real need depends on cache coverage, which a scalar budget
+        cannot express)."""
+        base = ServingEngine._admission_budget(self)
+        if self._demand_paging or self.prefix_cache is not None:
+            return base
         page_budget = self.page_pool.free_pages * self.page_size
         return page_budget if base is None else min(base, page_budget)
+
+    def _admission_fits(self):
+        if self.prefix_cache is None and not self._demand_paging:
+            return None
+
+        def fits(req):
+            n_init = self._pages_at_admission(req.prompt_len,
+                                              req.total_tokens)
+            n_ref = 0
+            ref_pages = ()
+            match, plan = self._prefix_probe(req)
+            if plan is not None:
+                n_ref = plan[0] // self.page_size
+                ref_pages = match.pages[:n_ref]
+            need = n_init - n_ref
+            if need <= self.page_pool.free_pages:
+                return True  # freelist covers it — skip the cache walk
+            if self.prefix_cache is None:
+                return False
+            # the pages this request would ADOPT are excluded: eviction
+            # can never reclaim what admission is about to reference —
+            # counting them would pass a head whose claim then fails
+            return need <= (self.page_pool.free_pages
+                            + self.prefix_cache.evictable_pages(
+                                exclude=ref_pages))
+
+        return fits
+
+    def _prefix_probe(self, req):
+        """One chain walk + chunk plan per request per admission
+        attempt, shared between the fits predicate and ``_admit_one``
+        (same driver thread, nothing mutates the cache between the pop
+        check and the admission that immediately follows it). The
+        result is stashed on the request and consumed by admission;
+        a head that waits re-probes on its next pop attempt."""
+        if self.prefix_cache is None:
+            return None, None
+        m = self.prefix_cache.match(req.input_ids, req.prompt_len,
+                                    self.weights_version)
+        plan = None
+        if m.covered > 0:
+            bucket = self.pool.bucket_for(req.prompt_len)
+            plan = self._chunk_plan(req.prompt_len, bucket, m.covered)
+        out = (m if plan is not None else None, plan)
+        req.__dict__["_prefix_probe_result"] = out
+        return out
 
     def _max_admissions_per_step(self):
         return self.max_prefills_per_step
@@ -238,6 +379,53 @@ class PagedServingEngine(ServingEngine):
         )
         return fn
 
+    def _gather_fn(self, bucket):
+        """Materialize ``bucket / page_size`` arena pages at traced ids
+        as one prefill-layout block — the warm path's cached-prefix
+        context (ids past the cached span -> garbage page 0, whose
+        content sits behind the position mask like any stale slot). The
+        arena is NOT donated: shared pages must survive the gather."""
+        fn = self._gather_fns.get(bucket)
+        if fn is not None:
+            return fn
+        ps = self.page_size
+        n_pages_b = bucket // ps
+
+        def body(flat_arena, src_ids):
+            from ..quantization.kv import gather_block_from_pages
+
+            return [
+                gather_block_from_pages(a, src_ids, n_pages_b, ps)
+                for a in flat_arena
+            ]
+
+        fn = jax.jit(body)
+        self._gather_fns[bucket] = fn
+        self.trace_guard.record_compile(
+            "serving::gather_pages", bucket,
+            origin="serving/paged_engine.py",
+        )
+        return fn
+
+    def _chunk_fn(self, bucket, tail_bucket):
+        """The chunked-prefill program: tail tokens [1, tail_bucket] at
+        a traced position offset over a gathered [1, bucket] block —
+        one program per (bucket, tail-bucket) pair, O(log^2) total."""
+        fn = self._chunk_fns.get((bucket, tail_bucket))
+        if fn is not None:
+            return fn
+        body = build_chunk_prefill_body(self.net, self.do_sample,
+                                        self.top_k, self.top_p)
+        fn = jax.jit(
+            body, donate_argnums=(5,) if self._donate else ()
+        )
+        self._chunk_fns[(bucket, tail_bucket)] = fn
+        self.trace_guard.record_compile(
+            "serving::chunk_prefill", (bucket, tail_bucket),
+            origin="serving/paged_engine.py",
+        )
+        return fn
+
     def _adopt_example_args(self, flat_block, bucket):
         return (
             self._flat, flat_block,
@@ -250,6 +438,58 @@ class PagedServingEngine(ServingEngine):
         sig["num_pages"] = self.page_pool.num_pages
         sig["table_width"] = self.table_width
         return sig
+
+    # --------------------------------------------------- prefix caching
+    def _tail_buckets(self, bucket):
+        """The tail-chunk shape ladder for one prompt bucket: the
+        power-of-two prefill ladder capped at the bucket itself."""
+        out, L = [], int(getattr(self.pool, "min_bucket", 16))
+        while L < bucket:
+            out.append(L)
+            L *= 2
+        out.append(bucket)
+        return out
+
+    def _chunk_plan(self, prompt_len, bucket, covered):
+        """Pick the warm path's (recompute start ``c``, tail bucket):
+        maximize the cached span actually reused, under the hard shape
+        constraint ``c + tail_bucket <= bucket`` (the chunk writes
+        [c, c + tail_bucket) into the block — clamped dynamic slices
+        would silently corrupt positions otherwise) and ``c <=
+        prompt_len - 1`` (the last prompt token is always re-run: its
+        logits produce the first output token). None when no plan
+        reuses anything (degenerate -> cold path)."""
+        best = None
+        for tb in self._tail_buckets(bucket):
+            c = min(int(covered), prompt_len - 1, bucket - tb)
+            if c <= 0 or prompt_len - c > tb:
+                continue
+            if best is None or c > best[0]:
+                best = (c, tb)
+        return best
+
+    def _claim_pages(self, n):
+        """Fresh pages, evicting cold cached prefixes under pressure.
+        Raises :class:`PagesExhausted` only when the freelist AND the
+        reclaimable side of the cache together cannot cover ``n``."""
+        try:
+            return self.page_pool.claim(n)
+        except PagesExhausted:
+            if self.prefix_cache is None:
+                raise
+            need = n - self.page_pool.free_pages
+            self.prefix_cache.evict(need)
+            return self.page_pool.claim(n)
+
+    def _on_weights_swapped(self):
+        # the reload-flush satellite: every cached page was computed
+        # under the weights that just rotated out — a post-swap request
+        # must miss (keys re-root on the new version too, belt and
+        # braces). The swap only applies at a zero-in-flight boundary,
+        # so the cache holds the only reference to every page and the
+        # flush returns them all to the freelist.
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush(reason="weights_reload")
 
     # ---------------------------------------------------------- requests
     def _drop_block(self, blk):
@@ -287,31 +527,87 @@ class PagedServingEngine(ServingEngine):
     def _admit_one(self, handle):
         req = handle.request
         now = self.clock()
+        ps = self.page_size
         bucket = self.pool.bucket_for(req.prompt_len)
-        n_req = self.page_pool.pages_for(req.total_tokens)
+        n_init = self._pages_at_admission(req.prompt_len,
+                                          req.total_tokens)
         # sampling key drawn ONCE so a remote-prefill failure that falls
         # back locally consumes the same key the pure-local path would —
-        # sampled streams stay reproducible either way
+        # sampled streams stay reproducible either way (warm hits
+        # consume it in the chunk program's sampling head)
         key = self._next_key()
-        remote = self._remote_prefill(req, bucket, key)
+        # prefix-cache walk: adopt matching full pages by reference and
+        # recompute only the uncached tail. The fits predicate already
+        # walked the chain for this pop — reuse its stashed probe
+        # instead of matching twice per admission.
+        match = plan = None
+        if self.prefix_cache is not None:
+            probe = req.__dict__.pop("_prefix_probe_result", None)
+            if probe is None:
+                probe = self._prefix_probe(req)
+                req.__dict__.pop("_prefix_probe_result", None)
+            match, plan = probe
+            if match is not None:
+                self.prefix_cache.hits.inc()
+                self.prefix_cache.tokens_saved.inc(plan[0])
+            else:
+                self.prefix_cache.misses.inc()
+        remote = None
         blk = None
-        if remote is None:
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, : req.prompt_len] = req.input_ids
-            blk = self.pool.alloc(req.prompt_len)
-        # the budget gate already sized the claim against free pages;
-        # claim + row pop still guarded so an exception can never
-        # strand pages or a row
-        try:
-            pages = self.page_pool.claim(n_req)
-        except PagesExhausted:
-            self._drop_block(blk)
-            raise
-        row = self._free_rows.pop()
-        try:
-            self._tables[row, :] = 0
-            self._tables[row, :n_req] = pages
+        if match is None:
+            remote = self._remote_prefill(req, bucket, key)
             if remote is None:
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, : req.prompt_len] = req.input_ids
+                blk = self.pool.alloc(req.prompt_len)
+        n_ref = 0 if match is None else plan[0] // ps
+        ref_pages = [] if match is None else match.pages[:n_ref]
+        row = None
+        owned = []
+        try:
+            if n_ref:
+                # reference the shared pages BEFORE any claim: claiming
+                # may evict, and eviction must see these as in-use
+                self.page_pool.incref(ref_pages)
+                owned.extend(ref_pages)
+            fresh = self._claim_pages(n_init - n_ref)
+            owned.extend(fresh)
+            row = self._free_rows.pop()
+            row_pages = ref_pages + fresh
+            self._tables[row, :] = 0
+            self._tables[row, :n_init] = row_pages
+            if match is not None:
+                c, tb = plan
+                L = req.prompt_len - c
+                n_gather = -(-c // ps)
+                src = np.zeros((bucket // ps,), np.int32)
+                src[:n_gather] = match.pages[:n_gather]
+                with profiler.RecordEvent(f"serving::gather_b{bucket}"):
+                    flat_block = self._run(
+                        ("gather", bucket), self._gather_fn(bucket),
+                        self._flat, jnp.asarray(src),
+                    )
+                tail = np.zeros((1, tb), np.int32)
+                tail[0, :L] = req.input_ids[c:]
+                self.chunk_prefills += 1
+                with profiler.RecordEvent(
+                    f"serving::chunk_prefill_b{bucket}_t{tb}"
+                ):
+                    nxt, new_flat = self._run(
+                        ("chunk", bucket, tb),
+                        self._chunk_fn(bucket, tb),
+                        self._params, self._buffers, jnp.asarray(tail),
+                        jnp.int32(L), jnp.int32(c), flat_block,
+                        jnp.float32(self.temperature), key,
+                    )
+                t0 = int(np.asarray(nxt)[0])
+                if c % ps:
+                    # recompute boundary inside a cached page: its
+                    # content was cloned through the gather into a
+                    # fresh page this request owns — the copy-on-write
+                    # (the shared original is never written)
+                    self.prefix_cache.cow_clones.inc()
+            elif remote is None:
                 self.local_prefills += 1
                 with profiler.RecordEvent(f"serving::prefill_b{bucket}"):
                     nxt, new_flat = self._run(
@@ -327,26 +623,41 @@ class PagedServingEngine(ServingEngine):
                 # wire block adopts through the SAME compiled scatter
                 t0, new_flat = remote
             with profiler.RecordEvent(f"serving::adopt_b{bucket}"):
-                # adopt: first min(n_req, bucket/ps) block pages land in
-                # the claim; block pad pages (prompt shorter than the
-                # bucket's page span) scatter to garbage page 0
-                page_ids = np.zeros((bucket // self.page_size,),
-                                    np.int32)
-                k = min(n_req, bucket // self.page_size)
-                page_ids[:k] = pages[:k]
+                # adopt: the request's FRESH pages within the bucket
+                # span land in the claim; shared by-reference pages
+                # (indices < n_ref) and block pad pages scatter to
+                # garbage page 0 — a shared page is never written
+                page_ids = np.zeros((bucket // ps,), np.int32)
+                k1 = min(n_init, bucket // ps)
+                page_ids[n_ref:k1] = row_pages[n_ref:k1]
                 self._flat = self._run(
                     ("adopt", bucket), self._adopt_fn(bucket),
                     self._flat, new_flat, jnp.asarray(page_ids),
                 )
+            if self.prefix_cache is not None:
+                # publish-on-admission: full prompt pages are stable
+                # the moment prefill wrote them (decode writes start at
+                # prompt_len, past every full prompt page) — concurrent
+                # same-prefix requests hit immediately
+                self.prefix_cache.publish(
+                    req.input_ids, req.prompt_len, row_pages,
+                    self.weights_version,
+                )
+                self.prefix_cache.update_gauges()
         except BaseException:
-            self._tables[row, :] = 0
-            self._free_rows.append(row)
-            self.page_pool.release(pages)
+            if row is not None:
+                self._tables[row, :] = 0
+                self._free_rows.append(row)
+            if owned:
+                self.page_pool.release(owned)
             self._drop_block(blk)
             raise
         if blk is not None:
             self.pool.free(blk)
-        self._row_pages[row] = pages
+        self._row_pages[row] = row_pages
+        self._row_meta[row] = (
+            tuple(int(t) for t in req.input_ids), req.prompt_len
+        )
         handle.status = RUNNING
         handle.weights_version = self.weights_version
         handle.admit_time = now
@@ -360,9 +671,40 @@ class PagedServingEngine(ServingEngine):
         self._seqs[row] = _Seq(handle, t0)
         self._append(row, t0)
 
+    # ------------------------------------------------------ decode loop
+    def _grow_pages(self):
+        """Demand growth: before the decode step, any row whose next
+        write position crosses into an unallocated page claims one
+        (evicting cold prefixes if needed). A claim that still fails
+        sheds THAT request with ``pages_exhausted`` — partial tokens
+        kept, terminal event fired, nobody else's pages touched."""
+        ps = self.page_size
+        for i, seq in enumerate(self._seqs):
+            if seq is None:
+                continue
+            pages = self._row_pages[i]
+            while seq.pos // ps >= len(pages):
+                try:
+                    new = self._claim_pages(1)
+                except PagesExhausted:
+                    self.metrics.sheds.inc(label=REASON_PAGES_EXHAUSTED)
+                    self._finish(i, CANCELLED,
+                                 reason=REASON_PAGES_EXHAUSTED)
+                    break
+                self._tables[i, len(pages)] = new[0]
+                pages.append(new[0])
+
+    def _decode_once(self):
+        if self._demand_paging:
+            self._grow_pages()
+        super()._decode_once()
+
     def close(self):
         super().close()
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush(reason="engine_closed")
         if self.prefill_transport is not None:
             self.prefill_transport.close()
         self._tables = None
         self._row_pages = [None] * self.max_batch_size
+        self._row_meta = [None] * self.max_batch_size

@@ -73,12 +73,23 @@ class PagedKVPool:
         )
         # ids 1..num_pages are claimable; 0 is the garbage page
         self._free = list(range(1, self.num_pages + 1))[::-1]
-        self._claimed = set()
+        # page id -> refcount. A fresh claim holds one reference; the
+        # prefix cache and every request adopting a shared page hold one
+        # more each (incref). release() decrements; the page returns to
+        # the freelist only when the LAST reference drops — copy-on-
+        # write page sharing without a separate ownership ledger.
+        self._refs = {}
         # counters for metrics/introspection
         self.claims = 0
         self.releases = 0
+        self.increfs = 0
         self.exhausted_events = 0
         self.peak_in_use = 0
+        # incremental sum of max(0, refcount - 2) over all pages: every
+        # reference past (cache + first holder) is a private page copy
+        # sharing avoided — the shared-HBM-saved gauge reads this O(1)
+        # instead of walking the cache per request
+        self._extra_shared_refs = 0
 
     # --------------------------------------------------------- geometry
     def pages_for(self, total_tokens):
@@ -121,11 +132,29 @@ class PagedKVPool:
 
     @property
     def pages_in_use(self):
-        return len(self._claimed)
+        return len(self._refs)
+
+    @property
+    def shared_pages(self):
+        """Pages held by more than one reference (a cached prefix page
+        adopted by at least one live request, or the cache plus its
+        publisher)."""
+        return sum(1 for v in self._refs.values() if v > 1)
+
+    @property
+    def shared_saved_pages(self):
+        """Private page copies avoided by sharing RIGHT NOW: references
+        past (cache + first holder) per page, maintained incrementally
+        — O(1) to read from any thread."""
+        return self._extra_shared_refs
+
+    def refcount(self, page_id):
+        return self._refs.get(int(page_id), 0)
 
     def claim(self, n):
-        """``n`` page ids, or raise :class:`PagesExhausted` (nothing is
-        claimed on failure — no partial claims to unwind)."""
+        """``n`` fresh page ids (refcount 1 each), or raise
+        :class:`PagesExhausted` (nothing is claimed on failure — no
+        partial claims to unwind)."""
         n = int(n)
         if n < 1:
             raise ValueError(f"claim of {n} pages")
@@ -133,20 +162,43 @@ class PagedKVPool:
             self.exhausted_events += 1
             raise PagesExhausted(
                 f"need {n} pages, {len(self._free)} free "
-                f"({len(self._claimed)} in use)"
+                f"({len(self._refs)} in use)"
             )
         ids = [self._free.pop() for _ in range(n)]
-        self._claimed.update(ids)
+        for i in ids:
+            self._refs[i] = 1
         self.claims += n
-        self.peak_in_use = max(self.peak_in_use, len(self._claimed))
+        self.peak_in_use = max(self.peak_in_use, len(self._refs))
         return ids
 
-    def release(self, ids):
-        """Release a claim. The WHOLE id list is validated before the
-        freelist is touched — a raise means nothing was released, so a
-        caller may safely treat the claim as still held."""
+    def incref(self, ids):
+        """Adopt already-claimed pages by reference (prefix sharing:
+        the cache's hold on a published page, a request's hold on an
+        adopted one). Validated all-or-nothing like :meth:`release`."""
         ids = [int(i) for i in ids]
-        bad = [i for i in ids if i not in self._claimed]
+        bad = [i for i in ids if i not in self._refs]
+        if bad:
+            raise ValueError(
+                f"page(s) {bad} not claimed — cannot share an "
+                f"unclaimed page"
+            )
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate page ids in one incref: {ids}")
+        for i in ids:
+            if self._refs[i] >= 2:
+                self._extra_shared_refs += 1
+            self._refs[i] += 1
+        self.increfs += len(ids)
+
+    def release(self, ids):
+        """Drop one reference per id. The WHOLE id list is validated
+        before anything is touched — a raise means nothing was
+        released, so a caller may safely treat the claim as still held.
+        A page returns to the freelist only when its LAST reference
+        drops (``releases`` counts freelist returns, so a fully drained
+        pool always reads ``claims == releases`` — the zero-leak pin)."""
+        ids = [int(i) for i in ids]
+        bad = [i for i in ids if i not in self._refs]
         if bad:
             raise ValueError(
                 f"page(s) {bad} not claimed (double release or foreign "
@@ -155,9 +207,13 @@ class PagedKVPool:
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate page ids in one release: {ids}")
         for i in ids:
-            self._claimed.remove(i)
-            self._free.append(i)
-            self.releases += 1
+            if self._refs[i] >= 3:
+                self._extra_shared_refs -= 1
+            self._refs[i] -= 1
+            if self._refs[i] == 0:
+                del self._refs[i]
+                self._free.append(i)
+                self.releases += 1
 
     # ------------------------------------------------------- accounting
     def page_bytes(self):
@@ -193,7 +249,9 @@ class PagedKVPool:
             "table_width": self.table_width(),
             "free_pages": self.free_pages,
             "pages_in_use": self.pages_in_use,
+            "shared_pages": self.shared_pages,
             "peak_pages_in_use": self.peak_in_use,
+            "increfs": self.increfs,
             "page_bytes": self.page_bytes(),
             "arena_bytes": self.arena_bytes(),
             "claims": self.claims,

@@ -1,0 +1,407 @@
+"""Hash-consed prefix page store — copy-on-write KV sharing for serving.
+
+At production traffic most requests share a long prefix (system prompt,
+few-shot header, RAG template); without this module every request
+re-prefills that prefix and claims all of its pages privately. The
+prefix cache layers content-addressed sharing on the
+:class:`~.paged_pool.PagedKVPool` page arena:
+
+- **Keys are hash chains at page granularity.** A published page is
+  keyed by ``(parent_key, its page_size tokens)``, with the chain
+  rooted at ``(weights_version, cache_dtype)`` — structurally collision
+  -free (keys are the token tuples themselves, not digests), and a
+  checkpoint rotation re-roots the whole keyspace so stale-weights KV
+  can never match (the engine additionally flushes on swap).
+- **Adoption is by reference.** A new request walks the chain and
+  adopts every matching FULL page into its page table with a refcount
+  (``pool.incref``); prefill then runs only on the uncached tail
+  (``models.generation.prefill(pos=...)`` — the chunked prefill,
+  tier-1-pinned bitwise-equal to the full-prompt program).
+- **Copy-on-write.** When the recompute boundary lands inside a cached
+  page (a divergent tail mid-page, or a fully-cached prompt whose last
+  token must be re-run to produce logits), the shared page is CLONED
+  through the gather -> chunk-prefill -> adopt pipeline into a fresh
+  page the request owns; the shared original is never written
+  (``cow_clones`` counts these).
+- **Eviction is leaf-first LRU.** Only pages whose sole reference is
+  the cache's own (refcount 1) are evictable, and only entries whose
+  cached descendants are themselves reclaimable — evicting a middle
+  page would orphan its (still resident) children. Triggered by the
+  engine under arena pressure; every reclaimed page counts.
+
+Exactness is the contract, not a trade: cached KV for position ``p`` is
+a pure function of ``tokens[0..p]`` under fixed weights, and only
+prefill-provenance content is ever published (full prompt pages at
+admission; the partial prompt-tail page at request finish with its
+prefill-written length recorded) — decode-written KV is never adopted,
+so a warm request's token stream is pinned exact-equal to the cold path
+and to ``net.generate`` (bf16 AND int8 arenas).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+
+
+class PrefixEntry:
+    """One cached page: its chain key, the arena page holding its KV,
+    the tokens it covers, and how many leading slots carry
+    prefill-provenance content (``valid_len < page_size`` for the
+    partial prompt-tail page published at finish)."""
+
+    __slots__ = ("key", "parent", "page", "tokens", "valid_len",
+                 "last_hit")
+
+    def __init__(self, key, parent, page, tokens, valid_len, tick):
+        self.key = key
+        self.parent = parent
+        self.page = int(page)
+        self.tokens = tuple(int(t) for t in tokens)
+        self.valid_len = int(valid_len)
+        self.last_hit = tick
+
+    @property
+    def full(self):
+        return self.valid_len == len(self.tokens)
+
+    def __repr__(self):
+        return (f"PrefixEntry(page={self.page}, "
+                f"tokens={len(self.tokens)}, valid={self.valid_len})")
+
+
+class PrefixMatch:
+    """Result of one chain walk: the full-page entries matched in
+    order, an optional partial-tail entry covering the rest of the
+    prompt, and the covered token count."""
+
+    __slots__ = ("entries", "tail", "covered")
+
+    def __init__(self, entries, tail, covered):
+        self.entries = entries
+        self.tail = tail
+        self.covered = int(covered)
+
+    @property
+    def pages(self):
+        """Matched arena page ids, chain order (tail last when hit)."""
+        out = [e.page for e in self.entries]
+        if self.tail is not None:
+            out.append(self.tail.page)
+        return out
+
+
+class PrefixCache:
+    """Content-addressed page store over one :class:`PagedKVPool`.
+
+    The cache holds ONE pool reference per published page; requests
+    adopting a page hold their own (the engine increfs at admission and
+    releases at finish). A page is evictable only while the cache's
+    reference is the last one. All methods are driver-thread-only, like
+    the engine that owns it."""
+
+    def __init__(self, pool, *, registry=None,
+                 namespace="paddle_serving"):
+        self.pool = pool
+        self.page_size = int(pool.page_size)
+        self._entries = {}    # key -> PrefixEntry
+        self._children = {}   # parent key -> set of child keys
+        self._tick = itertools.count()
+        self.flushes = 0
+        ns = namespace
+        # per-INSTANCE instruments with replace-on-register, like
+        # ServingMetrics: the newest cache owns the exported series and
+        # each engine's stats()/healthz report ITS OWN traffic, not
+        # process-lifetime totals across rebuilt engines
+        from ..observability import Gauge
+        from .metrics import Counter
+
+        self.hits = Counter(
+            "prefix_hits", prom_name=f"{ns}_prefix_hits_total",
+            help="admissions that adopted at least one cached prefix "
+                 "page")
+        self.misses = Counter(
+            "prefix_misses", prom_name=f"{ns}_prefix_misses_total",
+            help="admissions that found no usable cached prefix")
+        self.evictions = Counter(
+            "prefix_evictions",
+            prom_name=f"{ns}_prefix_evictions_total",
+            help="cached prefix pages reclaimed under arena pressure")
+        self.cow_clones = Counter(
+            "prefix_cow_clones",
+            prom_name=f"{ns}_prefix_cow_clones_total",
+            help="shared pages copy-on-write cloned for a divergent "
+                 "tail")
+        self.tokens_saved = Counter(
+            "prefix_tokens_saved",
+            prom_name=f"{ns}_prefix_tokens_saved_total",
+            help="prompt tokens NOT re-prefilled thanks to cache hits")
+        self.hbm_saved = Gauge(
+            "prefix_shared_hbm_saved",
+            prom_name=f"{ns}_prefix_shared_hbm_saved_bytes",
+            help="arena bytes saved by page sharing: pages that would "
+                 "be private copies without the prefix cache")
+        if registry is None:
+            from ..observability import get_registry
+
+            registry = get_registry()
+        registry.register_all([
+            self.hits, self.misses, self.evictions, self.cow_clones,
+            self.tokens_saved, self.hbm_saved,
+        ])
+
+    # ---------------------------------------------------------- keying
+    def root_key(self, weights_version):
+        return ("prefix-root", str(weights_version),
+                str(self.pool.dtype))
+
+    # --------------------------------------------------------- matching
+    def match(self, tokens, prompt_len, weights_version):
+        """Walk the chain for ``tokens[:prompt_len]``. Full pages match
+        by exact chain key; a partial tail matches when one cached
+        child covers the WHOLE remaining prompt within its
+        prefill-valid span. Touches matched entries for LRU. Does NOT
+        count hit/miss — the engine records the per-request outcome
+        once it knows whether the match was usable."""
+        ps = self.page_size
+        prompt_len = int(prompt_len)
+        key = self.root_key(weights_version)
+        entries = []
+        k = 0
+        tick = next(self._tick)
+        while (k + 1) * ps <= prompt_len:
+            child = self._entries.get(
+                (key, tuple(int(t) for t in tokens[k * ps:(k + 1) * ps]))
+            )
+            if child is None or not child.full:
+                break
+            child.last_hit = tick
+            entries.append(child)
+            key = child.key
+            k += 1
+        tail = None
+        r = prompt_len - k * ps
+        if 0 < r < ps:
+            rest = tuple(int(t) for t in tokens[k * ps:prompt_len])
+            for ck in self._children.get(key, ()):
+                e = self._entries.get(ck)
+                if e is None or e.valid_len < r:
+                    continue
+                if e.tokens[:r] == rest:
+                    e.last_hit = tick
+                    tail = e
+                    break
+        covered = k * ps + (r if tail is not None else 0)
+        return PrefixMatch(entries, tail, covered)
+
+    # -------------------------------------------------------- publishing
+    def _add(self, key, parent, page, tokens, valid_len):
+        e = PrefixEntry(key, parent, page, tokens, valid_len,
+                        next(self._tick))
+        self.pool.incref([page])
+        self._entries[key] = e
+        self._children.setdefault(parent, set()).add(key)
+        return e
+
+    def publish(self, tokens, prompt_len, page_ids, weights_version):
+        """Publish every FULL page of ``tokens[:prompt_len]`` whose
+        chain position is not already cached, using the request's own
+        ``page_ids`` (chain order). The cache takes one reference per
+        newly published page; existing entries win (the earlier
+        publisher's page stays shared). Returns the number published."""
+        ps = self.page_size
+        prompt_len = int(prompt_len)
+        key = self.root_key(weights_version)
+        published = 0
+        k = 0
+        while (k + 1) * ps <= prompt_len and k < len(page_ids):
+            toks = tuple(int(t) for t in tokens[k * ps:(k + 1) * ps])
+            child_key = (key, toks)
+            child = self._entries.get(child_key)
+            if child is None:
+                child = self._add(child_key, key, page_ids[k], toks, ps)
+                published += 1
+            key = child.key
+            k += 1
+        if published:
+            self.update_gauges()
+        return published
+
+    def publish_partial(self, tokens, prompt_len, page_id,
+                        weights_version):
+        """Publish the partial prompt-tail page (prefill-valid content
+        only — ``prompt_len % page_size`` leading slots). Called at
+        request FINISH, when the owner can no longer write the page, so
+        later same-prefix requests can COW-adopt the whole prompt.
+        Dedups by content; a longer or full entry always wins."""
+        ps = self.page_size
+        prompt_len = int(prompt_len)
+        r = prompt_len % ps
+        if r == 0:
+            return False
+        k = prompt_len // ps
+        key = self.root_key(weights_version)
+        for i in range(k):
+            toks = tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+            child = self._entries.get((key, toks))
+            if child is None or not child.full:
+                return False  # chain below is not cached; tail useless
+            key = child.key
+        rest = tuple(int(t) for t in tokens[k * ps:prompt_len])
+        for ck in self._children.get(key, ()):
+            e = self._entries.get(ck)
+            if e is not None and e.valid_len >= r \
+                    and e.tokens[:r] == rest:
+                return False  # an equal-or-better tail already cached
+        self._add((key, rest), key, page_id, rest, r)
+        self.update_gauges()
+        return True
+
+    # ---------------------------------------------------------- eviction
+    def _reclaimable(self, exclude=()):
+        """Entries whose page only the cache still references AND whose
+        cached descendants are all themselves reclaimable (evicting a
+        middle page would orphan still-resident children). ``exclude``
+        pages are treated as pinned — the admission gate passes the
+        pages the request itself is about to adopt, which eviction
+        could never actually reclaim. Iterative post-order walk: chains
+        run one entry per page of the longest cached prompt, far past
+        any comfortable recursion depth."""
+        exclude = set(exclude)
+        out = {}
+        for root in self._entries:
+            if root in out:
+                continue
+            stack = [(root, False)]
+            while stack:
+                key, ready = stack.pop()
+                if key in out:
+                    continue
+                kids = [ck for ck in self._children.get(key, ())
+                        if ck in self._entries]
+                if not ready:
+                    stack.append((key, True))
+                    stack.extend((ck, False) for ck in kids
+                                 if ck not in out)
+                    continue
+                e = self._entries[key]
+                out[key] = (
+                    self.pool.refcount(e.page) == 1
+                    and e.page not in exclude
+                    and all(out.get(ck, False) for ck in kids)
+                )
+        return out
+
+    def evictable_pages(self, exclude=()):
+        """How many cached pages an eviction pass could reclaim right
+        now — the engine folds this into its admission feasibility
+        check (free + evictable is the true claimable capacity).
+        ``exclude``: pages the caller intends to ADOPT, which must not
+        count as reclaimable headroom."""
+        return sum(
+            1 for v in self._reclaimable(exclude).values() if v
+        )
+
+    def _drop(self, entry):
+        self._entries.pop(entry.key, None)
+        kids = self._children.get(entry.parent)
+        if kids is not None:
+            kids.discard(entry.key)
+            if not kids:
+                self._children.pop(entry.parent, None)
+        self._children.pop(entry.key, None)
+        self.pool.release([entry.page])
+
+    def evict(self, n_pages):
+        """Reclaim up to ``n_pages`` cold pages, leaf-first in LRU
+        order. Only refcount-1 pages are touched — a page some request
+        still decodes over is never pulled out from under it. Returns
+        the number of pages actually freed.
+
+        Reclaimability is computed ONCE per pass (dropping a leaf can
+        only turn its parent into a new leaf, never change any entry's
+        verdict — a parent's verdict already required its whole subtree
+        reclaimable), then victims pop off a last-hit heap with parents
+        pushed as their cached-child count hits zero: O((n + k) log n)
+        per pass instead of a full leaf rescan per freed page."""
+        ok = self._reclaimable()
+        child_count = {
+            key: sum(1 for ck in self._children.get(key, ())
+                     if ck in self._entries)
+            for key, good in ok.items() if good
+        }
+        # unique tiebreaker: matched siblings share one LRU tick, and
+        # the nested-tuple keys do not order (str vs int)
+        tie = itertools.count()
+        heap = [
+            (self._entries[key].last_hit, next(tie), key)
+            for key, n in child_count.items() if n == 0
+        ]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < n_pages and heap:
+            _, _, key = heapq.heappop(heap)
+            victim = self._entries.get(key)
+            if victim is None:
+                continue
+            parent = victim.parent
+            self._drop(victim)
+            freed += 1
+            self.evictions.inc()
+            if parent in child_count:
+                child_count[parent] -= 1
+                if child_count[parent] == 0:
+                    heapq.heappush(
+                        heap,
+                        (self._entries[parent].last_hit, next(tie),
+                         parent),
+                    )
+        if freed:
+            self.update_gauges()
+        return freed
+
+    def flush(self, reason="flush"):
+        """Drop EVERY entry and release the cache's page references —
+        the weight-swap seam (post-reload requests must never adopt
+        pages computed under old weights) and part of engine close."""
+        n = len(self._entries)
+        for e in list(self._entries.values()):
+            self.pool.release([e.page])
+        self._entries.clear()
+        self._children.clear()
+        if n:
+            self.flushes += 1
+        self.update_gauges()
+        return n
+
+    # -------------------------------------------------------- accounting
+    @property
+    def cached_pages(self):
+        return len(self._entries)
+
+    def hbm_saved_bytes(self):
+        """Bytes the sharing saves RIGHT NOW: each reference beyond
+        (cache + first holder) on a cached page is a private page copy
+        a cacheless engine would be holding instead. Only cached pages
+        ever carry more than one reference, so the pool's incremental
+        over-2 counter IS this quantity — O(1), called per admission
+        and per finish on the driver thread."""
+        return self.pool.shared_saved_pages * self.pool.page_bytes()
+
+    def update_gauges(self):
+        self.hbm_saved.set(float(self.hbm_saved_bytes()))
+
+    def stats(self):
+        # scrape-path snapshot: every field is O(1) — the reclaimable
+        # walk (evictable_pages) stays in the admission path that
+        # actually needs it, not in every router /healthz poll
+        return {
+            "entries": len(self._entries),
+            "cached_pages": self.cached_pages,
+            "hits": int(self.hits.value),
+            "misses": int(self.misses.value),
+            "evictions": int(self.evictions.value),
+            "cow_clones": int(self.cow_clones.value),
+            "tokens_saved": int(self.tokens_saved.value),
+            "hbm_saved_bytes": int(self.hbm_saved_bytes()),
+            "flushes": self.flushes,
+        }

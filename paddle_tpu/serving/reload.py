@@ -27,7 +27,12 @@ enforces on restore:
    version (stamped on its handle at admission), and the attached
    prefill transport's ``expected_weights_version`` moves with the
    swap so the worker's version-skew refusal keeps disaggregation
-   exact during the rotation window.
+   exact during the rotation window. The same boundary fires the
+   engine's ``_on_weights_swapped`` hook: the paged engine FLUSHES its
+   prefix cache there (every cached page holds KV computed under the
+   outgoing weights — and the store's keys re-root on the new
+   ``weights_version`` as a second line of defense), so a post-swap
+   request can never adopt stale-weights pages.
 
 Steps 1–3 (``prepare``) are pure and run OFF the engine's step loop —
 an HTTP handler thread does the disk reads and quantization while the

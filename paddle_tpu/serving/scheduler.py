@@ -24,6 +24,11 @@ REASON_TOO_LONG = "too_long"
 REASON_SHAPE_MISMATCH = "shape_mismatch"
 REASON_TIMEOUT = "timeout"
 REASON_ENGINE_CLOSED = "engine_closed"
+# demand-grown paged decode: a mid-decode page claim that neither the
+# freelist nor prefix-cache eviction could satisfy sheds the request
+# with this reason (partial tokens kept, terminal event fired) — an
+# overcommitted arena degrades one request, never crashes the engine
+REASON_PAGES_EXHAUSTED = "pages_exhausted"
 
 # request lifecycle states
 QUEUED = "QUEUED"
@@ -235,11 +240,16 @@ class Scheduler:
             heapq.heapify(self._heap)
         return expired
 
-    def pop_next(self, token_budget=None):
+    def pop_next(self, token_budget=None, fits=None):
         """The next admissible request, or None. Strict priority-FIFO:
         if the head does not fit ``token_budget`` (sum of prompt +
         max_new tokens the engine may still take in flight), nothing is
-        admitted this call. Expired heads are failed and skipped."""
+        admitted this call. ``fits`` is an optional per-request
+        feasibility predicate with the same no-skip discipline (the
+        prefix-caching engine's page-need check, which depends on cache
+        state a scalar budget cannot express) — a head failing it is
+        delayed, never overtaken. Expired heads are failed and
+        skipped."""
         while self._heap:
             neg_pri, seq, handle = self._heap[0]
             dl = self.deadline_of(handle)
@@ -252,6 +262,8 @@ class Scheduler:
                 token_budget is not None
                 and handle.request.total_tokens > token_budget
             ):
+                return None
+            if fits is not None and not fits(handle.request):
                 return None
             heapq.heappop(self._heap)
             return handle

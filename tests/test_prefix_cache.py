@@ -1,0 +1,575 @@
+"""Prefix cache subsystem — COW page sharing, demand growth, eviction.
+
+The non-negotiable here is EXACTNESS: a warm-prefix request adopts
+pages some other request's prefill computed, recomputes only its
+uncached tail, and its token stream must still be bitwise-equal to the
+cold path and to ``net.generate`` — bf16 AND int8 arenas, including a
+divergence that lands exactly on a page boundary (the COW case). The
+accounting contract rides along: refcounted sharing must end every
+churn pattern (finish / cancel / deadline / COW / eviction / reload
+flush) at zero leaked pages and zero refcount drift.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (
+    PagedKVPool,
+    PagedServingEngine,
+    PagesExhausted,
+    PrefixCache,
+    REASON_PAGES_EXHAUSTED,
+    ServingFrontend,
+)
+
+RNG = np.random.RandomState(13)
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _gen(net, prompt, max_new, cache_dtype="bfloat16"):
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray(prompt)), max_new_tokens=max_new,
+        cache_dtype=cache_dtype,
+    ).numpy())[0]
+    return out
+
+
+def _assert_drained(eng):
+    """Zero leaked pages and zero refcount drift: after close every
+    page went back exactly once (claims == releases) and nothing holds
+    a reference."""
+    st = eng.page_pool.stats()
+    assert st["pages_in_use"] == 0, st
+    assert st["claims"] == st["releases"], st
+    assert eng.page_pool._refs == {}
+
+
+# ------------------------------------------------------------ pool refcounts
+def test_pool_refcount_share_and_release(net):
+    pool = PagedKVPool(net.config, page_size=8, num_pages=6,
+                       max_seq_len=48)
+    a = pool.claim(2)
+    pool.incref([a[0]])
+    assert pool.refcount(a[0]) == 2 and pool.refcount(a[1]) == 1
+    assert pool.shared_pages == 1
+    pool.release(a)              # drops one ref each
+    assert pool.pages_in_use == 1          # a[0] survives, shared
+    assert pool.free_pages == 5
+    pool.release([a[0]])         # last ref -> freelist
+    assert pool.pages_in_use == 0
+    st = pool.stats()
+    assert st["claims"] == st["releases"] == 2
+    assert st["increfs"] == 1
+    with pytest.raises(ValueError, match="not claimed"):
+        pool.release([a[0]])
+    with pytest.raises(ValueError, match="not claimed"):
+        pool.incref([a[1]])
+
+
+# ---------------------------------------------------------- cache unit tests
+def test_prefix_cache_match_publish_evict(net):
+    pool = PagedKVPool(net.config, page_size=8, num_pages=8,
+                       max_seq_len=64)
+    cache = PrefixCache(pool)
+    toks = list(range(20))
+    pages = pool.claim(3)
+    ev0 = int(cache.evictions.value)
+    assert cache.publish(toks, 20, pages, "v0") == 2  # 2 full pages
+    assert pool.refcount(pages[0]) == 2  # owner + cache
+    m = cache.match(toks, 20, "v0")
+    assert [e.page for e in m.entries] == pages[:2]
+    assert m.covered == 16 and m.tail is None
+    # partial tail published at finish -> whole prompt covered
+    assert cache.publish_partial(toks, 20, pages[2], "v0")
+    m = cache.match(toks, 20, "v0")
+    assert m.covered == 20 and m.tail is not None
+    assert m.pages == pages
+    # a shorter same-prefix prompt partial-hits the SAME tail page
+    m2 = cache.match(toks[:18], 18, "v0")
+    assert m2.covered == 18 and m2.tail.page == pages[2]
+    # a divergent tail misses the partial but keeps the full pages
+    div = toks[:17] + [63, 62, 61]
+    m3 = cache.match(div, 20, "v0")
+    assert m3.covered == 16 and m3.tail is None
+    # another weights version sees nothing
+    assert cache.match(toks, 20, "v1").covered == 0
+    # eviction refuses pages a request still references
+    assert cache.evictable_pages() == 0  # owner still holds every page
+    pool.release(pages)  # owner done; cache refs remain
+    assert pool.pages_in_use == 3
+    assert cache.evictable_pages() == 3
+    # leaf-first LRU: the tail (and deepest page) go before the root
+    freed = cache.evict(1)
+    assert freed == 1
+    assert cache.match(toks, 20, "v0").covered == 16  # tail evicted 1st
+    assert int(cache.evictions.value) - ev0 == 1
+    cache.flush()
+    assert pool.pages_in_use == 0
+    assert cache.cached_pages == 0
+
+
+def test_prefix_cache_lru_order(net):
+    pool = PagedKVPool(net.config, page_size=8, num_pages=8,
+                       max_seq_len=64)
+    cache = PrefixCache(pool)
+    a = pool.claim(1)
+    b = pool.claim(1)
+    cache.publish(list(range(8)), 8, a, "v0")
+    cache.publish(list(range(8, 16)), 8, b, "v0")
+    pool.release(a + b)
+    cache.match(list(range(8)), 8, "v0")  # touch a -> b is colder
+    cache.evict(1)
+    assert cache.match(list(range(8)), 8, "v0").covered == 8
+    assert cache.match(list(range(8, 16)), 8, "v0").covered == 0
+
+
+# -------------------------------------------------- chunked prefill primitive
+def test_chunked_prefill_bitwise_equals_full(net):
+    """The warm path's compute primitive: prefill(pos=c) over a block
+    whose [0, c) slots came from a prior prefill must reproduce the
+    full-prompt prefill bitwise — logits row AND the KV it writes."""
+    import jax
+
+    from paddle_tpu.models.generation import alloc_kv_caches, prefill
+
+    params = {k: p.value for k, p in net.named_parameters()}
+    buffers = {k: b.value for k, b in net.named_buffers()}
+
+    def full_body(pp, bb, ids, n, caches):
+        net.load_functional_state(pp, bb)
+        net.eval()
+        return prefill(net, ids, caches, length=n)
+
+    def chunk_body(pp, bb, ids, n, pos, caches):
+        net.load_functional_state(pp, bb)
+        net.eval()
+        return prefill(net, ids, caches, length=n, pos=pos)
+
+    ids = RNG.randint(0, 64, (28,)).astype(np.int32)
+    try:
+        for dtype in ("bfloat16", "int8"):
+            full = np.zeros((1, 32), np.int32)
+            full[0, :28] = ids
+            caches = alloc_kv_caches(net.config, 1, 32, dtype)
+            lf, cf = jax.jit(full_body)(
+                params, buffers, jnp.asarray(full), jnp.int32(28),
+                caches,
+            )
+            # every pair obeys the plan's hard constraint
+            # c + tail_bucket <= bucket — past it, dynamic_update_slice
+            # CLAMPS the write start and corrupts cached positions,
+            # which is why _chunk_plan never emits such a pair (pinned
+            # below in test_chunk_plan_never_overflows_the_bucket)
+            for c, tb in ((16, 16), (23, 8), (24, 8)):
+                tail = np.zeros((1, tb), np.int32)
+                tail[0, : 28 - c] = ids[c:]
+                blk = alloc_kv_caches(net.config, 1, 32, dtype)
+                # copy [0, c) from the published caches (the gather)
+                blk2 = []
+                for (ks, vs), (kb, vb) in zip(cf, blk):
+                    if dtype == "int8":
+                        from paddle_tpu.quantization.kv import QuantizedKV
+
+                        blk2.append((
+                            QuantizedKV(
+                                kb.q.at[:, :c].set(ks.q[:, :c]),
+                                kb.scale.at[:, :c].set(ks.scale[:, :c]),
+                            ),
+                            QuantizedKV(
+                                vb.q.at[:, :c].set(vs.q[:, :c]),
+                                vb.scale.at[:, :c].set(vs.scale[:, :c]),
+                            ),
+                        ))
+                    else:
+                        blk2.append((kb.at[:, :c].set(ks[:, :c]),
+                                     vb.at[:, :c].set(vs[:, :c])))
+                lc, _ = jax.jit(chunk_body)(
+                    params, buffers, jnp.asarray(tail),
+                    jnp.int32(28 - c), jnp.int32(c), blk2,
+                )
+                np.testing.assert_array_equal(np.asarray(lf),
+                                              np.asarray(lc))
+    finally:
+        # tracing swapped tracers into the Layers; restore for later
+        # tests sharing the module-scoped net
+        net.load_functional_state(params, buffers)
+        net.eval()
+
+
+def test_chunk_plan_never_overflows_the_bucket(net):
+    """The plan invariant that keeps chunked prefill exact: the chunk
+    writes [c, c + tail_bucket) into a [bucket] block, and a start past
+    ``bucket - tail_bucket`` would make dynamic_update_slice CLAMP the
+    write into cached positions. Every emitted plan obeys it, the
+    recompute start never reaches the full prompt, and maximum
+    coverage is reused within the constraint."""
+    eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             prefix_cache=True)
+    try:
+        for prompt_len in range(2, 57):
+            bucket = eng.pool.bucket_for(prompt_len)
+            for covered in range(1, prompt_len + 1):
+                plan = eng._chunk_plan(prompt_len, bucket, covered)
+                if plan is None:
+                    continue
+                c, tb = plan
+                assert 0 < c <= prompt_len - 1
+                assert c + tb <= bucket, (prompt_len, covered, plan)
+                assert prompt_len - c <= tb
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------------- warm-path exactness
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_warm_streams_exact_vs_cold_and_generate(net, dtype):
+    """The tentpole pin: warm-prefix streams (full hits, partial-tail
+    COW hits, divergence exactly at a page boundary, identical full
+    reuse) are bitwise-equal to a cold no-cache engine AND to
+    net.generate — bf16 and int8 arenas."""
+    prefix = RNG.randint(0, 64, (20,))
+    cases = [
+        np.concatenate([prefix, RNG.randint(0, 64, (4,))])[None, :],
+        np.concatenate([prefix, RNG.randint(0, 64, (4,))])[None, :],
+        prefix[:16][None, :],   # page-aligned prompt: boundary COW
+        np.concatenate([prefix, RNG.randint(0, 64, (4,))])[None, :],
+    ]
+    warm = PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
+                              min_bucket=8, page_size=8,
+                              cache_dtype=dtype, prefix_cache=True)
+    cold = PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
+                              min_bucket=8, page_size=8,
+                              cache_dtype=dtype)
+    hits0 = int(warm.prefix_cache.hits.value)
+    cow0 = int(warm.prefix_cache.cow_clones.value)
+    # seed: first submission publishes; drain so finish publishes the
+    # partial tail page too
+    seed = warm.submit(cases[0], 6)
+    warm.run_until_idle()
+    assert seed.status == "DONE"
+    hw = [warm.submit(p, 6) for p in cases]
+    warm.run_until_idle()
+    hc = [cold.submit(p, 6) for p in cases]
+    cold.run_until_idle()
+    for h_w, h_c, p in zip(hw, hc, cases):
+        assert h_w.status == "DONE" and h_c.status == "DONE"
+        want = _gen(net, p, 6, dtype)
+        np.testing.assert_array_equal(h_w.output_ids, want)
+        np.testing.assert_array_equal(h_c.output_ids, want)
+    st = warm.prefix_cache.stats()
+    assert int(warm.prefix_cache.hits.value) - hits0 >= 4
+    # the identical fully-cached prompt re-runs ONLY its last token,
+    # which lands INSIDE the last cached page -> copy-on-write clone
+    # (the page-aligned 16-token prompt stays COW-free: its bucket
+    # equals the prompt, so the plan recomputes from a page boundary)
+    assert int(warm.prefix_cache.cow_clones.value) - cow0 >= 1
+    assert st["cached_pages"] > 0
+    warm.close()
+    cold.close()
+    _assert_drained(warm)
+    _assert_drained(cold)
+
+
+def test_warm_hit_skips_prefill_compute(net):
+    """The hit actually saves work: a warm admission runs the CHUNK
+    program, not the full prefill (chunk_prefills counted; tokens_saved
+    advances by the cached span)."""
+    prefix = RNG.randint(0, 64, (16,))
+    p1 = np.concatenate([prefix, RNG.randint(0, 64, (5,))])[None, :]
+    p2 = np.concatenate([prefix, RNG.randint(0, 64, (5,))])[None, :]
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             prefix_cache=True)
+    saved0 = int(eng.prefix_cache.tokens_saved.value)
+    eng.submit(p1, 4)
+    eng.run_until_idle()
+    assert eng.chunk_prefills == 0 and eng.local_prefills == 1
+    eng.submit(p2, 4)
+    eng.run_until_idle()
+    assert eng.chunk_prefills == 1 and eng.local_prefills == 1
+    assert int(eng.prefix_cache.tokens_saved.value) - saved0 == 16
+    eng.close()
+    _assert_drained(eng)
+
+
+# -------------------------------------------------- demand growth + shedding
+def test_demand_growth_claims_pages_per_step(net):
+    """Demand mode claims only the prompt's pages at admission and
+    grows as decode crosses page boundaries — residency tracks actual
+    depth, not the up-front worst case."""
+    eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             prefix_cache=True)
+    h = eng.submit(RNG.randint(0, 64, (1, 8)), 17)  # total 25 -> 4 pages
+    eng.step()
+    # admission claimed ONE page (the prompt); the step's decode then
+    # grew one more as the write position crossed the boundary — an
+    # up-front claimer would show claims == 4 already
+    assert eng.page_pool.claims == 2
+    assert len(eng._row_pages[0]) == 2
+    grown = set()
+    while h.status == "RUNNING":
+        eng.step()
+        rp = eng._row_pages[0]
+        if rp is not None:
+            grown.add(len(rp))
+    assert h.status == "DONE"
+    assert grown and max(grown) <= 4
+    # total span is 25 tokens (4 pages) but the LAST emitted token's KV
+    # is never written back (the request finishes instead of feeding
+    # it) — demand growth claims only the 3 pages actually written,
+    # one page less than the up-front claimer's pages_for(total)
+    assert eng.page_pool.claims == 3
+    eng.close()
+    _assert_drained(eng)
+
+
+def test_demand_growth_failure_sheds_with_reason(net):
+    """An overcommitted arena sheds the request that could not grow —
+    partial tokens kept, reason pages_exhausted, nobody else touched,
+    zero leaks after."""
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8, num_pages=5,
+                             prefix_cache=True,
+                             max_prefills_per_step=None)
+    sheds0 = eng.metrics.sheds.value
+    ha = eng.submit(RNG.randint(0, 64, (1, 10)), 30)
+    hb = eng.submit(RNG.randint(0, 64, (1, 10)), 30)
+    eng.run_until_idle()
+    statuses = sorted([ha.status, hb.status])
+    assert statuses == ["CANCELLED", "DONE"]
+    shed = ha if ha.status == "CANCELLED" else hb
+    winner = hb if shed is ha else ha
+    assert shed.reason == REASON_PAGES_EXHAUSTED
+    assert shed.tokens  # partial progress kept
+    assert len(winner.tokens) == 30  # survivor unaffected
+    assert eng.metrics.sheds.value - sheds0 == 1
+    eng.close()
+    _assert_drained(eng)
+
+
+def test_warm_admission_not_blocked_by_total_budget(net):
+    """The budget-relaxation pin: a warm request whose TOTAL span
+    exceeds free pages admits anyway when its actual fresh-page need
+    fits (the old total<=free gate would starve warm traffic)."""
+    prefix = RNG.randint(0, 64, (16,))
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8, num_pages=6,
+                             prefix_cache=True)
+    h1 = eng.submit(np.concatenate(
+        [prefix, RNG.randint(0, 64, (2,))])[None, :], 4)
+    eng.run_until_idle()
+    assert h1.status == "DONE"
+    # cache holds 2 full pages + 1 tail page; 3 free. A warm request
+    # with total 18+30=48 tokens (6 pages — more than free) must still
+    # admit: it needs only 1 fresh page at admission.
+    h2 = eng.submit(np.concatenate(
+        [prefix, RNG.randint(0, 64, (2,))])[None, :], 30)
+    eng.step()
+    assert h2.status == "RUNNING"
+    eng.run_until_idle()
+    assert h2.status in ("DONE", "CANCELLED")  # may shed deep in decode
+    eng.close()
+    _assert_drained(eng)
+
+
+def test_warm_head_waits_when_only_its_own_pages_are_evictable(net):
+    """Regression: the fits gate must NOT count the pages the request
+    itself is about to adopt as evictable headroom — that passed a
+    head whose claim then failed, escaping step() as a spurious
+    rejection. The head must WAIT (no crash, stays queued) and admit
+    once real pages free up."""
+    prefix = RNG.randint(0, 64, (16,))
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8, num_pages=4,
+                             prefix_cache=True)
+    ha = eng.submit(prefix[None, :], 2)   # publishes 2 full pages
+    eng.run_until_idle()
+    assert ha.status == "DONE"
+    assert eng.prefix_cache.cached_pages == 2
+    hb = eng.submit(RNG.randint(0, 64, (1, 10)), 5)  # pins 2 free pages
+    eng.step()
+    assert hb.status == "RUNNING"
+    assert eng.page_pool.free_pages == 0
+    # warm head: adopts the 2 cached pages by reference, needs 1 fresh
+    # — nothing is genuinely evictable (its own pages don't count), so
+    # it must wait, and stepping must not raise
+    hc = eng.submit(np.concatenate(
+        [prefix, RNG.randint(0, 64, (2,))])[None, :], 3)
+    eng.step()
+    assert hc.status == "QUEUED"
+    eng.run_until_idle()   # hb finishes -> pages free -> hc admits
+    assert hb.status == "DONE" and hc.status == "DONE"
+    want = _gen(net, hc.request.input_ids[None, :], 3)
+    np.testing.assert_array_equal(hc.output_ids, want)
+    eng.close()
+    _assert_drained(eng)
+
+
+# ------------------------------------------------------------ churn + leaks
+def test_mixed_churn_zero_leaked_pages_zero_refcount_drift(net):
+    """The satellite pin: finish + cancel + deadline + COW + eviction
+    churn over a SHARED arena ends at zero leaked pages and zero
+    dangling refcounts."""
+    t = [0.0]
+    prefix = RNG.randint(0, 64, (16,))
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8, num_pages=10,
+                             prefix_cache=True, clock=lambda: t[0])
+    mk = lambda n: np.concatenate(  # noqa: E731
+        [prefix, RNG.randint(0, 64, (n,))])[None, :]
+    h_done = eng.submit(mk(3), 2)
+    eng.run_until_idle()
+    h_run = eng.submit(mk(4), 24)          # warm hit, long decode
+    h_dead = eng.submit(mk(5), 4, deadline_s=5.0)
+    eng.step()
+    eng.step()
+    assert h_done.status == "DONE"
+    t[0] = 10.0                            # h_dead expires
+    eng.step()
+    assert h_dead.status in ("TIMEOUT", "RUNNING", "DONE")
+    # churn disjoint prefixes to force eviction against live sharing
+    for _ in range(3):
+        eng.submit(RNG.randint(0, 64, (1, 18)), 3)
+        for _ in range(12):
+            if eng.scheduler.depth or eng.active_slots:
+                eng.step()
+    eng.close()                            # cancels anything in flight
+    assert h_run.status in ("DONE", "CANCELLED", "TIMEOUT")
+    _assert_drained(eng)
+
+
+# --------------------------------------------------------------- reload flush
+def test_reload_flushes_prefix_cache_exact_after_swap(net, tmp_path):
+    """The satellite pin: a weight swap flushes the store; a post-swap
+    same-prefix request MISSES (never adopts old-weights KV) and its
+    stream is exact under the new weights."""
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    paddle.seed(77)
+    cfg = net.config
+    net2 = LlamaForCausalLM(cfg)
+    net2.eval()
+    root = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(root, network=net2, async_saves=False)
+    mgr.save(1, blocking=True)
+    mgr.close()
+
+    prefix = RNG.randint(0, 64, (16,))
+    p1 = np.concatenate([prefix, RNG.randint(0, 64, (3,))])[None, :]
+    p2 = np.concatenate([prefix, RNG.randint(0, 64, (3,))])[None, :]
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             prefix_cache=True)
+    misses0 = int(eng.prefix_cache.misses.value)
+    h1 = eng.submit(p1, 5)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(h1.output_ids, _gen(net, p1, 5))
+    assert eng.prefix_cache.cached_pages > 0
+    staged = eng.reload_weights(root)
+    assert staged.applied, staged
+    # the store flushed at the swap boundary
+    assert eng.prefix_cache.cached_pages == 0
+    h2 = eng.submit(p2, 5)
+    eng.run_until_idle()
+    # post-swap request MISSED (old-weights pages unreachable) and is
+    # exact under the NEW weights
+    assert int(eng.prefix_cache.misses.value) - misses0 >= 1
+    np.testing.assert_array_equal(h2.output_ids, _gen(net2, p2, 5))
+    eng.close()
+    _assert_drained(eng)
+
+
+# ------------------------------------------------------------- observability
+def test_healthz_and_prom_series_carry_prefix_stats(net):
+    prefix = RNG.randint(0, 64, (16,))
+    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
+                             min_bucket=8, page_size=8,
+                             prefix_cache=True)
+    fe = ServingFrontend(eng)
+    try:
+        for _ in range(2):
+            eng.submit(np.concatenate(
+                [prefix, RNG.randint(0, 64, (3,))])[None, :], 3)
+            eng.run_until_idle()
+        h = fe.health()
+        pc = h.get("prefix_cache")
+        assert pc is not None and pc["hits"] >= 1
+        assert "hbm_saved_bytes" in pc and "evictions" in pc
+        from paddle_tpu.observability import (
+            parse_prometheus_text,
+            prometheus_text,
+        )
+
+        series = parse_prometheus_text(prometheus_text())
+        for name in ("paddle_serving_prefix_hits_total",
+                     "paddle_serving_prefix_misses_total",
+                     "paddle_serving_prefix_evictions_total",
+                     "paddle_serving_prefix_cow_clones_total",
+                     "paddle_serving_prefix_shared_hbm_saved_bytes"):
+            assert name in series, (name, sorted(series)[:20])
+    finally:
+        eng.close()
+    _assert_drained(eng)
+
+
+# ---------------------------------------------------------- router affinity
+def test_router_affinity_bonus_prefers_warm_replica():
+    """Cache-affinity placement: the replica that last served a prefix
+    wins placement while its load stays within the bonus margin, and
+    loses it once genuinely busier."""
+    from paddle_tpu.serving.fleet.router import FleetRouter
+
+    r = FleetRouter([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                    affinity_bonus=0.5)
+    now = r.clock()
+    for rep, active in zip(r.replicas, (2, 1)):
+        rep.healthy = True
+        rep.status_time = now
+        rep.status = {"free_pages": 10, "queue_depth": 0,
+                      "active": active}
+    key = (1, 2, 3)
+    # without affinity, replica 1 (less loaded) wins
+    assert r._pick().index == 1
+    # replica 0 served this prefix before: bonus outweighs one row
+    r._note_affinity(key, 0)
+    assert r._pick(affinity_key=key).index == 0
+    # real load eventually outweighs the bonus
+    r.replicas[0].status["active"] = 8
+    assert r._pick(affinity_key=key).index == 1
+    # map is bounded
+    r.affinity_map_size = 2
+    for i in range(5):
+        r._note_affinity((i,), 0)
+    assert len(r._affinity) == 2
+
+
+def test_scheduler_fits_predicate_no_skip():
+    """The fits predicate keeps strict FIFO: a head that does not fit
+    delays everything behind it rather than being overtaken."""
+    from paddle_tpu.serving import Request, Scheduler
+
+    s = Scheduler(max_queue_size=8)
+    h1 = s.submit(Request([1] * 10, 4))
+    h2 = s.submit(Request([1] * 2, 4))
+    assert s.pop_next(fits=lambda r: r.prompt_len < 5) is None
+    assert s.depth == 2
+    got = s.pop_next(fits=lambda r: True)
+    assert got is h1
+    assert s.pop_next() is h2

@@ -1,0 +1,311 @@
+"""prefix-smoke — end-to-end gate for the prefix-cache subsystem.
+
+Four phases, every one asserting exactness and zero-leak accounting:
+
+1. **TTFT collapse** (the acceptance number): a subprocess
+   ``serve_bench --shared-prefix`` replay over a 448-token shared
+   system prompt must show >= 5x p50 TTFT reduction warm-vs-cold on
+   the CPU smoke model, with every request completed.
+2. **Two HTTP/SSE waves sharing a prefix**: wave 1 populates the
+   cache through real sockets; wave 2 (fresh tails, same prefix) must
+   HIT — hits counter up by the wave size — and every stream in both
+   waves must be token-exact vs ``net.generate``.
+3. **Arena pressure**: a deliberately undersized arena is churned with
+   disjoint prefixes; cold cached prefixes must be LRU-evicted
+   (evictions counted) with zero leaked pages and zero refcount drift
+   after close (claims == releases).
+4. **Reload mid-run**: a checkpoint with DIFFERENT weights commits,
+   ``POST /reload`` swaps it in — the prefix store must flush (a
+   post-swap request can never adopt old-weights KV), the next wave
+   must MISS cleanly, and its streams must be exact vs the NEW net's
+   generate.
+
+Exit 0 = gate passed. Wired as ``make prefix-smoke`` into
+``make smoke-all``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SEED_A = 11
+SEED_B = 29
+
+
+def _build_net(seed, hidden=32):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=hidden, intermediate_size=2 * hidden,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _ref(net, ids, max_new):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray([list(ids)])), max_new_tokens=max_new
+    ).numpy())[0]
+    return [int(t) for t in out[len(ids):]]
+
+
+def _stream(port, ids, max_new):
+    from paddle_tpu.serving import stream_generate
+
+    events, _ = stream_generate(
+        "127.0.0.1", port,
+        {"input_ids": [int(t) for t in ids], "max_new_tokens": max_new},
+    )
+    toks = [d["token"] for e, d in events if e == "token"]
+    return events[-1][0], toks
+
+
+def _healthz(port):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/healthz")
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out
+
+
+def phase_ttft_collapse(failures):
+    """serve_bench --shared-prefix must show the >= 5x p50 collapse."""
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "serve_bench.py"),
+        "--shared-prefix", "--json", "--requests", "24", "--rate", "30",
+        "--page-size", "16", "--min-bucket", "16", "--hidden", "256",
+        "--layers", "4", "--max-seq", "512", "--prefix-len", "448",
+        "--new-min", "4", "--new-max", "8",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=900, env=env)
+    if proc.returncode != 0:
+        failures.append(
+            f"shared-prefix bench failed rc={proc.returncode}: "
+            f"{proc.stderr[-800:]}"
+        )
+        return
+    rec = json.loads(proc.stdout)
+    ratio = rec.get("ttft_p50_ratio") or 0.0
+    cold_done = rec["cold"]["completed"]
+    warm_done = rec["warm"]["completed"]
+    if cold_done != rec["requests"] or warm_done != rec["requests"]:
+        failures.append(
+            f"bench dropped requests: cold {cold_done}, warm "
+            f"{warm_done} of {rec['requests']}"
+        )
+    if ratio < 5.0:
+        failures.append(
+            f"warm-prefix TTFT collapse below gate: p50 ratio {ratio} "
+            f"< 5.0 (cold {rec['cold']['ttft']['p50']}s, warm "
+            f"{rec['warm']['ttft']['p50']}s)"
+        )
+    if rec["prefix_cache"]["hits"] < rec["requests"]:
+        failures.append(
+            f"warm replay did not hit: {rec['prefix_cache']}"
+        )
+    print(
+        f"prefix_smoke: TTFT collapse x{ratio} "
+        f"(cold p50 {1e3 * rec['cold']['ttft']['p50']:.1f}ms -> warm "
+        f"{1e3 * rec['warm']['ttft']['p50']:.1f}ms), shared-HBM peak "
+        f"{rec['hbm_saved_bytes_peak']} B"
+    )
+
+
+def phase_waves_and_reload(failures):
+    import numpy as np
+
+    from paddle_tpu.serving import PagedServingEngine, ServingFrontend
+
+    rng = np.random.RandomState(3)
+    prefix = [int(t) for t in rng.randint(0, 64, (20,))]
+    netA = _build_net(SEED_A)
+    refA = _build_net(SEED_A)
+    netB_src = _build_net(SEED_B)
+    refB = _build_net(SEED_B)
+
+    root = tempfile.mkdtemp(prefix="prefix_smoke_ckpt_")
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(root, network=netB_src, async_saves=False)
+    mgr.save(1, blocking=True)
+    mgr.close()
+
+    eng = PagedServingEngine(
+        netA, max_batch_size=4, max_seq_len=64, min_bucket=8,
+        page_size=8, prefix_cache=True,
+    )
+    fe = ServingFrontend(eng).start()
+    try:
+        def wave(label, ref_net, n=3):
+            prompts = [
+                prefix + [int(t) for t in rng.randint(0, 64, (3,))]
+                for _ in range(n)
+            ]
+            results = [None] * n
+
+            def one(i):
+                results[i] = _stream(fe.port, prompts[i], 5)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            for i in range(n):
+                if results[i] is None:
+                    failures.append(f"{label} stream {i} hung")
+                    continue
+                status, toks = results[i]
+                if status != "done":
+                    failures.append(
+                        f"{label} stream {i} ended {status}"
+                    )
+                    continue
+                want = _ref(ref_net, prompts[i], 5)
+                if toks != want:
+                    failures.append(
+                        f"{label} stream {i} tokens {toks} != "
+                        f"generate {want}"
+                    )
+            return n
+
+        # -- wave 1 populates, wave 2 must hit ------------------------
+        wave("wave1", refA)
+        h1 = _healthz(fe.port)
+        pc1 = h1.get("prefix_cache") or {}
+        n2 = wave("wave2", refA)
+        h2 = _healthz(fe.port)
+        pc2 = h2.get("prefix_cache") or {}
+        if pc2.get("hits", 0) < pc1.get("hits", 0) + n2:
+            failures.append(
+                f"wave 2 did not hit the cache: {pc1} -> {pc2}"
+            )
+        print(
+            f"prefix_smoke: two SSE waves exact "
+            f"(hits {pc1.get('hits')} -> {pc2.get('hits')}, "
+            f"cow {pc2.get('cow_clones')})"
+        )
+
+        # -- reload mid-run: flush + clean miss + exact on new weights
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=300)
+        conn.request("POST", "/reload",
+                     body=json.dumps({"ckpt_dir": root}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        rel = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200 or not rel.get("applied"):
+            failures.append(f"reload failed: {resp.status} {rel}")
+        h3 = _healthz(fe.port)
+        pc3 = h3.get("prefix_cache") or {}
+        if pc3.get("entries", -1) != 0:
+            failures.append(
+                f"prefix store not flushed by reload: {pc3}"
+            )
+        misses_before = pc3.get("misses", 0)
+        wave("wave3-postswap", refB)
+        pc4 = (_healthz(fe.port).get("prefix_cache") or {})
+        if pc4.get("misses", 0) <= misses_before:
+            failures.append(
+                f"post-swap wave did not miss cleanly: {pc3} -> {pc4}"
+            )
+        print(
+            f"prefix_smoke: reload flushed the store "
+            f"(entries 0, misses {misses_before} -> "
+            f"{pc4.get('misses')}), post-swap streams exact on new "
+            f"weights"
+        )
+    finally:
+        fe.stop(close_engine=True)
+    pp = eng.page_pool.stats()
+    if pp["pages_in_use"] != 0 or pp["claims"] != pp["releases"]:
+        failures.append(f"page accounting drift after close: {pp}")
+
+
+def phase_pressure_eviction(failures):
+    import numpy as np
+
+    from paddle_tpu.serving import PagedServingEngine
+
+    net = _build_net(SEED_A)
+    rng = np.random.RandomState(5)
+    eng = PagedServingEngine(
+        net, max_batch_size=2, max_seq_len=64, min_bucket=8,
+        page_size=8, num_pages=6, prefix_cache=True,
+    )
+    try:
+        for _ in range(5):
+            p = rng.randint(0, 64, (1, 18))  # disjoint prefixes
+            h = eng.submit(p, 4)
+            eng.run_until_idle()
+            if h.status != "DONE":
+                failures.append(
+                    f"pressure request ended {h.status} ({h.reason})"
+                )
+        st = eng.prefix_cache.stats()
+        if st["evictions"] < 1:
+            failures.append(f"arena pressure evicted nothing: {st}")
+        in_use = eng.page_pool.pages_in_use
+        if in_use != st["cached_pages"]:
+            failures.append(
+                f"leak under pressure: {in_use} pages in use vs "
+                f"{st['cached_pages']} cached"
+            )
+        print(
+            f"prefix_smoke: pressure churn evicted "
+            f"{st['evictions']} pages, zero leaks "
+            f"({in_use} in use == {st['cached_pages']} cached)"
+        )
+    finally:
+        eng.close()
+    pp = eng.page_pool.stats()
+    if pp["pages_in_use"] != 0 or pp["claims"] != pp["releases"]:
+        failures.append(f"refcount drift after pressure close: {pp}")
+
+
+def main():
+    failures = []
+    phase_waves_and_reload(failures)
+    phase_pressure_eviction(failures)
+    phase_ttft_collapse(failures)
+    if failures:
+        print("prefix_smoke: FAILED")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("prefix_smoke: OK — warm TTFT collapse >= 5x, SSE waves "
+          "exact, eviction + reload-flush clean, zero leaked pages")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
