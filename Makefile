@@ -9,19 +9,22 @@ ENV = JAX_PLATFORMS=cpu
 	reload-smoke train-chaos-smoke prefix-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
-# decode / optimizer step) + AST lint + API-surface audit, diffed
-# against the checked-in baseline. Exit nonzero on any new finding.
+# decode / optimizer step, incl. collective-divergence) + AST lint +
+# the distributed-correctness passes (rank-conditional/off-main-thread
+# collectives, lock-order/unlocked-write/blocking-under-lock) +
+# API-surface audit, diffed against the checked-in baseline. Exit
+# nonzero on any new finding.
 lint:
-	$(ENV) $(PY) tools/tpu_lint.py --audit-api
+	$(ENV) $(PY) tools/tpu_lint.py --audit-api --concurrency
 
 # Source-only lint (seconds): for tight edit loops.
 lint-fast:
-	$(ENV) $(PY) tools/tpu_lint.py --ast-only
+	$(ENV) $(PY) tools/tpu_lint.py --ast-only --concurrency
 
 # Accept the current findings (each new entry needs a documented `why`
 # before review).
 lint-update:
-	$(ENV) $(PY) tools/tpu_lint.py --update-baseline
+	$(ENV) $(PY) tools/tpu_lint.py --update-baseline --concurrency
 
 # Tier-1: the suite the driver gates on (kept `not slow`).
 tier1:
@@ -102,7 +105,10 @@ layout-smoke:
 # uninterrupted reference (bf16 O1 and fp8 O3); a wedged step fires
 # the watchdog within budget with a flight bundle on disk; a hard-
 # exited rank is relaunched by the elastic supervisor and resumes from
-# the last committed step with zero duplicated log steps.
+# the last committed step with zero duplicated log steps. Every child
+# runs with the lock sentinel armed (PADDLE_TPU_LOCK_SENTINEL=1):
+# instrumented runtime locks must finish the round with ZERO
+# lock-order inversions.
 train-chaos-smoke:
 	$(ENV) $(PY) tools/train_chaos_smoke.py
 

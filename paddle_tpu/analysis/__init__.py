@@ -16,6 +16,17 @@ offline, the way upstream gates kernels through compile-time checks:
 - :mod:`ast_lint` — source-level pass for tensor-dependent Python
   control flow, host syncs inside ``@jit`` regions, and missing
   ``static_argnums``.
+- :mod:`collective_lint` — the distributed-hang shape:
+  ``collective-divergence`` (cond/switch branches with different
+  collective schedules, wired into the jaxpr walk) plus AST rules
+  ``rank-conditional-collective`` and ``collective-off-main-thread``.
+- :mod:`concurrency_lint` — host lock discipline per class:
+  ``lock-order-inversion`` (acquisition-order cycles),
+  ``unlocked-shared-write``, ``blocking-call-under-lock``.
+- :mod:`lock_sentinel` — the runtime counterpart: instrumented locks
+  (``instrument_locks`` / ``PADDLE_TPU_LOCK_SENTINEL=1``) that catch
+  ACTUAL lock-order inversions and long holds under the chaos
+  harnesses, publishing ``paddle_analysis_lock_*`` metrics.
 - :mod:`baseline` — the ratchet: CI fails only on findings not in the
   checked-in baseline (``tools/tpu_lint_baseline.json``).
 
@@ -25,6 +36,7 @@ Suppress an AST finding inline with ``# tpu-lint: disable=<rule>``.
 """
 from __future__ import annotations
 
+from . import collective_lint, concurrency_lint, lock_sentinel
 from .ast_lint import lint_file, lint_path, lint_source
 from .baseline import (
     assert_no_new_findings,
@@ -39,6 +51,14 @@ from .jaxpr_lint import (
     lint_fn,
     lint_jitted,
 )
+from .lock_sentinel import (
+    LockSentinel,
+    SentinelLock,
+    get_sentinel,
+    instrument_locks,
+    maybe_instrument,
+    use_sentinel,
+)
 from .trace_guard import (
     TraceGuard,
     find_leaked_tracers,
@@ -52,8 +72,11 @@ __all__ = [
     "Finding", "Report", "Severity", "LintConfig",
     "lint_closed_jaxpr", "lint_fn", "lint_jitted",
     "lint_source", "lint_file", "lint_path",
+    "collective_lint", "concurrency_lint", "lock_sentinel",
     "TraceGuard", "get_guard", "use_guard", "record_compile",
     "find_leaked_tracers", "lint_leaked_tracers",
+    "LockSentinel", "SentinelLock", "get_sentinel",
+    "instrument_locks", "maybe_instrument", "use_sentinel",
     "load_baseline", "save_baseline", "diff_against_baseline",
     "assert_no_new_findings",
 ]

@@ -35,6 +35,22 @@ _SHAPE_BUILDERS = {"zeros", "ones", "full", "empty", "arange", "linspace",
                    "eye"}
 
 
+def suppressed(lines, lineno, rule):
+    """The one `# tpu-lint: disable=<rule>` parser every source pass
+    shares: a finding is suppressed by a disable comment on its own
+    line or the line above (``disable=all`` suppresses everything; a
+    malformed bare ``disable=`` suppresses nothing)."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            if "tpu-lint:" in text and "disable=" in text:
+                tail = text.split("disable=", 1)[1].split()
+                rules = tail[0].split(",") if tail else []
+                if rule in rules or "all" in rules:
+                    return True
+    return False
+
+
 def _dotted(node):
     """'jax.jit' for Attribute/Name chains, else None."""
     parts = []
@@ -146,15 +162,7 @@ class _FnLinter(ast.NodeVisitor):
 
     # ------------------------------------------------------------- helpers
     def _suppressed(self, lineno, rule):
-        for ln in (lineno, lineno - 1):
-            if 1 <= ln <= len(self.lines):
-                text = self.lines[ln - 1]
-                if "tpu-lint:" in text and "disable=" in text:
-                    tail = text.split("disable=", 1)[1]
-                    rules = tail.split()[0].split(",")
-                    if rule in rules or "all" in rules:
-                        return True
-        return False
+        return suppressed(self.lines, lineno, rule)
 
     def _add(self, rule, severity, message, node, detail):
         if self._suppressed(node.lineno, rule):
@@ -259,8 +267,10 @@ class _FnLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(source, rel="<string>"):
-    """Lint one Python source string. Returns a Report."""
+def _parse_or_report(source, rel):
+    """(tree, lines, Report) — tree is None when the source does not
+    parse, with the single parse-error finding already in the Report.
+    The shared front half of every source pass."""
     rep = Report()
     try:
         tree = ast.parse(source)
@@ -270,8 +280,13 @@ def lint_source(source, rel="<string>"):
             message=f"could not parse: {e}", graph=rel, where=rel,
             detail="parse",
         ))
-        return rep
-    lines = source.splitlines()
+        return None, [], rep
+    return tree, source.splitlines(), rep
+
+
+def lint_parsed(tree, lines, rel):
+    """The jit-hazard rules over an already-parsed module."""
+    rep = Report()
     assigned = _module_jit_assignments(tree)
     for node in ast.walk(tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -286,7 +301,25 @@ def lint_source(source, rel="<string>"):
     return rep
 
 
-def lint_file(path, root=None):
+def lint_source(source, rel="<string>"):
+    """Lint one Python source string. Returns a Report."""
+    tree, lines, rep = _parse_or_report(source, rel)
+    if tree is None:
+        return rep
+    rep.extend(lint_parsed(tree, lines, rel))
+    return rep
+
+
+DEFAULT_SKIP_DIRS = ("__pycache__", ".git", "build", "dist")
+
+
+def lint_one_file(passes, path, root=None):
+    """Run one or more ``lint_parsed(tree, lines, rel)``-shaped passes
+    over one file: ONE read, ONE parse, one parse-error finding no
+    matter how many passes ride along. Shared by every source-level
+    lint module."""
+    if callable(passes):
+        passes = (passes,)
     rel = os.path.relpath(path, root) if root else path
     try:
         with open(path, "r", encoding="utf-8") as f:
@@ -299,21 +332,39 @@ def lint_file(path, root=None):
             detail="read",
         ))
         return rep
-    return lint_source(src, rel)
+    tree, lines, rep = _parse_or_report(src, rel)
+    if tree is None:
+        return rep
+    for fn in passes:
+        rep.extend(fn(tree, lines, rel))
+    return rep
 
 
-def lint_path(path, root=None, skip_dirs=("__pycache__", ".git",
-                                          "build", "dist")):
-    """Recursively lint every .py file under ``path``."""
+def lint_tree(passes, path, root=None, skip_dirs=DEFAULT_SKIP_DIRS):
+    """Run one or more ``lint_parsed``-shaped passes over every .py
+    under ``path`` — one directory walk, one read and one parse per
+    file no matter how many passes ride along (the CLI runs three)."""
     root = root or path
     rep = Report()
     if os.path.isfile(path):
-        rep.extend(lint_file(path, root=os.path.dirname(path)))
+        rep.extend(lint_one_file(passes, path,
+                                 root=os.path.dirname(path)))
         return rep
     for dirpath, dirnames, filenames in os.walk(path):
         dirnames[:] = [d for d in sorted(dirnames)
                        if d not in skip_dirs and not d.startswith(".")]
         for fn in sorted(filenames):
             if fn.endswith(".py"):
-                rep.extend(lint_file(os.path.join(dirpath, fn), root=root))
+                rep.extend(lint_one_file(
+                    passes, os.path.join(dirpath, fn), root=root
+                ))
     return rep
+
+
+def lint_file(path, root=None):
+    return lint_one_file(lint_parsed, path, root=root)
+
+
+def lint_path(path, root=None, skip_dirs=DEFAULT_SKIP_DIRS):
+    """Recursively lint every .py file under ``path``."""
+    return lint_tree(lint_parsed, path, root=root, skip_dirs=skip_dirs)

@@ -1,0 +1,329 @@
+"""Collective-schedule lint: the distributed-hang shape, caught offline.
+
+A multi-rank program hangs when ranks disagree about WHICH collectives
+run in WHAT order — one rank enters a psum its peers never issue and
+the fleet waits forever. Every instance this repo has shipped (the PR 5
+writer-thread collective, rank-gated barrier calls in early fleet
+drafts) was caught by hand in review; these rules mechanize that
+review at two levels:
+
+- ``collective-divergence`` (jaxpr rule, wired into
+  :func:`jaxpr_lint.lint_closed_jaxpr`): extracts the ORDERED sequence
+  of collective primitives (+ axis names) per ``lax.cond`` /
+  ``lax.switch`` branch — recursing through scan / while / shard_map /
+  pjit sub-jaxprs with the same ``_walk_eqns`` walk the other graph
+  rules use — and fires when two branches of one conditional emit
+  different schedules. If the predicate can ever differ across ranks
+  (and a traced predicate usually can), that graph is a deadlock with
+  a repro rate. Branches on genuinely uniform predicates are the
+  accept-with-reason case the baseline exists for.
+- ``rank-conditional-collective`` (AST rule): a collective call
+  lexically under a ``get_rank()`` / ``process_index()``-style
+  conditional — only some ranks participate, the others hang. The
+  coordinator idiom stays clean: point-to-point ops
+  (``send/recv/isend/irecv``) are rank-addressed by design, and a
+  conditional whose other branch issues the SAME collective (symmetric
+  participation, different args) does not fire.
+- ``collective-off-main-thread`` (AST rule): a collective call site
+  reachable (bounded call-graph walk) from a ``threading.Thread``
+  target — the exact PR 5 bug: a background writer thread issuing a
+  collective races the main thread's own collective schedule, and two
+  interleaved schedules on one device set is the same hang as a
+  divergent branch.
+
+Suppress AST findings inline with ``# tpu-lint: disable=<rule>`` on the
+offending line or the line above (the shared ast_lint mechanism).
+"""
+from __future__ import annotations
+
+import ast
+
+from .ast_lint import _dotted, suppressed as _suppressed
+from .findings import Finding, Report, Severity
+
+# collectives whose names are unambiguous at any call site
+_COLLECTIVE_CALLS = {
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "alltoall", "all_reduce",
+    "reduce_scatter", "barrier", "all_gather_object",
+    "broadcast_object_list", "scatter_object_list",
+}
+# generic verbs that are collectives only under a distributed namespace
+_COLLECTIVE_IF_DIST = {"broadcast", "reduce", "scatter", "gather"}
+_DIST_PREFIXES = ("dist", "distributed", "comm", "communication",
+                  "fleet", "collective")
+# rank-addressed by design: the coordinator idiom's building blocks
+_POINT_TO_POINT = {"send", "recv", "isend", "irecv"}
+
+_RANK_CALLS = {"get_rank", "process_index", "local_rank",
+               "get_local_rank", "rank"}
+
+_THREAD_REACH_DEPTH = 3
+
+
+# ======================================================================
+# jaxpr side: collective-divergence
+# ======================================================================
+def _is_collective_prim(name):
+    from .jaxpr_lint import _COLLECTIVE_PRIMS
+
+    if name == "axis_index":
+        return False  # reads the axis, never communicates
+    if name.startswith("pbroadcast"):
+        # jax's replication-typing adjustment (shard_map check_rep):
+        # device-local, inserted asymmetrically per branch — never a
+        # communicating collective, never part of the hang schedule
+        return False
+    return any(name.startswith(p) for p in _COLLECTIVE_PRIMS
+               if p != "axis_index")
+
+
+def collective_schedule(jaxpr):
+    """Ordered tuple of ``prim(axes)`` strings for every collective in
+    ``jaxpr``, recursing through sub-jaxprs in eqn order. For a nested
+    cond the FIRST branch's schedule stands in (each divergent nested
+    cond already fires its own finding)."""
+    from .jaxpr_lint import _axis_names_of, _sub_jaxprs
+
+    out = []
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if _is_collective_prim(prim):
+            axes = _axis_names_of(eqn)
+            out.append(f"{prim}({','.join(axes)})")
+        subs = list(_sub_jaxprs(eqn))
+        if prim == "cond" and subs:
+            out.extend(collective_schedule(subs[0]))
+        else:
+            for sub in subs:
+                out.extend(collective_schedule(sub))
+    return tuple(out)
+
+
+def check_eqn_divergence(eqn, graph, rep):
+    """Fire ``collective-divergence`` when the branches of a cond /
+    switch eqn emit different collective schedules."""
+    from .jaxpr_lint import ClosedJaxpr, Jaxpr, _src
+
+    if eqn.primitive.name != "cond":
+        return
+    branches = eqn.params.get("branches")
+    if not branches:
+        return
+    schedules = []
+    for b in branches:
+        j = b.jaxpr if isinstance(b, ClosedJaxpr) else b
+        if isinstance(j, Jaxpr):
+            schedules.append(collective_schedule(j))
+    if len(schedules) < 2 or len(set(schedules)) <= 1:
+        return
+    shown = sorted({"[" + " ".join(s or ("<none>",)) + "]"
+                    for s in schedules})
+    rep.add(Finding(
+        rule="collective-divergence", severity=Severity.ERROR,
+        message=(
+            "cond/switch branches emit different collective schedules "
+            + " vs ".join(shown)
+            + " — ranks disagreeing on the predicate deadlock here; "
+            "hoist the collective out of the branch or make the "
+            "predicate provably uniform"
+        ),
+        graph=graph, where=_src(eqn),
+        detail="cond:" + "!=".join(shown),
+    ))
+
+
+# ======================================================================
+# AST side: rank-conditional-collective / collective-off-main-thread
+# ======================================================================
+def _collective_name(call):
+    """The collective's name when ``call`` is a collective invocation,
+    else None (point-to-point ops excluded — rank-addressed)."""
+    name = _dotted(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last in _COLLECTIVE_CALLS:
+        return last
+    if last in _COLLECTIVE_IF_DIST and len(parts) > 1 and any(
+        p in _DIST_PREFIXES for p in parts[:-1]
+    ):
+        return last
+    return None
+
+
+def _collective_calls_in(node):
+    """[(name, lineno)] for every collective call anywhere under
+    ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            c = _collective_name(n)
+            if c is not None:
+                out.append((c, n.lineno))
+    return out
+
+
+def _is_rank_test(test):
+    """True when an ``if`` test depends on the caller's rank: a
+    ``get_rank()/process_index()``-style call, or a name/attribute
+    whose last component mentions rank."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            name = _dotted(n.func)
+            if name and name.split(".")[-1] in _RANK_CALLS:
+                return True
+        elif isinstance(n, (ast.Name, ast.Attribute)):
+            name = _dotted(n)
+            if name and "rank" in name.split(".")[-1].lower():
+                return True
+    return False
+
+
+def _rank_conditional_findings(tree, rel, rep, lines):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If) or not _is_rank_test(node.test):
+            continue
+        body_calls = [c for stmt in node.body
+                      for c in _collective_calls_in(stmt)]
+        else_calls = [c for stmt in node.orelse
+                      for c in _collective_calls_in(stmt)]
+        else_names = {c for c, _ in else_calls}
+        body_names = {c for c, _ in body_calls}
+        for calls, other in ((body_calls, else_names),
+                             (else_calls, body_names)):
+            for cname, lineno in calls:
+                if cname in other:
+                    continue  # symmetric participation: both sides call
+                if _suppressed(lines, lineno,
+                               "rank-conditional-collective"):
+                    continue
+                rep.add(Finding(
+                    rule="rank-conditional-collective",
+                    severity=Severity.ERROR,
+                    message=(
+                        f"collective `{cname}` under a rank conditional "
+                        f"— only some ranks participate, the rest hang; "
+                        f"use send/recv for coordinator work or run the "
+                        f"collective on every rank"
+                    ),
+                    graph=rel, where=f"{rel}:{lineno}",
+                    detail=f"rank-if:{cname}:{lineno}",
+                ))
+
+
+class _ModuleGraph:
+    """Bare-name call graph of one module: functions/methods, the
+    collective calls each makes directly, and thread-target entry
+    points (``threading.Thread(target=...)``)."""
+
+    def __init__(self, tree):
+        self.direct = {}        # fn bare name -> [(collective, lineno)]
+        self.calls = {}         # fn bare name -> set of called bare names
+        self.thread_targets = []  # (target bare name, lineno)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(node)
+            elif isinstance(node, ast.Call):
+                self._scan_thread(node)
+
+    def _scan_fn(self, fn):
+        direct, called = [], set()
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            c = _collective_name(n)
+            if c is not None:
+                direct.append((c, n.lineno))
+            name = _dotted(n.func)
+            if name:
+                called.add(name.split(".")[-1])
+        self.direct.setdefault(fn.name, []).extend(direct)
+        self.calls.setdefault(fn.name, set()).update(called)
+
+    def _scan_thread(self, call):
+        name = _dotted(call.func)
+        if not name or name.split(".")[-1] != "Thread":
+            return
+        for kw in call.keywords:
+            if kw.arg == "target":
+                t = _dotted(kw.value)
+                if t:
+                    self.thread_targets.append(
+                        (t.split(".")[-1], call.lineno)
+                    )
+
+    def reachable(self, entry, depth=_THREAD_REACH_DEPTH):
+        seen, frontier = {entry}, {entry}
+        for _ in range(depth):
+            nxt = set()
+            for fn in frontier:
+                nxt |= {c for c in self.calls.get(fn, ())
+                        if c in self.calls and c not in seen}
+            if not nxt:
+                break
+            seen |= nxt
+            frontier = nxt
+        return seen
+
+
+def _off_main_thread_findings(tree, rel, rep, lines):
+    g = _ModuleGraph(tree)
+    for target, t_line in g.thread_targets:
+        if target not in g.calls:
+            continue  # target defined elsewhere: out of this pass's view
+        for fn in sorted(g.reachable(target)):
+            for cname, lineno in g.direct.get(fn, ()):
+                if _suppressed(lines, lineno,
+                               "collective-off-main-thread"):
+                    continue
+                rep.add(Finding(
+                    rule="collective-off-main-thread",
+                    severity=Severity.ERROR,
+                    message=(
+                        f"collective `{cname}` in `{fn}` is reachable "
+                        f"from threading.Thread target `{target}` "
+                        f"(line {t_line}) — a background-thread "
+                        f"collective interleaves with the main thread's "
+                        f"schedule and deadlocks the fleet (the PR 5 "
+                        f"writer-thread bug); move the collective to "
+                        f"the main thread or hand the thread plain "
+                        f"host data"
+                    ),
+                    graph=rel, where=f"{rel}:{lineno}",
+                    detail=f"thread:{target}->{fn}:{cname}",
+                ))
+
+
+def lint_parsed(tree, lines, rel):
+    """Both collective AST rules over an already-parsed module."""
+    rep = Report()
+    _rank_conditional_findings(tree, rel, rep, lines)
+    _off_main_thread_findings(tree, rel, rep, lines)
+    return rep
+
+
+def lint_source(source, rel="<string>"):
+    """Run both collective AST rules over one source string."""
+    from .ast_lint import _parse_or_report
+
+    tree, lines, rep = _parse_or_report(source, rel)
+    if tree is None:
+        return rep
+    rep.extend(lint_parsed(tree, lines, rel))
+    return rep
+
+
+def lint_file(path, root=None):
+    from .ast_lint import lint_one_file
+
+    return lint_one_file(lint_parsed, path, root=root)
+
+
+def lint_path(path, root=None, skip_dirs=None):
+    """Recursively run the collective AST rules under ``path``."""
+    from .ast_lint import DEFAULT_SKIP_DIRS, lint_tree
+
+    return lint_tree(lint_parsed, path, root=root,
+                     skip_dirs=skip_dirs or DEFAULT_SKIP_DIRS)

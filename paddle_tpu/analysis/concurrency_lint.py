@@ -1,0 +1,521 @@
+"""Host-concurrency lint: lock discipline over the threaded runtimes.
+
+The serving fleet, checkpoint writer, resilience watchdog, and
+observability registry are all lock-per-class threaded code — and every
+review since PR 5 has hand-checked the same three properties. This pass
+checks them statically, per class:
+
+- ``lock-order-inversion``  builds the class's lock-ACQUISITION-ORDER
+  graph from ``with self._lock:`` nesting (plus statement-level
+  ``.acquire()``/``.release()`` pairs) with ONE level of call-graph
+  interprocedural propagation: holding A while calling a method that
+  acquires B adds the A->B edge too. A cycle in that graph is a
+  deadlock waiting for the right interleaving; a nested re-acquisition
+  of a known non-reentrant ``threading.Lock`` is a deadlock on the
+  spot (``self:`` detail).
+- ``unlocked-shared-write``  an attribute the class writes BOTH under a
+  lock and outside one (outside ``__init__``) — the lock is evidently
+  meant to protect it, and the unlocked write is the torn-state race.
+  A second trigger (``:thread`` detail): in a lock-holding class, an
+  unlocked ``self.X`` write inside a method reachable from a
+  ``threading.Thread`` target — a background thread publishing state
+  the rest of the class reads (the fleet-router health-map shape).
+- ``blocking-call-under-lock``  a known-blocking call (``join()``,
+  ``.result()``, socket/HTTP I/O, ``time.sleep``, subprocess waits)
+  while a lock is held — every other thread touching that lock now
+  waits on the network too. One level interprocedural: holding a lock
+  while calling a method whose body blocks fires the same rule.
+  ``Condition.wait()`` on a class Condition attribute is exempt (it
+  RELEASES the lock while waiting — that is the point of a condition).
+
+``threading.Condition(self._lock)`` attributes alias the wrapped lock:
+``with self._cond:`` acquires the same underlying lock, and the order
+graph treats them as one node.
+
+Findings key on class/attr/method names (never line numbers), so the
+baseline survives refactors that move code. Suppress inline with
+``# tpu-lint: disable=<rule>``. The runtime counterpart — the lock
+sentinel that catches ACTUAL inversions under the chaos harnesses —
+lives in :mod:`lock_sentinel`.
+"""
+from __future__ import annotations
+
+import ast
+
+from .ast_lint import _dotted, suppressed as _suppressed
+from .findings import Finding, Report, Severity
+
+# attribute calls that block the calling thread
+_BLOCKING_ATTRS = {
+    "join", "result", "sleep", "recv", "recv_into", "accept",
+    "connect", "sendall", "getresponse", "request", "urlopen",
+    "readline",
+}
+# fully-dotted callables that block
+_BLOCKING_DOTTED = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "urllib.request.urlopen",
+}
+_THREAD_REACH_DEPTH = 3
+
+# container mutations that count as writes to ``self.X``
+_MUTATOR_ATTRS = {
+    "append", "extend", "insert", "pop", "popitem", "clear", "update",
+    "setdefault", "add", "discard", "remove", "appendleft",
+}
+
+
+def _self_attr(node):
+    """'X' when ``node`` is ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassScan:
+    """Everything the three rules need to know about one class."""
+
+    def __init__(self, cls, rel, lines):
+        self.cls = cls
+        self.rel = rel
+        self.lines = lines
+        self.name = cls.name
+        self.lock_attrs = set()       # ctor-confirmed Lock() attrs
+        self.rlock_attrs = set()      # ctor-confirmed RLock() attrs
+        self.assumed_lock_attrs = set()  # name-based `with self.X:` only
+        self.cond_attrs = {}          # Condition attr -> wrapped lock | None
+        self.event_attrs = set()
+        self.methods = {}             # name -> FunctionDef
+        self.thread_targets = set()   # method names run on threads
+        self._method_calls = {}       # name -> set of self-method names
+        self.direct_acquires = {}     # name -> set of lock ids
+        self.direct_blocking = {}     # name -> [callname]
+        self.edges = {}               # (a, b) -> (method, lineno)
+        self.self_cycles = {}         # lock -> (method, lineno)
+        self.writes = []              # (attr, locked, method, lineno)
+        self.blocking = []            # (callname, method, lineno)
+        self._discover()
+
+    # ---------------------------------------------------------- discovery
+    def _discover(self):
+        for node in self.cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[node.name] = node
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and isinstance(
+                    n.value, ast.Call
+                ):
+                    self._scan_ctor_assign(n)
+                if isinstance(n, ast.Call):
+                    self._scan_thread(n)
+        # lock names used only via `with self.X:` (lock passed in from
+        # outside the class): name-based fallback. Kind unknown — it
+        # could be an RLock, so the self-reacquire rule must give it
+        # the benefit of the doubt (assumed set, not lock_attrs).
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        a = _self_attr(item.context_expr)
+                        if a and "lock" in a.lower() and \
+                                a not in self.cond_attrs and \
+                                a not in self.lock_attrs and \
+                                a not in self.rlock_attrs:
+                            self.assumed_lock_attrs.add(a)
+        # per-method call graph + direct acquire/blocking summaries
+        for name, m in self.methods.items():
+            calls, acquires, blocking = set(), set(), []
+            for n in ast.walk(m):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name
+                ) and n.func.value.id == "self" and \
+                        n.func.attr in self.methods:
+                    calls.add(n.func.attr)
+                lk = self._acquire_of(n)
+                if lk:
+                    acquires.add(lk)
+                b = self._blocking_name(n)
+                if b:
+                    blocking.append(b)
+            for n in ast.walk(m):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        lk = self._lock_of(item.context_expr)
+                        if lk:
+                            acquires.add(lk)
+            self._method_calls[name] = calls
+            self.direct_acquires[name] = acquires
+            self.direct_blocking[name] = blocking
+
+    def _scan_ctor_assign(self, assign):
+        ctor = _dotted(assign.value.func)
+        if ctor is None:
+            return
+        last = ctor.split(".")[-1]
+        for tgt in assign.targets:
+            a = _self_attr(tgt)
+            if a is None:
+                continue
+            if last == "Lock":
+                self.lock_attrs.add(a)
+            elif last == "RLock":
+                self.rlock_attrs.add(a)
+            elif last == "Condition":
+                wrapped = None
+                if assign.value.args:
+                    wrapped = _self_attr(assign.value.args[0])
+                self.cond_attrs[a] = wrapped
+            elif last == "Event":
+                self.event_attrs.add(a)
+
+    def _scan_thread(self, call):
+        name = _dotted(call.func)
+        if not name or name.split(".")[-1] != "Thread":
+            return
+        for kw in call.keywords:
+            if kw.arg == "target":
+                t = _self_attr(kw.value)
+                if t:
+                    self.thread_targets.add(t)
+
+    # ----------------------------------------------------------- helpers
+    def _all_locks(self):
+        return (self.lock_attrs | self.rlock_attrs
+                | self.assumed_lock_attrs | set(self.cond_attrs))
+
+    def _lock_id(self, attr):
+        """Canonical node: a Condition aliases its wrapped lock."""
+        wrapped = self.cond_attrs.get(attr)
+        return wrapped if wrapped else attr
+
+    def _lock_of(self, expr):
+        """Lock id when ``expr`` is ``self.X`` for a known lock/cond."""
+        a = _self_attr(expr)
+        if a and a in self._all_locks():
+            return self._lock_id(a)
+        return None
+
+    def _acquire_of(self, call):
+        """Lock id when ``call`` is ``self.X.acquire(...)``."""
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "acquire":
+            return self._lock_of(call.func.value)
+        return None
+
+    def _release_of(self, call):
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "release":
+            return self._lock_of(call.func.value)
+        return None
+
+    def _blocking_name(self, call):
+        """The blocking call's display name, or None. ``wait()`` on a
+        Condition attribute is exempt: it releases the lock."""
+        dotted = _dotted(call.func)
+        if dotted in _BLOCKING_DOTTED:
+            return dotted
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr == "wait":
+            recv = _self_attr(call.func.value)
+            if recv is not None and recv in self.cond_attrs:
+                return None  # Condition.wait releases the lock
+            return "wait"
+        if attr == "join":
+            # thread.join blocks; os.path.join / ", ".join do not
+            if dotted and ("path" in dotted or dotted.startswith("os.")):
+                return None
+            if isinstance(call.func.value, ast.Constant):
+                return None
+            return "join"
+        if attr in _BLOCKING_ATTRS:
+            return attr
+        return None
+
+    def thread_reachable(self):
+        seen = set(self.thread_targets)
+        frontier = set(seen)
+        for _ in range(_THREAD_REACH_DEPTH):
+            nxt = set()
+            for m in frontier:
+                nxt |= self._method_calls.get(m, set()) - seen
+            if not nxt:
+                break
+            seen |= nxt
+            frontier = nxt
+        return seen
+
+    # --------------------------------------------------------- the walk
+    def scan_methods(self):
+        for name, m in self.methods.items():
+            self._walk_stmts(m.body, [], name)
+
+    def _note_edge(self, held, new, method, lineno):
+        for h in held:
+            if h == new:
+                # re-acquiring a non-reentrant Lock deadlocks outright;
+                # RLocks and unknown kinds are given the benefit
+                if new in self.lock_attrs and \
+                        new not in self.rlock_attrs:
+                    self.self_cycles.setdefault(new, (method, lineno))
+                continue
+            self.edges.setdefault((h, new), (method, lineno))
+
+    def _walk_stmts(self, stmts, held, method):
+        """Statement-list walk threading the held-lock stack through
+        ``with`` blocks and acquire()/release() pairs."""
+        held = list(held)
+        for stmt in stmts:
+            # statement-level acquire()/release()
+            for call in self._calls_in_stmt_head(stmt):
+                lk = self._acquire_of(call)
+                if lk:
+                    self._note_edge(held, lk, method, call.lineno)
+                    held.append(lk)
+                rl = self._release_of(call)
+                if rl and rl in held:
+                    held.remove(rl)
+            if isinstance(stmt, ast.With):
+                locks_here = []
+                for item in stmt.items:
+                    lk = self._lock_of(item.context_expr)
+                    if lk:
+                        self._note_edge(held, lk, method, stmt.lineno)
+                        locks_here.append(lk)
+                self._scan_exprs(stmt, held, method)
+                self._walk_stmts(stmt.body, held + locks_here, method)
+                continue
+            self._scan_exprs(stmt, held, method)
+            for body in self._sub_bodies(stmt):
+                self._walk_stmts(body, held, method)
+
+    @staticmethod
+    def _sub_bodies(stmt):
+        for field in ("body", "orelse", "finalbody"):
+            b = getattr(stmt, field, None)
+            if b:
+                yield b
+        for h in getattr(stmt, "handlers", ()):
+            yield h.body
+
+    @staticmethod
+    def _calls_in_stmt_head(stmt):
+        """Calls in the statement itself, not in nested suites."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            roots = [stmt.test]
+        elif isinstance(stmt, ast.For):
+            roots = [stmt.iter]
+        elif isinstance(stmt, (ast.Try, ast.With)):
+            roots = [i.context_expr for i in getattr(stmt, "items", [])]
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            roots = []
+        else:
+            roots = [stmt]
+        out = []
+        for r in roots:
+            for n in ast.walk(r):
+                if isinstance(n, ast.Call):
+                    out.append(n)
+        return out
+
+    def _scan_exprs(self, stmt, held, method):
+        """Record writes + blocking calls for one statement's head —
+        nested suites are walked with their own held stack."""
+        # ---- attribute writes ----------------------------------------
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            attr = _self_attr(tgt)
+            if attr is None and isinstance(tgt, ast.Subscript):
+                attr = _self_attr(tgt.value)
+            if attr is None and isinstance(tgt, (ast.Tuple, ast.List)):
+                for elt in tgt.elts:
+                    a = _self_attr(elt)
+                    if a is not None:
+                        self.writes.append(
+                            (a, bool(held), method, stmt.lineno)
+                        )
+                continue
+            if attr is not None:
+                self.writes.append(
+                    (attr, bool(held), method, stmt.lineno)
+                )
+        # in-place container mutations: self.X.append(...) etc.
+        for call in self._calls_in_stmt_head(stmt):
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in _MUTATOR_ATTRS:
+                attr = _self_attr(call.func.value)
+                if attr is not None:
+                    self.writes.append(
+                        (attr, bool(held), method, call.lineno)
+                    )
+        # ---- blocking calls + one-level interprocedural --------------
+        if not held:
+            return
+        for call in self._calls_in_stmt_head(stmt):
+            b = self._blocking_name(call)
+            if b:
+                self.blocking.append((b, method, call.lineno))
+            # one level of call graph: self.m() while a lock is held
+            if isinstance(call.func, ast.Attribute) and isinstance(
+                call.func.value, ast.Name
+            ) and call.func.value.id == "self":
+                callee = call.func.attr
+                if callee in self.methods:
+                    for lk in self.direct_acquires.get(callee, ()):
+                        self._note_edge(held, lk, method, call.lineno)
+                    for b2 in self.direct_blocking.get(callee, ()):
+                        self.blocking.append(
+                            (f"{callee}()->{b2}", method, call.lineno)
+                        )
+
+    # ----------------------------------------------------------- reports
+    def report_into(self, rep):
+        self.scan_methods()
+        self._report_inversions(rep)
+        self._report_unlocked_writes(rep)
+        self._report_blocking(rep)
+
+    def _add(self, rep, rule, severity, message, lineno, detail):
+        if _suppressed(self.lines, lineno, rule):
+            return
+        rep.add(Finding(
+            rule=rule, severity=severity, message=message,
+            graph=self.rel, where=f"{self.rel}:{lineno}", detail=detail,
+        ))
+
+    def _report_inversions(self, rep):
+        for lock, (method, lineno) in sorted(self.self_cycles.items()):
+            self._add(
+                rep, "lock-order-inversion", Severity.ERROR,
+                f"{self.name}.{method} re-acquires non-reentrant lock "
+                f"`self.{lock}` while already holding it — this "
+                f"deadlocks on the spot (use an RLock or drop the "
+                f"nested acquisition)",
+                lineno, f"{self.name}:self:{lock}",
+            )
+        # cycles among distinct locks: DFS over the edge graph
+        graph = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, set()).add(b)
+        seen_cycles = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start and len(path) > 1:
+                        cyc = tuple(sorted(path))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        method, lineno = self.edges[(path[-1], start)]
+                        order = "->".join(path + [start])
+                        self._add(
+                            rep, "lock-order-inversion", Severity.ERROR,
+                            f"{self.name} acquires its locks in "
+                            f"conflicting orders ({order}) — two "
+                            f"threads taking the two orders deadlock; "
+                            f"pick one global order",
+                            lineno,
+                            f"{self.name}:cycle:{'|'.join(cyc)}",
+                        )
+                    elif nxt not in path and len(path) < 6:
+                        stack.append((nxt, path + [nxt]))
+
+    def _report_unlocked_writes(self, rep):
+        if not self._all_locks():
+            return
+        skip = self._all_locks() | self.event_attrs
+        by_attr = {}
+        for attr, locked, method, lineno in self.writes:
+            if attr in skip:
+                continue
+            by_attr.setdefault(attr, []).append((locked, method, lineno))
+        reachable = self.thread_reachable()
+        for attr, ws in sorted(by_attr.items()):
+            locked_ws = [w for w in ws if w[0]]
+            unlocked_ws = [w for w in ws
+                           if not w[0] and w[1] != "__init__"]
+            if locked_ws and unlocked_ws:
+                _, method, lineno = unlocked_ws[0]
+                self._add(
+                    rep, "unlocked-shared-write", Severity.WARNING,
+                    f"{self.name}.{attr} is written under a lock in "
+                    f"`{locked_ws[0][1]}` but without one in "
+                    f"`{method}` — the unlocked write races every "
+                    f"locked reader",
+                    lineno, f"{self.name}.{attr}",
+                )
+                continue
+            thread_ws = [w for w in unlocked_ws if w[1] in reachable]
+            if thread_ws:
+                _, method, lineno = thread_ws[0]
+                self._add(
+                    rep, "unlocked-shared-write", Severity.WARNING,
+                    f"{self.name}.{attr} is written without a lock in "
+                    f"`{method}`, which runs on a background thread "
+                    f"(threading.Thread target reach) — readers on "
+                    f"other threads see torn/stale state",
+                    lineno, f"{self.name}.{attr}:thread",
+                )
+
+    def _report_blocking(self, rep):
+        seen = set()
+        for callname, method, lineno in self.blocking:
+            key = f"{self.name}.{method}:{callname}"
+            if key in seen:
+                continue
+            seen.add(key)
+            self._add(
+                rep, "blocking-call-under-lock", Severity.WARNING,
+                f"{self.name}.{method} calls `{callname}` while "
+                f"holding a lock — every thread contending that lock "
+                f"now waits on the blocking call too; move the slow "
+                f"work outside the critical section",
+                lineno, key,
+            )
+
+
+def lint_parsed(tree, lines, rel):
+    """The lock-discipline rules over an already-parsed module."""
+    rep = Report()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _ClassScan(node, rel, lines).report_into(rep)
+    return rep
+
+
+def lint_source(source, rel="<string>"):
+    """Run the lock-discipline rules over one source string."""
+    from .ast_lint import _parse_or_report
+
+    tree, lines, rep = _parse_or_report(source, rel)
+    if tree is None:
+        return rep
+    rep.extend(lint_parsed(tree, lines, rel))
+    return rep
+
+
+def lint_file(path, root=None):
+    from .ast_lint import lint_one_file
+
+    return lint_one_file(lint_parsed, path, root=root)
+
+
+def lint_path(path, root=None, skip_dirs=None):
+    """Recursively run the lock-discipline rules under ``path``."""
+    from .ast_lint import DEFAULT_SKIP_DIRS, lint_tree
+
+    return lint_tree(lint_parsed, path, root=root,
+                     skip_dirs=skip_dirs or DEFAULT_SKIP_DIRS)

@@ -41,6 +41,10 @@ them):
                      fleet topology.
 - ``broadcast-blowup``  non-scalar broadcasts that multiply bytes past
                      a threshold (materialized [B,H,S,S] masks etc.).
+- ``collective-divergence``  cond/switch branches whose COLLECTIVE
+                     SCHEDULES differ (rule body in
+                     :mod:`collective_lint` — ranks disagreeing on the
+                     predicate deadlock; the distributed-hang shape).
 """
 from __future__ import annotations
 
@@ -69,6 +73,7 @@ class LintConfig:
     min_broadcast_bytes: int = 128 << 20    # materialized-mask scale
     broadcast_ratio: float = 64.0
     min_upcast_bytes: int = 32 << 20        # bulk narrow->wide promotion
+    check_collective_divergence: bool = True
     mesh_axes: tuple | None = None          # None: use the global mesh
     #: auto mode only: accept axis names declared by an EXPLICITLY
     #: installed parallel.layout policy on top of the installed mesh's —
@@ -271,8 +276,14 @@ def lint_closed_jaxpr(closed, *, graph="", donated=None, config=None):
                     graph=graph, detail=f"const:{_aval_str(cv.aval)}",
                 ))
 
+    from .collective_lint import check_eqn_divergence
+
     for eqn, producers in _walk_eqns(jaxpr):
         prim = eqn.primitive.name
+
+        # ---- collective-divergence -----------------------------------
+        if cfg.check_collective_divergence and prim == "cond":
+            check_eqn_divergence(eqn, graph, rep)
 
         # ---- fp64-leak -----------------------------------------------
         if cfg.check_fp64:

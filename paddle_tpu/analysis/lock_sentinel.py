@@ -1,0 +1,387 @@
+"""Runtime lock sentinel — the dynamic half of the concurrency lint.
+
+:mod:`concurrency_lint` proves lock-order properties about code it can
+see; this module catches the inversions that only EXIST at runtime
+(locks spread across classes, orders that depend on which callback
+fired first) by instrumenting the locks themselves:
+
+- :class:`SentinelLock` wraps a ``threading.Lock``/``RLock`` and keeps
+  a per-thread stack of held locks. Acquiring B while holding A records
+  the A->B edge in one process-global order graph; the first time the
+  REVERSED edge is observed — any thread, any time earlier — the
+  sentinel emits a ``lock-order-inversion`` Finding with both witness
+  stacks. That is a deadlock that simply hasn't hit its interleaving
+  yet, caught without hanging anything.
+
+  Graph nodes are lock CLASSES (``ClassName.attr``), not instances —
+  the lockdep discipline: ordering rules are properties of the code,
+  and two instances of one class taking inconsistent class-level
+  orders is a latent deadlock the moment the instances coincide (it
+  also keeps the metric label space bounded). The deliberate trade:
+  an inversion between two DIFFERENT instances of the same class is
+  not separable from reentrancy and goes unreported.
+- Releases are timed: a hold longer than ``long_hold_s`` is a
+  ``lock-long-hold`` finding (the runtime twin of
+  ``blocking-call-under-lock``).
+- Everything is published: ``paddle_analysis_lock_inversions_total`` /
+  ``paddle_analysis_lock_long_holds_total`` counters plus a
+  flight-recorder event per detection, so a chaos run's bundle shows
+  WHERE the ordering went wrong.
+
+Opt-in, zero hot-path cost when off: :func:`maybe_instrument` is called
+by the threaded runtimes' constructors and does nothing unless
+``PADDLE_TPU_LOCK_SENTINEL=1`` (or :func:`instrument_locks` is called
+explicitly — tests and the chaos smokes do). Instrumentation wraps the
+object's lock attributes in place; locks already captured by a
+``threading.Condition`` attribute are skipped (the condition holds a
+reference to the RAW lock — wrapping would split the two into
+different objects and break ``wait()``).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import traceback
+
+from .findings import Finding, Severity
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+DEFAULT_LONG_HOLD_S = 1.0
+
+
+def enabled():
+    """True when the env var arms the sentinel process-wide."""
+    return os.environ.get("PADDLE_TPU_LOCK_SENTINEL", "").strip() \
+        not in ("", "0", "false", "False")
+
+
+def _call_site(skip_module=True):
+    """'file:line (function)' of the frame that touched the lock —
+    first frame outside this module."""
+    here = os.path.basename(__file__)
+    for fr in reversed(traceback.extract_stack(limit=12)):
+        if skip_module and os.path.basename(fr.filename) == here:
+            continue
+        return f"{fr.filename}:{fr.lineno} ({fr.name})"
+    return "<unknown>"
+
+
+class LockSentinel:
+    """Process-global order graph + findings sink for instrumented
+    locks. One instance per process (``get_sentinel``); tests swap a
+    fresh one in with ``use_sentinel``."""
+
+    def __init__(self, *, long_hold_s=None, clock=time.monotonic,
+                 registry=None, recorder=None):
+        if long_hold_s is None:
+            # constructed at module import (the process-wide default
+            # sentinel): a malformed env value must degrade to the
+            # default, not crash every `import paddle_tpu.analysis`
+            try:
+                long_hold_s = float(os.environ.get(
+                    "PADDLE_TPU_LOCK_LONG_HOLD_S", DEFAULT_LONG_HOLD_S
+                ))
+            except (TypeError, ValueError):
+                long_hold_s = DEFAULT_LONG_HOLD_S
+        self.long_hold_s = float(long_hold_s)
+        self.clock = clock
+        self._registry = registry
+        self._recorder = recorder
+        self._lock = threading.Lock()   # guards the graph + findings
+        self._tls = threading.local()
+        self._edges = {}        # (a, b) -> first-witness call site
+        self._fired_pairs = set()
+        self._long_hold_fired = set()
+        self._tokens = itertools.count(1)
+        # holds released by a DIFFERENT thread than their acquirer (a
+        # legal Lock hand-off): the acquirer's TLS entry is stale and
+        # must not feed the order graph — purged lazily by token
+        self._cancelled = set()
+        self.findings = []
+        self.instrumented = []  # lock names, registration order
+
+    # ------------------------------------------------------------ plumbing
+    def _registry_or_default(self):
+        if self._registry is not None:
+            return self._registry
+        from ..observability import get_registry
+
+        return get_registry()
+
+    def _count(self, name, help_text, **labels):
+        try:
+            self._registry_or_default().counter(name, help=help_text)\
+                .inc(**labels)
+        except Exception:
+            pass
+
+    def _note(self, event, **info):
+        try:
+            rec = self._recorder
+            if rec is None:
+                from ..observability import get_flight_recorder
+
+                rec = get_flight_recorder()
+            rec.note(event, **info)
+        except Exception:
+            pass
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        elif held and self._cancelled:
+            # purge entries whose hold was released on ANOTHER thread
+            # (Lock hand-off): they no longer protect anything here
+            with self._lock:
+                held[:] = [e for e in held
+                           if e[3] not in self._cancelled]
+        return held
+
+    # ------------------------------------------------------------- events
+    def note_acquired(self, name):
+        """Called by a SentinelLock AFTER its inner lock is acquired.
+        Returns the hold token the matching release must present."""
+        held = self._held()
+        site = _call_site()
+        finding = None
+        token = next(self._tokens)
+        with self._lock:
+            for h, _t0, h_site, _tok in held:
+                if h == name:
+                    continue  # reentrant RLock hold
+                self._edges.setdefault((h, name),
+                                       f"{h_site} -> {site}")
+                rev = self._edges.get((name, h))
+                pair = tuple(sorted((h, name)))
+                if rev is not None and pair not in self._fired_pairs:
+                    self._fired_pairs.add(pair)
+                    finding = Finding(
+                        rule="lock-order-inversion",
+                        severity=Severity.ERROR,
+                        message=(
+                            f"runtime lock-order inversion: this thread "
+                            f"acquired {name!r} while holding {h!r}, "
+                            f"but the opposite order was also observed "
+                            f"({name!r} then {h!r} at {rev}) — the two "
+                            f"interleavings deadlock; current site: "
+                            f"{site}"
+                        ),
+                        graph="runtime", where=site,
+                        detail=f"runtime:{pair[0]}<->{pair[1]}",
+                    )
+                    self.findings.append(finding)
+        held.append((name, self.clock(), site, token))
+        if finding is not None:
+            self._count(
+                "paddle_analysis_lock_inversions_total",
+                "runtime lock-order inversions seen by the sentinel, "
+                "by lock pair",
+                pair=f"{finding.detail}",
+            )
+            self._note("lock_inversion", detail=finding.detail,
+                       where=site)
+        return token
+
+    def note_released(self, name, token=None):
+        """Pop this thread's matching hold. A release whose token was
+        acquired on a DIFFERENT thread (Lock hand-off) cancels that
+        token instead, so the acquirer's stale entry is purged on its
+        next touch rather than poisoning its order graph."""
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name and (token is None
+                                       or held[i][3] == token):
+                _, t0, site, _tok = held.pop(i)
+                dur = self.clock() - t0
+                if dur > self.long_hold_s:
+                    self._long_hold(name, dur, site)
+                return
+        if token is not None:
+            with self._lock:
+                self._cancelled.add(token)
+
+    def _long_hold(self, name, dur, site):
+        with self._lock:
+            first = name not in self._long_hold_fired
+            if first:
+                self._long_hold_fired.add(name)
+                self.findings.append(Finding(
+                    rule="lock-long-hold", severity=Severity.WARNING,
+                    message=(
+                        f"lock {name!r} held {dur:.3f}s (> "
+                        f"{self.long_hold_s:.3f}s) — acquired at "
+                        f"{site}; every contending thread stalled that "
+                        f"long"
+                    ),
+                    graph="runtime", where=site,
+                    detail=f"runtime:long-hold:{name}",
+                ))
+        self._count(
+            "paddle_analysis_lock_long_holds_total",
+            "lock holds exceeding the sentinel's long-hold threshold, "
+            "by lock",
+            lock=name,
+        )
+        if first:
+            self._note("lock_long_hold", lock=name,
+                       seconds=round(dur, 4), where=site)
+
+    # ------------------------------------------------------------ readouts
+    def inversions(self):
+        with self._lock:
+            return [f for f in self.findings
+                    if f.rule == "lock-order-inversion"]
+
+    def long_holds(self):
+        with self._lock:
+            return [f for f in self.findings
+                    if f.rule == "lock-long-hold"]
+
+    def edge_count(self):
+        with self._lock:
+            return len(self._edges)
+
+    def reset(self):
+        with self._lock:
+            self._edges.clear()
+            self._fired_pairs.clear()
+            self._long_hold_fired.clear()
+            self.findings.clear()
+
+
+class SentinelLock:
+    """Drop-in wrapper over a ``threading.Lock``/``RLock`` that reports
+    acquire/release to the sentinel. Supports the full lock protocol
+    (``with``, ``acquire(blocking=, timeout=)``, ``locked()``) so it
+    can sit wherever the raw lock sat."""
+
+    __slots__ = ("_inner", "name", "_sentinel", "_active")
+
+    def __init__(self, inner, name, sentinel=None):
+        self._inner = inner
+        self.name = name
+        self._sentinel = sentinel or get_sentinel()
+        # hold tokens, acquisition order. Mutated only while the inner
+        # lock is held (append post-acquire, pop pre-release), so the
+        # lock itself serializes access — including a hand-off release
+        # from a thread that never acquired.
+        self._active = []
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._active.append(
+                self._sentinel.note_acquired(self.name)
+            )
+        return ok
+
+    def release(self):
+        token = self._active.pop() if self._active else None
+        self._sentinel.note_released(self.name, token)
+        self._inner.release()
+
+    def locked(self):
+        inner = self._inner
+        fn = getattr(inner, "locked", None)
+        if fn is not None:
+            return fn()
+        # RLock grows .locked() only in py3.14; _is_owned covers the
+        # own-thread case (a reentrant probe would lie), then probe
+        # without touching the sentinel bookkeeping (a query, not a
+        # real hold)
+        owned = getattr(inner, "_is_owned", None)
+        if owned is not None and owned():
+            return True
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"SentinelLock({self.name!r}, {self._inner!r})"
+
+
+def instrument_locks(obj, *, name=None, sentinel=None, attrs=None):
+    """Wrap ``obj``'s lock attributes in :class:`SentinelLock`s, in
+    place. Returns the list of instrumented lock names
+    (``ClassName.attr``). Skips locks a ``threading.Condition``
+    attribute of the same object wraps (the condition keeps a raw-lock
+    reference that instrumentation cannot follow), and locks that are
+    already instrumented."""
+    sent = sentinel or get_sentinel()
+    prefix = name or type(obj).__name__
+    cond_locks = set()
+    attr_names = attrs or [a for a in vars(obj)]
+    for a in attr_names:
+        v = getattr(obj, a, None)
+        if isinstance(v, threading.Condition):
+            cond_locks.add(id(v._lock))
+    done = []
+    for a in attr_names:
+        v = getattr(obj, a, None)
+        if isinstance(v, SentinelLock) or not isinstance(
+            v, _LOCK_TYPES
+        ):
+            continue
+        if id(v) in cond_locks:
+            continue
+        lock_name = f"{prefix}.{a}"
+        setattr(obj, a, SentinelLock(v, lock_name, sentinel=sent))
+        done.append(lock_name)
+    with sent._lock:
+        sent.instrumented.extend(done)
+    if done:
+        try:
+            sent._registry_or_default().gauge(
+                "paddle_analysis_lock_instrumented",
+                help="locks currently wrapped by the runtime sentinel",
+            ).set(float(len(sent.instrumented)))
+        except Exception:
+            pass
+    return done
+
+
+def maybe_instrument(obj, *, name=None):
+    """Constructor seam for the threaded runtimes: a no-op unless the
+    ``PADDLE_TPU_LOCK_SENTINEL`` env var arms the sentinel."""
+    if not enabled():
+        return []
+    return instrument_locks(obj, name=name)
+
+
+# one process-wide sentinel: lock order is a process property
+_SENTINEL = LockSentinel()
+
+
+def get_sentinel() -> LockSentinel:
+    return _SENTINEL
+
+
+class use_sentinel:
+    """Context manager installing a replacement sentinel (tests)."""
+
+    def __init__(self, sentinel):
+        self.sentinel = sentinel
+        self._prev = None
+
+    def __enter__(self):
+        global _SENTINEL
+        self._prev, _SENTINEL = _SENTINEL, self.sentinel
+        return self.sentinel
+
+    def __exit__(self, *exc):
+        global _SENTINEL
+        _SENTINEL = self._prev
+        return False

@@ -120,7 +120,11 @@ class TraceGuard:
             ),
             graph=str(key), where=origin, detail=detail,
         )
-        self.findings.append(f)
+        # under the lock: reset() clears this list under it, and an
+        # unlocked append would race that clear (found by the repo's
+        # own unlocked-shared-write pass)
+        with self._lock:
+            self.findings.append(f)
         from .. import profiler
 
         profiler.record_lint_event(f"lint::recompile-storm::{key}")

@@ -49,7 +49,10 @@ class AsyncSaver:
             try:
                 job()
             except Exception as e:  # surfaced, never fatal to training
-                self.last_error = e
+                with self._lock:
+                    # writer-thread publication: readers poll last_error
+                    # from the train thread (unlocked-shared-write)
+                    self.last_error = e
                 if self._on_error is not None:
                     try:
                         self._on_error(e)

@@ -213,6 +213,9 @@ class CheckpointManager:
         self._preempt_rethrow = {}
         self._preempt_thread = None
         self._init_metrics(registry or get_registry())
+        from ..analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
         self._saver = (
             AsyncSaver(on_error=self._on_writer_error)
             if self.async_saves else None

@@ -177,6 +177,9 @@ class PrefillWorker:
         self._listener.listen(16)
         self.port = self._listener.getsockname()[1]
         self._accept_thread = None
+        from ...analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
         self.served = 0
         self.errors = 0
 
@@ -251,12 +254,17 @@ class PrefillWorker:
                             f"unknown request kind {req.get('kind')!r}"
                         )
                     self._handle_prefill(conn, req)
-                    self.served += 1
+                    with self._lock:
+                        # per-connection threads all bump these; an
+                        # unlocked += tears under contention
+                        self.served += 1
                 except TransferError:
-                    self.errors += 1
+                    with self._lock:
+                        self.errors += 1
                     return  # send path broken; nothing else to say
                 except Exception as e:
-                    self.errors += 1
+                    with self._lock:
+                        self.errors += 1
                     try:
                         send_frame(conn, {"kind": "error",
                                           "error": repr(e)})

@@ -252,6 +252,10 @@ class FleetRouter:
         self._watch_thread = None
         self._watched_step = None
         self.last_watch_result = None
+        # opt-in runtime lock sentinel (PADDLE_TPU_LOCK_SENTINEL=1)
+        from ...analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
 
     # ---------------------------------------------------------- lifecycle
     def start(self):
@@ -274,7 +278,8 @@ class FleetRouter:
             # baseline = the newest commit ALREADY on disk: the fleet
             # is assumed launched from it, only new commits rotate
             found = self._latest_commit()
-            self._watched_step = found[0] if found else None
+            with self._lock:
+                self._watched_step = found[0] if found else None
             self._watch_thread = threading.Thread(
                 target=self._watch_ckpt_loop,
                 name="paddle-fleet-ckpt-watch", daemon=True,
@@ -370,6 +375,14 @@ class FleetRouter:
 
     # ---------------------------------------------------------- placement
     def _eligible(self, now, exclude=()):
+        return [r for r, _ in self._eligible_snapshot(now, exclude)]
+
+    def _eligible_snapshot(self, now, exclude=()):
+        """Eligible replicas WITH their load-score inputs, all read
+        under the lock: the scrape thread rewrites ``r.status`` /
+        ``r.healthy`` concurrently, and scoring from unlocked reads
+        mixes fields of two different scrapes (the health-map race the
+        concurrency lint flags)."""
         out = []
         with self._lock:
             for r in self.replicas:
@@ -381,7 +394,13 @@ class FleetRouter:
                     continue
                 if now - r.status_time > self.status_ttl_s:
                     continue
-                out.append(r)
+                st = r.status or {}
+                out.append((r, (
+                    float(st.get("queue_depth") or 0)
+                    + float(st.get("active") or 0)
+                    + float(r.in_flight),
+                    float(st.get("free_pages") or 0),
+                )))
         return out
 
     def _affinity_key(self, parsed):
@@ -420,11 +439,11 @@ class FleetRouter:
             with self._lock:
                 affine = self._affinity.get(affinity_key)
         best, best_score = None, None
-        for r in self._eligible(now, exclude):
-            st = r.status or {}
-            pressure = 1.0 + float(st.get("queue_depth") or 0) \
-                + float(st.get("active") or 0) + float(r.in_flight)
-            capacity = 1.0 + float(st.get("free_pages") or 0)
+        for r, (pressure0, free_pages) in self._eligible_snapshot(
+            now, exclude
+        ):
+            pressure = 1.0 + pressure0
+            capacity = 1.0 + free_pages
             score = pressure / capacity
             if affine == r.index:
                 score /= 1.0 + self.affinity_bonus
@@ -765,8 +784,9 @@ class FleetRouter:
             if found is None:
                 continue
             step, path = found
-            if self._watched_step is not None and \
-                    step <= self._watched_step:
+            with self._lock:
+                watched = self._watched_step
+            if watched is not None and step <= watched:
                 continue
             out = self.reload_fleet(
                 path, version=None,
@@ -774,12 +794,15 @@ class FleetRouter:
             )
             if out is None:
                 continue  # a walk was in flight; retry next poll
-            self.last_watch_result = dict(out, step=step, path=path)
-            if out["ok"]:
-                # only a fully-rotated fleet advances the marker: a
-                # failed walk is retried on the next poll (replicas
-                # already rotated are version-idempotent)
-                self._watched_step = step
+            # watcher-thread publications go under the lock: admin
+            # readers (/replicas, tests) poll these from other threads
+            with self._lock:
+                self.last_watch_result = dict(out, step=step, path=path)
+                if out["ok"]:
+                    # only a fully-rotated fleet advances the marker: a
+                    # failed walk is retried on the next poll (replicas
+                    # already rotated are version-idempotent)
+                    self._watched_step = step
 
     # ------------------------------------------------------------ routing
     def _route(self, h, body, stream, parsed=None):

@@ -126,6 +126,9 @@ class ServingFrontend:
         # (time, repr) of swallowed step errors — bounded so a
         # persistently failing step cannot grow memory without limit.
         self.driver_errors = collections.deque(maxlen=256)
+        from ..analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
 
     # ---------------------------------------------------------- lifecycle
     def start(self):

@@ -671,6 +671,60 @@ class PagedServingEngine(ServingEngine):
         self._seqs[row] = _Seq(handle, t0)
         self._append(row, t0)
 
+    # ------------------------------------------------------- AOT warmup
+    def warmup(self, aot_cache=None, buckets=None):
+        """Extend the base warmup with the prefix-cache warm path: the
+        per-bucket gather-pages program and the per-(bucket,
+        tail-bucket) chunked-prefill ladder. Without this the FIRST
+        warm hit per shape paid one untracked compile mid-request (the
+        PR 14 residual) — now the whole warm-path inventory compiles
+        (or AOT-cache-loads) before READY, and the trace guard's
+        ``serving::gather_pages`` / ``serving::chunk_prefill`` entries
+        are recorded up front, so any LATER compile on those keys is a
+        storm finding, not silence."""
+        stats = super().warmup(aot_cache=aot_cache, buckets=buckets)
+        if self.prefix_cache is None:
+            return stats
+        from ..jit import aot_cache as aot_mod
+
+        cache = aot_mod.resolve(aot_cache)
+        if buckets is None:
+            buckets = self._warmup_buckets()
+        try:
+            for b in buckets:
+                ps = self.page_size
+                gargs = (self._flat,
+                         jnp.zeros((b // ps,), jnp.int32))
+                self._warm_one(
+                    cache, f"gather_b{b}", ("gather", b),
+                    self._gather_fn(b), gargs,
+                    lambda comp, b=b: self._gather_fns
+                    .__setitem__(b, comp), stats,
+                )
+                blk = self.pool.alloc(b)
+                try:
+                    flat = _flatten(blk.caches)
+                    for tb in self._tail_buckets(b):
+                        cargs = (
+                            self._params, self._buffers,
+                            jnp.zeros((1, tb), jnp.int32),
+                            jnp.int32(1), jnp.int32(0), flat,
+                            jnp.float32(self.temperature), self._key,
+                        )
+                        self._warm_one(
+                            cache, f"chunk_b{b}_t{tb}",
+                            ("chunk", b, tb), self._chunk_fn(b, tb),
+                            cargs,
+                            lambda comp, b=b, tb=tb: self._chunk_fns
+                            .__setitem__((b, tb), comp), stats,
+                        )
+                finally:
+                    self.pool.free(blk)
+        finally:
+            # lowering traced the bodies — restore concrete weights
+            self._restore_net_state()
+        return stats
+
     # ------------------------------------------------------ decode loop
     def _grow_pages(self):
         """Demand growth: before the decode step, any row whose next

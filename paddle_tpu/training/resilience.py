@@ -222,6 +222,9 @@ class AnomalySentinel:
                  "(skip|rollback|abort)",
         )
         (registry or get_registry()).register_all([self.anomalies])
+        from ..analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
 
     # ------------------------------------------------------------- wiring
     @property
@@ -512,6 +515,9 @@ class TrainWatchdog:
         (registry or get_registry()).register_all([self.fires])
         if self.heartbeat_dir:
             os.makedirs(self.heartbeat_dir, exist_ok=True)
+        from ..analysis.lock_sentinel import maybe_instrument
+
+        maybe_instrument(self)
 
     @staticmethod
     def _resolve_rank(rank):
@@ -632,9 +638,13 @@ class TrainWatchdog:
                 continue
             if now - mtime <= self.heartbeat_timeout_s:
                 continue
-            if self._peer_fired.get(name) == mtime:
-                continue  # already fired for this staleness episode
-            self._peer_fired[name] = mtime
+            # monitor-thread state goes under the lock: tests and the
+            # attach()ing thread call check() too, and an unlocked dict
+            # write races them (unlocked-shared-write)
+            with self._lock:
+                if self._peer_fired.get(name) == mtime:
+                    continue  # already fired for this staleness episode
+                self._peer_fired[name] = mtime
             info = {"rank": int(name),
                     "stale_s": round(now - mtime, 3)}
             self._fire(self.KIND_MISSED, **info)
@@ -656,9 +666,12 @@ class TrainWatchdog:
             # the whole point: the bundle lands BEFORE the job dies
             # silently (nonblocking — the wedged step's refs are by
             # definition not ready)
-            self.last_dump_path = self.recorder.dump(
+            path = self.recorder.dump(
                 reason=f"watchdog:{kind}", sync=False
             )
+            with self._lock:
+                # published to other threads (the smoke asserts on it)
+                self.last_dump_path = path
         except Exception:
             pass
         if self.on_fire is not None:

@@ -573,3 +573,65 @@ def test_scheduler_fits_predicate_no_skip():
     got = s.pop_next(fits=lambda r: True)
     assert got is h1
     assert s.pop_next() is h2
+
+
+def test_warmup_covers_gather_and_chunk_programs(net):
+    """Closing the PR 14 residual: warmup() must pre-compile (and
+    trace-guard-register) the warm path's gather-pages and
+    chunked-prefill inventory, so the FIRST warm hit pays zero
+    compiles — and any later compile on those keys is a storm finding,
+    not silence."""
+    eng = PagedServingEngine(
+        net, max_batch_size=2, max_seq_len=64, page_size=8,
+        min_bucket=16, prefix_cache=True,
+    )
+    stats = eng.warmup()
+    counts = eng.trace_guard.compile_counts()
+    # one gather program per prompt bucket, one chunk program per
+    # (bucket, tail-bucket) pair — all registered with the guard
+    n_buckets = len(eng._warmup_buckets())
+    assert counts.get("serving::gather_pages") == n_buckets
+    n_pairs = sum(len(eng._tail_buckets(b))
+                  for b in eng._warmup_buckets())
+    assert counts.get("serving::chunk_prefill") == n_pairs
+    assert stats["programs"] >= 2 * n_buckets + n_pairs + 1
+    # warmup is idempotent: a second call finds everything warmed
+    again = eng.warmup()
+    assert again["programs"] == 0
+    assert again["aot_hits"] == 0 and again["aot_saves"] == 0
+    # warm traffic: a repeat-prefix request HITS and adds ZERO new
+    # compile entries anywhere (the first-warm-hit compile is gone)
+    prompt = [int(t) for t in RNG.randint(1, 64, size=19)]
+    h1 = eng.submit(np.array([prompt]), max_new_tokens=4)
+    eng.run_until_idle()
+    before = dict(eng.trace_guard.compile_counts())
+    h2 = eng.submit(np.array([prompt]), max_new_tokens=4)
+    eng.run_until_idle()
+    assert h1.tokens == h2.tokens
+    assert dict(eng.trace_guard.compile_counts()) == before
+    assert int(eng.prefix_cache.hits.value) >= 1
+    assert eng.trace_guard.findings == []
+    eng.close()
+    _assert_drained(eng)
+
+
+def test_warmup_gather_chunk_round_trips_aot_cache(net, tmp_path):
+    """A relaunched prefix engine with the same geometry must LOAD the
+    gather/chunk executables from the AOT cache instead of compiling
+    anything."""
+    eng = PagedServingEngine(
+        net, max_batch_size=2, max_seq_len=32, page_size=8,
+        min_bucket=16, prefix_cache=True,
+    )
+    stats = eng.warmup(aot_cache=str(tmp_path))
+    assert stats["aot_saves"] == stats["programs"]
+    eng.close()
+    eng2 = PagedServingEngine(
+        net, max_batch_size=2, max_seq_len=32, page_size=8,
+        min_bucket=16, prefix_cache=True,
+    )
+    stats2 = eng2.warmup(aot_cache=str(tmp_path))
+    assert stats2["aot_hits"] == stats2["programs"], stats2
+    assert stats2["programs"] == stats["programs"]
+    assert eng2.compile_cache_hits == stats2["programs"]
+    eng2.close()

@@ -21,6 +21,12 @@ through the shared chaos harness (``paddle_tpu.chaos``):
    resumes from the last committed step with ZERO duplicated log steps
    (the PR 5 dedup-across-restarts discipline).
 
+Every child runs with the LOCK SENTINEL armed
+(``PADDLE_TPU_LOCK_SENTINEL=1``): the threaded runtimes' locks
+(checkpoint manager, watchdog, anomaly sentinel) are instrumented and
+the chaos round must finish with ZERO runtime lock-order inversions —
+the dynamic counterpart of the static concurrency lint.
+
 Exit 0 when every path recovers as specified, 1 with a named failure.
 
     python tools/train_chaos_smoke.py      # or: make train-chaos-smoke
@@ -57,6 +63,9 @@ def run_child(script, work, *args, timeout=300):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    # every chaos child runs with instrumented locks: a round that
+    # finishes with a runtime lock-order inversion is a latent deadlock
+    env["PADDLE_TPU_LOCK_SENTINEL"] = "1"
     r = subprocess.run(
         [sys.executable, script, work, *map(str, args)], env=env,
         capture_output=True, text=True, timeout=timeout,
@@ -145,6 +154,12 @@ ROLLBACK_CHILD = textwrap.dedent("""
             for key, n in sentinel.anomalies.series().items()
         }}
         mgr.finalize()
+    from paddle_tpu.analysis import lock_sentinel as ls
+    sent = ls.get_sentinel()
+    out["lock_sentinel"] = {{
+        "instrumented": len(sent.instrumented),
+        "inversions": [str(f) for f in sent.inversions()],
+    }}
     print("RESULT " + json.dumps(out), flush=True)
 """)
 
@@ -178,9 +193,19 @@ def scenario_rollback(work):
             }
             fail("rollback-trajectory",
                  f"amp={amp}: recovered run != uninterrupted: {diff}")
+        sent = cha.get("lock_sentinel") or {}
+        if sent.get("instrumented", 0) < 2:
+            fail("rollback-sentinel-armed",
+                 f"amp={amp}: lock sentinel instrumented only "
+                 f"{sent.get('instrumented')} locks: {sent}")
+        if sent.get("inversions"):
+            fail("rollback-lock-inversion",
+                 f"amp={amp}: runtime lock-order inversions during the "
+                 f"chaos round: {sent['inversions']}")
         print(f"rollback[{amp}]: NaN at step {NAN_STEP} -> rollback -> "
               f"replayed trajectory EXACTLY equals the uninterrupted "
-              f"run ({len(ref['traj'])} steps)")
+              f"run ({len(ref['traj'])} steps); lock sentinel: "
+              f"{sent['instrumented']} locks armed, 0 inversions")
 
 
 # ------------------------------------------------- 2. wedge -> watchdog
@@ -230,11 +255,17 @@ WEDGE_CHILD = textwrap.dedent("""
     y = Tensor(jax.numpy.asarray(rng.randn(8, 8), "float32"))
     run_resilient(trainer, lambda s: ([x], [y]), steps=6)
     wd.stop()
+    from paddle_tpu.analysis import lock_sentinel as ls
+    sent = ls.get_sentinel()
     print("RESULT " + json.dumps({{
         "fires": fires, "wedge_t": wedge_t[0],
         "series": {{str(dict(k)): v
                     for k, v in wd.fires.series().items()}},
         "bundle": wd.last_dump_path,
+        "lock_sentinel": {{
+            "instrumented": len(sent.instrumented),
+            "inversions": [str(f) for f in sent.inversions()],
+        }},
     }}), flush=True)
 """)
 
@@ -271,9 +302,16 @@ def scenario_wedge(work):
         fail("wedge-bundle-reason", parsed["reason"])
     if not parsed["steps"]:
         fail("wedge-bundle-steps", "bundle carries no step records")
+    sent = res.get("lock_sentinel") or {}
+    if sent.get("instrumented", 0) < 1:
+        fail("wedge-sentinel-armed", f"{sent}")
+    if sent.get("inversions"):
+        fail("wedge-lock-inversion", f"{sent['inversions']}")
     print(f"wedge: watchdog fired {latency:.2f}s into a "
           f"{WEDGE_SECONDS:.0f}s wedge (stall budget "
-          f"{WATCHDOG_STALL_S:.0f}s) with a flight bundle on disk")
+          f"{WATCHDOG_STALL_S:.0f}s) with a flight bundle on disk; "
+          f"lock sentinel: {sent['instrumented']} locks armed, "
+          f"0 inversions")
 
 
 # -------------------------------------- 3. kill-rank -> elastic resume
