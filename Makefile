@@ -6,7 +6,7 @@ ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
-	reload-smoke train-chaos-smoke prefix-smoke smoke-all
+	reload-smoke train-chaos-smoke prefix-smoke trace-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step, incl. collective-divergence) + AST lint +
@@ -122,10 +122,21 @@ train-chaos-smoke:
 prefix-smoke:
 	$(ENV) $(PY) tools/prefix_smoke.py
 
+# Distributed-tracing gate: a prefill worker + two prefill-attached
+# replica subprocesses behind the router under real SSE load. At least
+# one request must stitch into ONE trace with spans from all three
+# processes (router root/attempt, replica queue-wait/prefill/decode —
+# decode as a single span with step events — and the worker's span
+# carried home in the PKV2 frame header), child spans causally ordered
+# within each process, and the router /metrics exposition must carry
+# parseable trace_id exemplars.
+trace-smoke:
+	$(ENV) $(PY) tools/trace_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
-		train-chaos-smoke prefix-smoke
+		train-chaos-smoke prefix-smoke trace-smoke
 	@echo "smoke-all: every gate green"
 
 test:

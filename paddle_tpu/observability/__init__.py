@@ -54,6 +54,22 @@ from .step_meter import (
     peak_flops_per_device,
     set_step_meter,
 )
+from .tracing import (
+    Span,
+    SpanBuffer,
+    SpanContext,
+    Tracer,
+    chrome_trace,
+    export_chrome,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    remote_child_span,
+    set_process_name,
+    set_tracer,
+    stitch,
+    trace_payload,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -66,4 +82,8 @@ __all__ = [
     "device_memory_stats", "batch_geometry",
     "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
     "tagged_snapshot", "merge_snapshots", "merged_report",
+    "Span", "SpanBuffer", "SpanContext", "Tracer",
+    "get_tracer", "set_tracer", "set_process_name",
+    "parse_traceparent", "format_traceparent", "remote_child_span",
+    "stitch", "chrome_trace", "export_chrome", "trace_payload",
 ]

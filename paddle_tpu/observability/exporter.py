@@ -9,18 +9,44 @@ The registry's wire formats:
   ``{series_name: [(labels, value), ...]}`` — used by the smoke gate to
   assert the exposition is well-formed without a prometheus dependency.
 - :class:`MetricsServer` serves ``/metrics`` (text), ``/metrics.json``
-  (registry snapshot), and ``/flight`` (the flight recorder's current
-  bundle) from a daemon thread over ``http.server`` — no third-party
+  (registry snapshot), ``/flight`` (the flight recorder's current
+  bundle), and ``/trace`` (recent finished spans from the process
+  tracer) from a daemon thread over ``http.server`` — no third-party
   server; scraping a training job is one stdlib import away.
 """
 from __future__ import annotations
 
 import json
 import math
+import os
 import re
 import threading
 
 from .registry import get_registry
+
+# OpenMetrics-style exemplars in the text exposition are behind a flag:
+# classic Prometheus text-format scrapers reject the suffix, so emitting
+# it must be an explicit choice (env or prometheus_text(exemplars=True))
+EXEMPLARS_ENV = "PADDLE_TPU_METRICS_EXEMPLARS"
+
+
+def _exemplars_enabled(flag):
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get(EXEMPLARS_ENV, "").lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+def _fmt_exemplar(ex):
+    """OpenMetrics exemplar suffix: `` # {trace_id="..."} <value>``."""
+    if not ex:
+        return ""
+    labels = {
+        k: v for k, v in ex.items() if k not in ("value",)
+    }
+    return (f" # {_fmt_labels(labels) or '{}'}"
+            f" {_fmt_value(float(ex.get('value', 0.0)))}")
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
@@ -63,10 +89,22 @@ def _fmt_value(v):
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
-def prometheus_text(registry=None):
+def prometheus_text(registry=None, exemplars=None):
     """Render ``registry`` (default: the process registry) in Prometheus
-    text exposition format 0.0.4."""
+    text exposition format 0.0.4.
+
+    ``exemplars=True`` (or ``PADDLE_TPU_METRICS_EXEMPLARS=1`` when the
+    argument is left ``None``) appends OpenMetrics-style
+    `` # {trace_id="..."} <value>`` exemplar suffixes to counter and
+    histogram-bucket samples that have one recorded — the hook from a
+    latency bucket straight to a distributed trace. Off by default:
+    classic text-format scrapers reject the suffix."""
     registry = registry or get_registry()
+    ex_on = _exemplars_enabled(exemplars)
+
+    def ex_suffix(ex):
+        return _fmt_exemplar(ex) if ex_on and ex else ""
+
     lines = []
     for m in registry.metrics():
         name = _sanitize_name(m.prom_name)
@@ -82,7 +120,10 @@ def prometheus_text(registry=None):
             total = name if name.endswith("_total") else name + "_total"
             series = d.get("series", [])
             if not series:
-                lines.append(f"{total} {_fmt_value(d['value'])}")
+                lines.append(
+                    f"{total} {_fmt_value(d['value'])}"
+                    f"{ex_suffix(d.get('exemplar'))}"
+                )
             else:
                 # one family must not mix a bare aggregate with labeled
                 # children — sum(rate(...)) would double-count. Emit the
@@ -92,6 +133,7 @@ def prometheus_text(registry=None):
                     lines.append(
                         f"{total}{_fmt_labels(s['labels'])} "
                         f"{_fmt_value(s['value'])}"
+                        f"{ex_suffix(s.get('exemplar'))}"
                     )
                 rest = d["value"] - sum(s["value"] for s in series)
                 if rest:
@@ -116,6 +158,7 @@ def prometheus_text(registry=None):
                 le_s = "+Inf" if math.isinf(le) else _fmt_value(float(le))
                 lines.append(
                     f'{name}_bucket{{le="{le_s}"}} {b["count"]}'
+                    f"{ex_suffix(b.get('exemplar'))}"
                 )
             lines.append(f"{name}_sum {_fmt_value(d.get('sum', 0.0))}")
             lines.append(f"{name}_count {d.get('count', 0)}")
@@ -135,9 +178,15 @@ _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
 _LABELS_BLOCK = (
     r'(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"\s*,?\s*)*'
 )
+# OpenMetrics exemplar suffix: `` # {labels} value [timestamp]`` — the
+# same quoted-pair labels grammar as the sample's own block (an
+# exemplar trace_id may hold escaped chars too), value/timestamp as
+# bare tokens validated numerically after the match
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>" + _LABELS_BLOCK + r")\})?\s+(?P<value>\S+)\s*$"
+    r"(?:\{(?P<labels>" + _LABELS_BLOCK + r")\})?\s+(?P<value>[^\s#]+)"
+    r"(?:\s+#\s*\{(?P<ex_labels>" + _LABELS_BLOCK + r")\}"
+    r"\s+(?P<ex_value>[^\s#]+)(?:\s+(?P<ex_ts>[^\s#]+))?)?\s*$"
 )
 _UNESCAPE_RE = re.compile(r"\\(.)")
 
@@ -150,31 +199,65 @@ def _unescape_label(v):
     )
 
 
-def parse_prometheus_text(text):
+def _parse_value(v, line):
+    value = {"+Inf": math.inf, "-Inf": -math.inf, "NaN": math.nan}.get(v)
+    if value is None:
+        try:
+            value = float(v)
+        except ValueError:
+            raise ValueError(
+                f"malformed sample value {v!r} on line: {line!r}"
+            ) from None
+    return value
+
+
+def parse_prometheus_text(text, exemplars=False):
     """Parse exposition text into ``{series_name: [(labels, value)]}``.
 
     Strict about sample-line shape (a malformed line raises ValueError,
     which is exactly what the smoke gate wants to catch); comment and
-    blank lines are skipped."""
+    blank lines are skipped. Exemplar suffixes (`` # {...} value``) are
+    validated on EVERY line — a malformed exemplar is a clear,
+    dedicated ValueError, never silently dropped; with
+    ``exemplars=True`` the return is ``(series, exemplar_list)`` where
+    each exemplar entry is ``{"series", "labels", "exemplar_labels",
+    "value"}``."""
     out = {}
+    found = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
         m = _SAMPLE_RE.match(line)
         if m is None:
+            if "#" in line:
+                raise ValueError(
+                    "malformed exemplar (expected "
+                    "'# {label=\"v\",...} value [timestamp]') on "
+                    f"line: {line!r}"
+                )
             raise ValueError(f"malformed exposition line: {line!r}")
         labels = {}
         if m.group("labels"):
             for lm in _LABEL_RE.finditer(m.group("labels")):
                 labels[lm.group(1)] = _unescape_label(lm.group(2))
-        v = m.group("value")
-        value = {"+Inf": math.inf, "-Inf": -math.inf,
-                 "NaN": math.nan}.get(v)
-        if value is None:
-            value = float(v)
-        out.setdefault(m.group("name"), []).append((labels, value))
-    return out
+        value = _parse_value(m.group("value"), line)
+        name = m.group("name")
+        out.setdefault(name, []).append((labels, value))
+        if m.group("ex_value") is not None:
+            ex_labels = {}
+            for lm in _LABEL_RE.finditer(m.group("ex_labels") or ""):
+                ex_labels[lm.group(1)] = _unescape_label(lm.group(2))
+            ex_value = _parse_value(m.group("ex_value"), line)
+            if m.group("ex_ts") is not None:
+                _parse_value(m.group("ex_ts"), line)  # validate only
+            found.append({
+                "series": name,
+                "labels": labels,
+                "exemplar_labels": ex_labels,
+                "value": ex_value,
+            })
+    return (out, found) if exemplars else out
 
 
 class MetricsServer:
@@ -230,6 +313,13 @@ class MetricsServer:
                                 ),
                                 default=str,
                             ),
+                            "application/json",
+                        )
+                    elif path == "/trace":
+                        from .tracing import trace_payload
+
+                        self._send(
+                            json.dumps(trace_payload(), default=str),
                             "application/json",
                         )
                     else:

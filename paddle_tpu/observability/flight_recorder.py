@@ -152,6 +152,17 @@ class FlightRecorder:
             registry_snap = self.registry.snapshot()
         except Exception:
             registry_snap = {}
+        # name the requests in flight: a NaN/watchdog bundle that says
+        # WHICH traces were mid-decode turns "something was running"
+        # into a /trace (or serve_bench --trace-out) lookup
+        try:
+            from .tracing import get_tracer
+
+            tracer = get_tracer()
+            traces_in_flight = tracer.active_trace_ids()
+            spans_in_flight = tracer.active_spans()
+        except Exception:
+            traces_in_flight, spans_in_flight = [], []
         return _jsonable({
             "reason": reason,
             "time": time.time(),
@@ -159,6 +170,8 @@ class FlightRecorder:
             "exception": exc_info,
             "steps": steps,
             "events": events,
+            "traces_in_flight": traces_in_flight,
+            "spans_in_flight": spans_in_flight,
             "registry": registry_snap,
             "env": info,
         })

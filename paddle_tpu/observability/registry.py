@@ -77,20 +77,28 @@ class Counter(_Metric):
         super().__init__(name, help=help, unit=unit, prom_name=prom_name)
         self._value = 0
         self._series = {}
+        # bounded per-series exemplar: the LAST trace_id whose request
+        # bumped this series (labels_key -> {"trace_id", "value"}) —
+        # same cardinality bound as the series map itself
+        self._exemplars = {}
 
-    def inc(self, n=1, **labels):
+    def inc(self, n=1, trace_id=None, **labels):
         with self._lock:
             self._value += n
+            k = _labels_key(labels)
             if labels:
-                k = _labels_key(labels)
                 self._series[k] = self._series.get(k, 0) + n
+            if trace_id is not None:
+                self._exemplars[k] = {
+                    "trace_id": str(trace_id), "value": float(n),
+                }
 
     def labels(self, **labels):
         counter = self
 
         class _Bound:
-            def inc(self, n=1):
-                counter.inc(n, **labels)
+            def inc(self, n=1, trace_id=None):
+                counter.inc(n, trace_id=trace_id, **labels)
 
         return _Bound()
 
@@ -102,16 +110,29 @@ class Counter(_Metric):
         with self._lock:
             return dict(self._series)
 
+    def exemplars(self):
+        """labels_key -> {"trace_id", "value"} (copies)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._exemplars.items()}
+
     def data(self):
         with self._lock:
-            return {
+            out = {
                 "type": self.metric_type,
                 "value": self._value,
                 "series": [
-                    {"labels": dict(k), "value": v}
+                    dict(
+                        {"labels": dict(k), "value": v},
+                        **({"exemplar": dict(self._exemplars[k])}
+                           if k in self._exemplars else {}),
+                    )
                     for k, v in self._series.items()
                 ],
             }
+            ex = self._exemplars.get(())
+            if ex is not None:
+                out["exemplar"] = dict(ex)
+            return out
 
 
 _NONBLOCK = threading.local()
@@ -250,14 +271,23 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
         # per-bucket (non-cumulative) counts; last slot is +Inf overflow
         self._bucket_counts = [0] * (len(self.buckets) + 1)
+        # bounded per-bucket exemplar: the LAST trace_id observed into
+        # each bucket slot (None until one arrives) — links a latency
+        # bucket straight to a representative distributed trace
+        self._exemplars = [None] * (len(self.buckets) + 1)
 
-    def observe(self, v):
+    def observe(self, v, trace_id=None):
         v = float(v)
         with self._lock:
             self._samples.append(v)
             self._count += 1
             self._sum += v
-            self._bucket_counts[bisect.bisect_left(self.buckets, v)] += 1
+            idx = bisect.bisect_left(self.buckets, v)
+            self._bucket_counts[idx] += 1
+            if trace_id is not None:
+                self._exemplars[idx] = {
+                    "trace_id": str(trace_id), "value": v,
+                }
 
     @property
     def count(self):
@@ -334,6 +364,9 @@ class Histogram(_Metric):
             window = sorted(self._samples)
             count, total = self._count, self._sum
             counts = list(self._bucket_counts)
+            exemplars = [
+                None if e is None else dict(e) for e in self._exemplars
+            ]
         d = {"type": self.metric_type, "count": count,
              "window_count": len(window)}
         if window:
@@ -348,10 +381,16 @@ class Histogram(_Metric):
                 max=window[-1], min=window[0], unit=self.unit,
             )
         buckets, acc = [], 0
-        for ub, c in zip(self.buckets, counts):
+        for i, (ub, c) in enumerate(zip(self.buckets, counts)):
             acc += c
-            buckets.append({"le": ub, "count": acc})
-        buckets.append({"le": float("inf"), "count": acc + counts[-1]})
+            b = {"le": ub, "count": acc}
+            if exemplars[i] is not None:
+                b["exemplar"] = exemplars[i]
+            buckets.append(b)
+        inf_b = {"le": float("inf"), "count": acc + counts[-1]}
+        if exemplars[-1] is not None:
+            inf_b["exemplar"] = exemplars[-1]
+        buckets.append(inf_b)
         d["buckets"] = buckets
         d.setdefault("sum", total)
         return d

@@ -43,6 +43,7 @@ from ..models.generation import (
     decode_step,
     prefill,
 )
+from ..observability.tracing import get_tracer
 from .kv_pool import KVCachePool
 from .metrics import ServingMetrics
 from .scheduler import (
@@ -205,6 +206,10 @@ class ServingEngine:
         )
         self._donate = accel
         self._traced = set()
+        # count of in-flight requests that carry an open decode span —
+        # the decode hot path checks this ONE integer and, when zero
+        # (tracing off / sampled out), allocates no span machinery
+        self._traced_live = 0
         self._closed = False
         # runtime lint guard: the whole engine design exists so that
         # admission/retirement NEVER recompile — if compile caches grow
@@ -405,7 +410,14 @@ class ServingEngine:
             self.metrics.completed.inc()
         elif status == TIMEOUT:
             self.metrics.timeouts.inc()
-        self.metrics.e2e.observe(now - h.submit_time)
+        tid = None if h.trace is None else h.trace.trace_id
+        self.metrics.e2e.observe(now - h.submit_time, trace_id=tid)
+        sp = h._decode_span
+        if sp is not None:
+            h._decode_span = None
+            self._traced_live -= 1
+            sp.finish(status=status, tokens=len(h.tokens),
+                      **({"error": reason} if reason else {}))
         self._seqs[slot] = None
         self._release_slot(slot)
         h._fire_terminal()
@@ -424,6 +436,23 @@ class ServingEngine:
         elif seq.emitted >= req.max_new_tokens:
             self._finish(slot, DONE)
 
+    def _trace_admitted(self, handle, slot, wait):
+        """Admission-time spans under the request's trace context: the
+        scheduler-measured queue wait rendered retroactively (the span
+        duration IS ``wait`` — the same number the ``queue_wait``
+        histogram observed), and the ONE open decode span whose bounded
+        event ring the step loop feeds. Zero allocations when the
+        request is sampled out (``handle.trace is None``)."""
+        tspan = handle.trace
+        if tspan is None:
+            return
+        tr = get_tracer()
+        tr.record_span("engine.queue_wait", tspan, wait)
+        handle._decode_span = tr.start_span("engine.decode", tspan,
+                                            slot=slot)
+        if handle._decode_span is not None:
+            self._traced_live += 1
+
     def _admit_one(self, handle):
         req = handle.request
         now = self.clock()
@@ -436,6 +465,9 @@ class ServingEngine:
         # would wedge forever)
         slot = self._slab.claim()
         assert slot is not None  # caller checked free_slots
+        psp = None if handle.trace is None else get_tracer().start_span(
+            "engine.prefill", handle.trace, mode="local", bucket=bucket
+        )
         try:
             with profiler.RecordEvent(f"serving::prefill_b{bucket}"):
                 nxt, new_flat = self._run(
@@ -451,6 +483,8 @@ class ServingEngine:
                 )
                 t0 = int(np.asarray(nxt)[0])
         except BaseException:
+            if psp is not None:
+                psp.finish(error="admission_error")
             self._slab.release(slot)
             # under donation the failed call may already have consumed
             # the block's buffers — recycling them would poison the
@@ -460,17 +494,22 @@ class ServingEngine:
             else:
                 self.pool.free(blk)
             raise
+        if psp is not None:
+            psp.finish()
         self.pool.free(blk)
         handle.status = RUNNING
         handle.weights_version = self.weights_version
         handle.admit_time = now
         handle.admitted_step = self.step_count
         handle.first_token_time = self.clock()
+        wait = now - handle.submit_time
+        tid = None if handle.trace is None else handle.trace.trace_id
         self.metrics.admitted.inc()
         self.metrics.prefill_tokens.inc(req.prompt_len)
-        self.metrics.queue_wait.observe(now - handle.submit_time)
+        self.metrics.queue_wait.observe(wait, trace_id=tid)
         self.metrics.ttft.observe(handle.first_token_time
-                                  - handle.submit_time)
+                                  - handle.submit_time, trace_id=tid)
+        self._trace_admitted(handle, slot, wait)
         self._seqs[slot] = _Seq(handle, t0)
         self._append(slot, t0)
 
@@ -583,6 +622,17 @@ class ServingEngine:
             )
             nxt = np.asarray(nxt)
         dt = self.clock() - t0
+        if self._traced_live:
+            # ONE bounded-ring event per traced request per step (the
+            # O(1)-spans discipline: a 500-step decode stays one span);
+            # sampled-out runs never reach this branch — the single
+            # integer check above is the whole hot-path cost
+            occ = len(active)
+            for i in active:
+                sp = self._seqs[i].handle._decode_span
+                if sp is not None:
+                    sp.event("decode_step", step=self.step_count,
+                             occupancy=occ, dt_s=dt)
         for i in active:
             if self._seqs[i] is None:
                 continue  # finished by an earlier row this step
@@ -706,8 +756,14 @@ class ServingEngine:
                                       None) is not None:
             tr.expected_weights_version = staged.weights_version
         if staged.staged_at is not None:
-            self.metrics.reload_ttft_spike.observe(
-                self.clock() - staged.staged_at
+            pause = self.clock() - staged.staged_at
+            self.metrics.reload_ttft_spike.observe(pause)
+            # the admission-pause window as a (head-sampled) root span:
+            # the reload's worst-case extra TTFT is visible in the same
+            # timeline as the requests it delayed
+            get_tracer().record_trace(
+                "engine.reload_pause", pause,
+                version=staged.weights_version, step=staged.step,
             )
         self.metrics.reloads.inc(label="ok")
         staged.outcome = "applied"

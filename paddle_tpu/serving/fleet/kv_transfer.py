@@ -47,12 +47,25 @@ import jax
 import jax.numpy as jnp
 
 from ...models.generation import alloc_kv_caches, normalize_cache_dtype
+from ...observability.tracing import (
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    remote_child_span,
+)
 from ...quantization.kv import QuantizedKV, is_quantized
 from ..chaos import poke as _chaos_poke
 from ..engine import _flatten, build_prefill_body
 from ..metrics import Counter
 
-MAGIC = b"PKV1"
+# Wire protocol version. PKV2 added the optional trace fields
+# (``traceparent`` on the prefill request, ``span`` on the prefilled
+# response) — both are carried in the header JSON, so the frame layout
+# itself is unchanged and a PKV1 peer's frames still parse: we SEND the
+# current magic but ACCEPT both on receive.
+MAGIC = b"PKV2"
+MAGIC_V1 = b"PKV1"
+_ACCEPTED_MAGICS = (MAGIC, MAGIC_V1)
 _HEAD = struct.Struct(">QI")   # payload_len, crc32
 _HLEN = struct.Struct(">I")    # header_json length
 # one frame is at most a few pages of KV; anything past this is a
@@ -96,7 +109,7 @@ def _recv_exact(sock, n):
 def recv_frame(sock):
     _chaos_poke("kv.recv_frame")
     head = _recv_exact(sock, 4 + _HEAD.size)
-    if head[:4] != MAGIC:
+    if head[:4] not in _ACCEPTED_MAGICS:
         raise TransferError(f"bad frame magic {head[:4]!r}")
     length, crc = _HEAD.unpack(head[4:])
     if length < _HLEN.size or length > MAX_FRAME_BYTES:
@@ -328,6 +341,17 @@ class PrefillWorker:
                 f"page_size {ps} must divide bucket {bucket}"
             )
         dtype_name = normalize_cache_dtype(req["cache_dtype"])
+        # PKV2 trace propagation: a sampled client sends a traceparent;
+        # we time the compute under a tracer-less span and ship it back
+        # in the response header — the CLIENT adds it to its buffer, so
+        # the worker needs no trace endpoint of its own (and an
+        # in-process worker never double-records).
+        wsp = None
+        ctx = parse_traceparent(req.get("traceparent"))
+        if ctx is not None:
+            wsp = remote_child_span("worker.prefill", ctx,
+                                    "prefill_worker")
+            wsp.set(bucket=bucket, prompt_len=L)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :L] = prompt
         key = jnp.asarray(np.asarray(req["key"], np.uint32))
@@ -354,12 +378,16 @@ class PrefillWorker:
             self._blocks[(bucket, dtype_name)] = new_flat
             t0 = int(np.asarray(nxt)[0])
         n_pages = bucket // ps
-        send_frame(conn, {
+        meta = {
             "kind": "prefilled", "first_token": t0, "bucket": bucket,
             "page_size": ps, "n_pages": n_pages,
             "cache_dtype": dtype_name, "entries": len(new_flat),
             "weights_version": self.weights_version,
-        })
+        }
+        if wsp is not None:
+            wsp.finish(weights_version=self.weights_version)
+            meta["span"] = wsp.to_dict()
+        send_frame(conn, meta)
         for arr in new_flat:
             if is_quantized(arr):
                 kvh, d = arr.q.shape[2], arr.q.shape[3]
@@ -439,44 +467,63 @@ class RemotePrefillClient:
         return self._sock
 
     def prefill(self, prompt, prompt_len, bucket, page_size,
-                cache_dtype, temperature, key):
+                cache_dtype, temperature, key, trace=None):
         """One remote prefill: returns ``(first_token, flat_block)``
         where ``flat_block`` matches the engine's local prefill output
         (``[1, bucket, kvH, D]`` per K/V per layer; ``QuantizedKV``
         for int8 pools). Raises :class:`TransferError` on ANY failure
         after opening the cooldown window.
 
+        ``trace`` (a Span or None) makes the exchange traced: a
+        ``kv.transfer`` wire span brackets the socket round-trip, its
+        traceparent rides the PKV2 request header, and the worker's
+        returned ``worker.prefill`` span lands in THIS process's trace
+        buffer (the worker keeps no buffer of its own).
+
         A failure on a REUSED connection gets one fresh-connection
         retry first: the worker idle-closes connections (and may have
         restarted), and a stale cached socket must not demote a
         healthy worker to local-prefill + cooldown. Prefill is pure
         compute, so the retry is safe to replay."""
+        tr = get_tracer()
+        wire = None if trace is None else tr.start_span(
+            "kv.transfer", trace,
+            worker=f"{self.host}:{self.port}", bucket=int(bucket),
+        )
+        tid = None if wire is None else wire.trace_id
         args = (prompt, prompt_len, bucket, page_size, cache_dtype,
-                temperature, key)
+                temperature, key, wire)
         reused = self._sock is not None
         try:
-            t0, flat, nbytes = self._prefill_once(*args)
-        except TransferError:
-            if not reused:
+            t0, flat, nbytes, wspan = self._prefill_once(*args)
+        except TransferError as e:
+            retried = False
+            if reused:
+                self.close()
+                retried = True
+                try:
+                    t0, flat, nbytes, wspan = self._prefill_once(*args)
+                except TransferError as e2:
+                    e, retried = e2, False
+            if not retried:
                 self._mark_down()
-                self.transfers.inc(label="error")
-                raise
-            self.close()
-            try:
-                t0, flat, nbytes = self._prefill_once(*args)
-            except TransferError:
-                self._mark_down()
-                self.transfers.inc(label="error")
-                raise
-        self.transfers.inc(label="ok")
-        self.transfer_bytes.inc(nbytes)
+                self.transfers.inc(label="error", trace_id=tid)
+                if wire is not None:
+                    wire.finish(outcome="error", error=str(e))
+                raise e
+        self.transfers.inc(label="ok", trace_id=tid)
+        self.transfer_bytes.inc(nbytes, trace_id=tid)
+        if wire is not None:
+            wire.finish(outcome="ok", bytes=nbytes)
+            if wspan:
+                tr.buffer.add(wspan)
         return t0, flat
 
     def _prefill_once(self, prompt, prompt_len, bucket, page_size,
-                      cache_dtype, temperature, key):
+                      cache_dtype, temperature, key, wire=None):
         try:
             sock = self._connection()
-            send_frame(sock, {
+            req = {
                 "kind": "prefill",
                 "prompt": [int(t) for t in prompt],
                 "prompt_len": int(prompt_len),
@@ -485,7 +532,10 @@ class RemotePrefillClient:
                 "cache_dtype": str(cache_dtype),
                 "temperature": float(temperature),
                 "key": [int(x) for x in np.asarray(key).ravel()],
-            })
+            }
+            if wire is not None:
+                req["traceparent"] = format_traceparent(wire)
+            send_frame(sock, req)
             meta, _ = recv_frame(sock)
             if meta.get("kind") == "error":
                 raise TransferError(
@@ -534,7 +584,8 @@ class RemotePrefillClient:
         except (OSError, KeyError, ValueError) as e:
             self.close()
             raise TransferError(repr(e))
-        return int(meta["first_token"]), flat, nbytes
+        return (int(meta["first_token"]), flat, nbytes,
+                meta.get("span"))
 
     def reload(self, ckpt_dir, weights_version=None,
                reload_timeout_s=120.0):

@@ -149,6 +149,16 @@ def main(argv=None):
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *a: stop.set())
 
+    # name this process's trace rows before any span exists — stitched
+    # fleet traces show router/replica/prefill_worker as separate
+    # Perfetto process lanes (replicas additionally keyed by port once
+    # known, via PADDLE_TPU_TRACE_PROCESS set by the spawner)
+    from ...observability.tracing import set_process_name
+
+    set_process_name(os.environ.get("PADDLE_TPU_TRACE_PROCESS")
+                     or ("prefill_worker" if args.role == "prefill"
+                         else args.role))
+
     if args.role == "router":
         if not args.replicas:
             ap.error("--role router requires --replicas")

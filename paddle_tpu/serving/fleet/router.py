@@ -58,6 +58,12 @@ import time
 
 from ...observability import Gauge, get_registry
 from ...observability.exporter import prometheus_text
+from ...observability.tracing import (
+    TRACEPARENT_HEADER,
+    Tracer,
+    format_traceparent,
+    trace_payload,
+)
 from ..metrics import Counter, Histogram
 
 # terminal stream-abort reasons the router originates
@@ -231,6 +237,10 @@ class FleetRouter:
         self.affinity_prefix_tokens = int(affinity_prefix_tokens)
         self.affinity_map_size = int(affinity_map_size)
         self._affinity = collections.OrderedDict()
+        # the router owns its OWN tracer (not the process default): it
+        # must show up as a distinct "router" process row even when it
+        # runs in-process next to an engine (serve_bench, smokes)
+        self.tracer = Tracer(process="router")
         self._lock = threading.Lock()
         # one rolling reload at a time: overlapping walks would drain
         # multiple replicas at once, breaking the at-most-one-out-of-
@@ -492,6 +502,8 @@ class FleetRouter:
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
                 self.metrics.http_requests.inc(label="200")
+            elif path == "/trace":
+                self._send_json(h, 200, trace_payload(self.tracer))
             elif path in ("/healthz", "/replicas"):
                 now = self.clock()
                 reps = [r.summary(now) for r in self.replicas]
@@ -806,12 +818,30 @@ class FleetRouter:
 
     # ------------------------------------------------------------ routing
     def _route(self, h, body, stream, parsed=None):
+        # head-sampling point for the whole distributed trace: the root
+        # span starts here (or not at all); everything downstream —
+        # frontend, engine, KV wire, worker — hangs off its context
+        rsp = self.tracer.start_trace("router.request",
+                                      stream=bool(stream))
+        try:
+            attrs = self._route_attempts(h, body, stream, parsed, rsp)
+        except BaseException:
+            if rsp is not None:
+                rsp.finish(outcome="error", error="router_error")
+            raise
+        if rsp is not None:
+            rsp.finish(**attrs)
+
+    def _route_attempts(self, h, body, stream, parsed, rsp):
+        """The placement/retry loop; returns the root span's outcome
+        attributes (``error=`` present on shed/abort paths)."""
         t_recv = self.clock()
         tried = set()
         saw_saturated = False
         saw_conn_error = False
         akey = self._affinity_key(parsed or {})
-        client = _ClientStream(h, self.metrics)
+        tid = None if rsp is None else rsp.trace_id
+        client = _ClientStream(h, self.metrics, trace_id=tid)
         while True:
             r = self._pick(exclude=tried, affinity_key=akey)
             if r is None:
@@ -824,22 +854,28 @@ class FleetRouter:
                 r.in_flight += 1
             try:
                 outcome = self._try_replica(r, client, body, stream,
-                                            t_recv)
+                                            t_recv, rsp)
             finally:
                 with self._lock:
                     r.in_flight -= 1
             if outcome == "done":
                 self._breaker_ok(r)
-                return
+                return {"outcome": "done", "replica": r.index,
+                        "attempts": len(tried)}
             if outcome == "client_gone":
-                return
+                return {"outcome": "client_gone", "replica": r.index,
+                        "attempts": len(tried),
+                        "error": ABORT_CLIENT_DISCONNECT}
             if outcome == "failed_after_tokens":
                 # terminal error already sent; never replayed
                 self._breaker_fail(r)
-                return
+                return {"outcome": "failed_after_tokens",
+                        "replica": r.index, "attempts": len(tried),
+                        "error": ABORT_REPLICA_FAILED}
             if outcome == "saturated":
                 saw_saturated = True
-                self.metrics.retries.inc(label="replica_busy")
+                self.metrics.retries.inc(label="replica_busy",
+                                         trace_id=tid)
                 continue
             if outcome in ("conn_error", "midstream_unstarted"):
                 # midstream_unstarted already counted its retry label
@@ -847,7 +883,8 @@ class FleetRouter:
                 saw_conn_error = True
                 self._breaker_fail(r)
                 if outcome == "conn_error":
-                    self.metrics.retries.inc(label="conn_error")
+                    self.metrics.retries.inc(label="conn_error",
+                                             trace_id=tid)
                 continue
             raise AssertionError(f"unknown outcome {outcome!r}")
         # fleet exhausted: shed with a reason that tells the client
@@ -858,22 +895,41 @@ class FleetRouter:
             reason = SHED_REPLICAS_UNAVAILABLE
         else:
             reason = SHED_NO_REPLICAS
-        self.metrics.shed.inc(label=reason)
+        self.metrics.shed.inc(label=reason, trace_id=tid)
         if client.headers_sent:
             # stream already open (a replica died mid-handshake after
             # we committed to SSE): terminal error event, not a status
             client.error_event({"reason": reason})
-            self.metrics.stream_aborts.inc(label=reason)
+            self.metrics.stream_aborts.inc(label=reason, trace_id=tid)
         else:
             self._send_json(h, _SHED_STATUS[reason], {
                 "error": "rejected", "reason": reason,
                 "replicas_tried": len(tried),
             })
+        return {"outcome": "shed", "attempts": len(tried),
+                "error": reason}
 
-    def _try_replica(self, r, client, body, stream, t_recv):
+    def _try_replica(self, r, client, body, stream, t_recv, rsp=None):
         """One placement attempt. Returns 'done' | 'client_gone' |
         'failed_after_tokens' | 'saturated' | 'conn_error' |
         'midstream_unstarted'."""
+        # per-attempt CLIENT span — its traceparent is what crosses the
+        # HTTP hop, so the replica's server span parents under THIS
+        # attempt, not under the whole request (retries stay separable)
+        asp = None if rsp is None else self.tracer.start_span(
+            "router.try_replica", rsp, replica=r.index
+        )
+        outcome = self._try_replica_once(r, client, body, stream,
+                                         t_recv, asp)
+        if asp is not None:
+            bad = outcome in ("conn_error", "midstream_unstarted",
+                              "failed_after_tokens", "saturated",
+                              "client_gone")
+            asp.finish(outcome=outcome,
+                       **({"error": outcome} if bad else {}))
+        return outcome
+
+    def _try_replica_once(self, r, client, body, stream, t_recv, asp):
         import http.client
 
         # a replica dying mid-response surfaces as HTTPException
@@ -885,9 +941,11 @@ class FleetRouter:
             r.host, r.port, timeout=self.connect_timeout_s
         )
         try:
+            headers = {"Content-Type": "application/json"}
+            if asp is not None:
+                headers[TRACEPARENT_HEADER] = format_traceparent(asp)
             conn.request(
-                "POST", "/v1/generate", body=body,
-                headers={"Content-Type": "application/json"},
+                "POST", "/v1/generate", body=body, headers=headers,
             )
             # connect is bounded by connect_timeout_s above; from here
             # on reads wait on GENERATION (a non-stream response only
@@ -925,7 +983,7 @@ class FleetRouter:
                     return "conn_error"
                 self._forward_reject(client, 200, payload)
                 return "done"
-            return self._pipe_sse(r, resp, client, t_recv)
+            return self._pipe_sse(r, resp, client, t_recv, asp)
         finally:
             conn.close()
 
@@ -942,7 +1000,7 @@ class FleetRouter:
             return
         self._send_json(client.h, code, obj)
 
-    def _pipe_sse(self, r, resp, client, t_recv):
+    def _pipe_sse(self, r, resp, client, t_recv, asp=None):
         """Forward the replica's SSE stream event-block by event-block.
         Token events count toward the unstarted/started boundary; a
         replica failure after the first forwarded token ends the
@@ -950,6 +1008,7 @@ class FleetRouter:
         """
         import http.client
 
+        tid = None if asp is None else asp.trace_id
         tokens_forwarded = 0
         try:
             for block, event in _iter_sse_blocks(resp):
@@ -958,7 +1017,7 @@ class FleetRouter:
                 if event == "token":
                     if tokens_forwarded == 0:
                         self.metrics.ttft.observe(
-                            self.clock() - t_recv
+                            self.clock() - t_recv, trace_id=tid,
                         )
                     tokens_forwarded += 1
                 elif event in ("done", "error"):
@@ -968,14 +1027,16 @@ class FleetRouter:
         except (OSError, http.client.HTTPException):
             if tokens_forwarded == 0:
                 # unstarted — safe to replay on another replica
-                self.metrics.retries.inc(label="midstream_unstarted")
+                self.metrics.retries.inc(label="midstream_unstarted",
+                                         trace_id=tid)
                 return "midstream_unstarted"
             client.error_event({
                 "reason": ABORT_REPLICA_FAILED,
                 "replica": r.index,
                 "tokens_forwarded": tokens_forwarded,
             })
-            self.metrics.stream_aborts.inc(label=ABORT_REPLICA_FAILED)
+            self.metrics.stream_aborts.inc(label=ABORT_REPLICA_FAILED,
+                                           trace_id=tid)
             return "failed_after_tokens"
 
 
@@ -984,9 +1045,10 @@ class _ClientStream:
     first forwarded block, so an unstarted request can still fail over
     to another replica (or shed with a plain HTTP status)."""
 
-    def __init__(self, h, metrics):
+    def __init__(self, h, metrics, trace_id=None):
         self.h = h
         self.metrics = metrics
+        self.trace_id = trace_id
         self.headers_sent = False
         self.client_gone = False
 
@@ -1013,7 +1075,7 @@ class _ClientStream:
         except OSError:
             self.client_gone = True
             self.metrics.stream_aborts.inc(
-                label=ABORT_CLIENT_DISCONNECT
+                label=ABORT_CLIENT_DISCONNECT, trace_id=self.trace_id,
             )
             return False
 

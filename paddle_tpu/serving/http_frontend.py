@@ -51,6 +51,12 @@ import time
 
 from ..observability import get_registry
 from ..observability.exporter import prometheus_text
+from ..observability.tracing import (
+    TRACEPARENT_HEADER,
+    get_tracer,
+    parse_traceparent,
+    trace_payload,
+)
 from .metrics import Counter, Histogram
 
 # terminal abort reasons surfaced on streams (engine REASON_* strings
@@ -229,6 +235,8 @@ class ServingFrontend:
                     "text/plain; version=0.0.4; charset=utf-8",
                 )
                 self.metrics.http_requests.inc(label="200")
+            elif path == "/trace":
+                self._send_json(h, 200, trace_payload())
             elif path == "/healthz":
                 self._send_json(h, 200, self.health())
             else:
@@ -381,12 +389,31 @@ class ServingFrontend:
         if max_new is not None and hasattr(self.engine, "max_seq_len"):
             submit_args = submit_args + (max_new,)
         t_recv = time.monotonic()
+        # an upstream router's traceparent makes this a child server
+        # span; a direct request starts a new (head-sampled) root
+        ctx = parse_traceparent(h.headers.get(TRACEPARENT_HEADER))
+        tr = get_tracer()
         try:
             with self._lock:
                 handle = self.engine.submit(
                     *submit_args, on_token=on_token, on_event=on_event,
                     **kwargs,
                 )
+                # under the SAME lock the driver steps with: the engine
+                # cannot admit this handle before its trace is attached
+                if not handle.finished:
+                    if ctx is not None:
+                        handle.trace = tr.start_span(
+                            "frontend.request", ctx,
+                            request_id=handle.request.request_id,
+                            prompt_len=handle.request.prompt_len,
+                        )
+                    else:
+                        handle.trace = tr.start_trace(
+                            "frontend.request",
+                            request_id=handle.request.request_id,
+                            prompt_len=handle.request.prompt_len,
+                        )
         except TypeError as e:
             # a field the wrapped engine doesn't take (StaticBatchEngine
             # has no eos_token_id) is the client's problem — 400, never
@@ -407,6 +434,9 @@ class ServingFrontend:
             self._stream_response(h, handle, events, t_recv)
         else:
             self._blocking_response(h, handle, events)
+        if handle.trace is not None:
+            handle.trace.finish(status=handle.status,
+                                tokens=len(handle.tokens))
 
     def _handle_reload(self, h):
         """Live weight reload over the wire: heavy work (disk reads,
@@ -497,6 +527,10 @@ class ServingFrontend:
         idx = 0
         last_write = None
         counted_abort = False
+        tid = None if handle.trace is None else handle.trace.trace_id
+        ssp = None if handle.trace is None else get_tracer().start_span(
+            "frontend.stream", handle.trace
+        )
         # poll in short slices so frontend stop() ends open streams
         # promptly instead of after a full stream_timeout_s of silence
         stall_at = time.monotonic() + self.stream_timeout_s
@@ -512,7 +546,10 @@ class ServingFrontend:
                     else:
                         continue
                     counted_abort = True
-                    self.metrics.stream_aborts.inc(label=reason)
+                    self.metrics.stream_aborts.inc(label=reason,
+                                                   trace_id=tid)
+                    if ssp is not None:
+                        ssp.finish(tokens=idx, error=reason)
                     write_event("error", {"reason": reason,
                                           "status": handle.status})
                     return
@@ -522,7 +559,8 @@ class ServingFrontend:
                                           "token": int(payload)})
                     now = time.monotonic()
                     if idx == 0:
-                        self.metrics.wire_ttft.observe(now - t_recv)
+                        self.metrics.wire_ttft.observe(now - t_recv,
+                                                       trace_id=tid)
                     elif last_write is not None:
                         self.metrics.wire_itl.observe(now - last_write)
                     last_write = now
@@ -530,16 +568,20 @@ class ServingFrontend:
                 else:  # terminal — exactly once by the handle contract
                     p = self._terminal_payload(handle)
                     if handle.status == "DONE":
+                        if ssp is not None:
+                            ssp.finish(tokens=idx)
                         write_event("done", p)
                     else:
                         # the satellite fix: shed/expired requests END
                         # the open stream with the reject reason instead
                         # of hanging it
                         counted_abort = True
-                        self.metrics.stream_aborts.inc(
-                            label=handle.reason
-                            or handle.status.lower()
-                        )
+                        reason = (handle.reason
+                                  or handle.status.lower())
+                        self.metrics.stream_aborts.inc(label=reason,
+                                                       trace_id=tid)
+                        if ssp is not None:
+                            ssp.finish(tokens=idx, error=reason)
                         write_event("error", p)
                     return
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -547,8 +589,10 @@ class ServingFrontend:
             # must not produce a second client_disconnect sample
             if not counted_abort:
                 self.metrics.stream_aborts.inc(
-                    label=ABORT_CLIENT_DISCONNECT
+                    label=ABORT_CLIENT_DISCONNECT, trace_id=tid,
                 )
+            if ssp is not None:
+                ssp.finish(tokens=idx, error=ABORT_CLIENT_DISCONNECT)
 
 
 # --------------------------------------------------------- client helpers

@@ -30,14 +30,15 @@ class Counter(_reg.Counter):
         super().__init__(name, help=help, prom_name=prom_name)
         self._labelname = labelname
 
-    def inc(self, n=1, label=None, **labels):
+    def inc(self, n=1, label=None, trace_id=None, **labels):
         """``label=`` is the serving shorthand for the configured
         labelname; registry-style ``**labels`` kwargs (what the
         inherited ``.labels()`` binding forwards) pass straight
-        through, so both idioms work on the same instrument."""
+        through, so both idioms work on the same instrument.
+        ``trace_id`` records an exemplar on the bumped series."""
         if label is not None:
             labels[self._labelname] = label
-        super().inc(n, **labels)
+        super().inc(n, trace_id=trace_id, **labels)
 
     def by_label(self):
         out = {}
@@ -70,8 +71,8 @@ class Histogram(_reg.Histogram):
                          buckets=buckets, prom_name=prom_name)
         self._export = export
 
-    def observe(self, v):
-        super().observe(float(v))
+    def observe(self, v, trace_id=None):
+        super().observe(float(v), trace_id=trace_id)
         if self._export:
             from .. import profiler
 

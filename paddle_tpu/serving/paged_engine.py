@@ -69,6 +69,7 @@ import jax.numpy as jnp
 
 from .. import profiler
 from ..models.generation import _select_next, decode_step
+from ..observability.tracing import get_tracer
 from .engine import (
     ServingEngine,
     _Seq,
@@ -503,10 +504,13 @@ class PagedServingEngine(ServingEngine):
         else:
             self.pool.free(blk)
 
-    def _remote_prefill(self, req, bucket, key):
+    def _remote_prefill(self, req, bucket, key, trace=None):
         """Try the attached prefill pool: ``(first_token, flat_block)``
         on success, None when the transport is absent/down/failing (the
-        caller runs local prefill — clean fallback, counted)."""
+        caller runs local prefill — clean fallback, counted).
+        ``trace`` is the admission's prefill span: the transport
+        parents its wire span (and the worker's remote span) under
+        it."""
         tr = self.prefill_transport
         if tr is None or not tr.available():
             return None
@@ -516,7 +520,7 @@ class PagedServingEngine(ServingEngine):
             out = tr.prefill(
                 [int(t) for t in req.input_ids], req.prompt_len, bucket,
                 self.page_size, str(self.cache_dtype),
-                float(self.temperature), key,
+                float(self.temperature), key, trace=trace,
             )
         except TransferError:
             self.remote_prefill_fallbacks += 1
@@ -552,14 +556,32 @@ class PagedServingEngine(ServingEngine):
                 self.prefix_cache.tokens_saved.inc(plan[0])
             else:
                 self.prefix_cache.misses.inc()
+        # the per-admission prefill span: mode (remote|local|fallback|
+        # chunk) plus the prefix-hit/chunk-plan attributes the warm
+        # path decided on — None (zero allocations) when sampled out
+        psp = None if handle.trace is None else get_tracer().start_span(
+            "engine.prefill", handle.trace, bucket=bucket,
+            prefix_hit=match is not None,
+        )
+        if psp is not None and plan is not None:
+            psp.set(chunk_start=plan[0], tail_bucket=plan[1],
+                    cached_tokens=plan[0])
+        fb0 = self.remote_prefill_fallbacks
         remote = None
         blk = None
         if match is None:
-            remote = self._remote_prefill(req, bucket, key)
+            remote = self._remote_prefill(req, bucket, key, trace=psp)
             if remote is None:
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, : req.prompt_len] = req.input_ids
                 blk = self.pool.alloc(req.prompt_len)
+        if psp is not None:
+            psp.set(mode=(
+                "chunk" if match is not None
+                else "remote" if remote is not None
+                else "fallback" if self.remote_prefill_fallbacks > fb0
+                else "local"
+            ))
         n_ref = 0 if match is None else plan[0] // ps
         ref_pages = [] if match is None else match.pages[:n_ref]
         row = None
@@ -582,11 +604,16 @@ class PagedServingEngine(ServingEngine):
                 n_gather = -(-c // ps)
                 src = np.zeros((bucket // ps,), np.int32)
                 src[:n_gather] = match.pages[:n_gather]
+                gsp = None if psp is None else get_tracer().start_span(
+                    "engine.gather", psp, pages=n_gather
+                )
                 with profiler.RecordEvent(f"serving::gather_b{bucket}"):
                     flat_block = self._run(
                         ("gather", bucket), self._gather_fn(bucket),
                         self._flat, jnp.asarray(src),
                     )
+                if gsp is not None:
+                    gsp.finish()
                 tail = np.zeros((1, tb), np.int32)
                 tail[0, :L] = req.input_ids[c:]
                 self.chunk_prefills += 1
@@ -622,6 +649,11 @@ class PagedServingEngine(ServingEngine):
                 # the prefill pool already ran the bucket program; the
                 # wire block adopts through the SAME compiled scatter
                 t0, new_flat = remote
+            if psp is not None:
+                psp.finish()
+            asp = None if handle.trace is None else \
+                get_tracer().start_span("engine.adopt", handle.trace,
+                                        bucket=bucket)
             with profiler.RecordEvent(f"serving::adopt_b{bucket}"):
                 # adopt: the request's FRESH pages within the bucket
                 # span land in the claim; shared by-reference pages
@@ -634,6 +666,8 @@ class PagedServingEngine(ServingEngine):
                     ("adopt", bucket), self._adopt_fn(bucket),
                     self._flat, new_flat, jnp.asarray(page_ids),
                 )
+            if asp is not None:
+                asp.finish()
             if self.prefix_cache is not None:
                 # publish-on-admission: full prompt pages are stable
                 # the moment prefill wrote them (decode writes start at
@@ -645,6 +679,8 @@ class PagedServingEngine(ServingEngine):
                 )
                 self.prefix_cache.update_gauges()
         except BaseException:
+            if psp is not None:
+                psp.finish(error="admission_error")
             if row is not None:
                 self._tables[row, :] = 0
                 self._free_rows.append(row)
@@ -663,11 +699,14 @@ class PagedServingEngine(ServingEngine):
         handle.admit_time = now
         handle.admitted_step = self.step_count
         handle.first_token_time = self.clock()
+        wait = now - handle.submit_time
+        tid = None if handle.trace is None else handle.trace.trace_id
         self.metrics.admitted.inc()
         self.metrics.prefill_tokens.inc(req.prompt_len)
-        self.metrics.queue_wait.observe(now - handle.submit_time)
+        self.metrics.queue_wait.observe(wait, trace_id=tid)
         self.metrics.ttft.observe(handle.first_token_time
-                                  - handle.submit_time)
+                                  - handle.submit_time, trace_id=tid)
+        self._trace_admitted(handle, row, wait)
         self._seqs[row] = _Seq(handle, t0)
         self._append(row, t0)
 

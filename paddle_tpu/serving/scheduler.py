@@ -107,6 +107,12 @@ class RequestHandle:
         self.admitted_step = None   # engine step index at admission
         self.finished_step = None
         self.weights_version = None  # engine weights at admission
+        # distributed-tracing context (an observability.tracing.Span or
+        # None): set by the front-end right after submit, read by the
+        # engine at admission. None = sampled out — every engine
+        # instrumentation site then allocates nothing.
+        self.trace = None
+        self._decode_span = None  # the engine's open per-request span
         self.on_token = on_token
         self.on_event = on_event
         self._terminal_fired = False

@@ -1,0 +1,382 @@
+"""Distributed request tracing — spans, propagation, export, exemplars.
+
+Pins: span lifecycle + head sampling + buffer bounds; W3C traceparent
+round-trip across the HTTP hop and the PKV2 KV-frame hop (old PKV1
+frames still parse); the queue-wait span duration equals the
+scheduler-measured wait the histogram saw; ONE decode span per request
+with a bounded per-step event ring; chrome-trace export loads back
+through ``profiler.load_profiler_result``; exemplars render in the
+text exposition and round-trip the strict parser (malformed exemplars
+rejected with a clear error); the flight-recorder bundle names
+in-flight trace ids; and ``sample=0`` allocates ZERO spans in the
+engine hot path.
+"""
+import json
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import registry as reg_mod
+from paddle_tpu.observability.exporter import (
+    parse_prometheus_text,
+    prometheus_text,
+)
+from paddle_tpu.observability.flight_recorder import FlightRecorder
+from paddle_tpu.observability.tracing import (
+    Span,
+    SpanBuffer,
+    Tracer,
+    chrome_trace,
+    export_chrome,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+    set_tracer,
+    stitch,
+)
+from paddle_tpu.serving import ServingEngine, ServingFrontend
+from paddle_tpu.serving.fleet import kv_transfer
+from paddle_tpu.serving.fleet.kv_transfer import (
+    PrefillWorker,
+    RemotePrefillClient,
+)
+from paddle_tpu.serving.http_frontend import read_sse_events
+
+RNG = np.random.RandomState(11)
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture()
+def tracer():
+    """A fresh keep-all default tracer, restored after the test."""
+    tr = Tracer(process="test", sample=1)
+    prev = set_tracer(tr)
+    yield tr
+    set_tracer(prev)
+
+
+# ------------------------------------------------------------- lifecycle
+def test_span_lifecycle_and_buffer(tracer):
+    root = tracer.start_trace("router.request", stream=True)
+    child = tracer.start_span("frontend.request", root, replica=0)
+    child.event("mark", k=1)
+    child.finish(status="DONE")
+    root.finish(outcome="done")
+    assert child.finished and root.finished
+    assert root.duration >= 0 and child.duration >= 0
+    # second finish is a no-op, not a double record
+    root.finish()
+    spans = tracer.buffer.get(root.trace_id)
+    assert len(spans) == 2
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["frontend.request"]["parent_id"] == root.span_id
+    assert by_name["frontend.request"]["attrs"]["status"] == "DONE"
+    assert by_name["frontend.request"]["events"][0]["name"] == "mark"
+    assert by_name["router.request"]["parent_id"] is None
+
+
+def test_traceparent_roundtrip_and_malformed():
+    tr = Tracer(process="p", sample=1)
+    sp = tr.start_trace("r")
+    hdr = format_traceparent(sp)
+    ctx = parse_traceparent(hdr)
+    assert ctx.trace_id == sp.trace_id
+    assert ctx.span_id == sp.span_id
+    assert ctx.sampled
+    # malformed/absent headers are best-effort None, never an error
+    for bad in (None, "", "garbage", "00-zz-yy-01",
+                "00-" + "0" * 32 + "-" + "0" * 16, hdr + "-extra"):
+        assert parse_traceparent(bad) is None
+    # an unsampled upstream decision is honored downstream
+    unsampled = hdr[:-2] + "00"
+    ctx2 = parse_traceparent(unsampled)
+    assert ctx2 is not None and not ctx2.sampled
+    assert tr.start_span("child", ctx2) is None
+
+
+def test_head_sampling(tracer):
+    t3 = Tracer(process="p", sample=3)
+    kept = [t3.start_trace("r") for _ in range(9)]
+    assert sum(1 for s in kept if s is not None) == 3
+    # sampled-out roots propagate None -> no child allocation at all
+    off = Tracer(process="p", sample=0)
+    assert off.start_trace("r") is None
+    assert off.start_span("c", None) is None
+    assert off.record_span("c", None, 0.5) is None
+    assert off.spans_started == 0
+
+
+def test_buffer_bounds():
+    buf = SpanBuffer(max_spans=10, max_traces=3)
+    for t in range(6):
+        for i in range(4):
+            buf.add({"trace_id": f"t{t}", "span_id": f"s{i}",
+                     "name": "x", "start": float(i), "end": float(i)})
+    assert len(buf) <= 10
+    assert len(buf.trace_ids()) <= 3
+    # newest trace survives eviction
+    assert "t5" in buf.trace_ids()
+    # one oversized trace trims its own oldest spans, keeps the tail
+    big = SpanBuffer(max_spans=5, max_traces=4)
+    for i in range(20):
+        big.add({"trace_id": "only", "span_id": f"s{i}", "name": "x",
+                 "start": float(i), "end": float(i)})
+    spans = big.get("only")
+    assert len(spans) == 5
+    assert spans[-1]["span_id"] == "s19"
+
+
+def test_event_ring_bounded():
+    tr = Tracer(process="p", sample=1, event_ring=8)
+    sp = tr.start_trace("engine.decode")
+    for step in range(50):
+        sp.event("decode_step", step=step, occupancy=1)
+    sp.finish()
+    evs = tr.buffer.get(sp.trace_id)[0]["events"]
+    assert len(evs) == 8
+    assert [e["step"] for e in evs] == list(range(42, 50))
+
+
+# ------------------------------------------------------------ HTTP hop
+def test_http_traceparent_propagation(net, tracer):
+    """A router-style traceparent on POST /v1/generate parents the
+    frontend's server span; the engine's queue-wait/prefill/decode
+    spans land under the SAME trace, and /trace serves them."""
+    import http.client
+
+    upstream = Tracer(process="router", sample=1)
+    root = upstream.start_trace("router.request")
+    eng = ServingEngine(net, max_batch_size=2, max_seq_len=32,
+                        min_bucket=8)
+    fe = ServingFrontend(eng).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=60)
+        conn.request(
+            "POST", "/v1/generate",
+            body=json.dumps({
+                "input_ids": [int(t) for t in RNG.randint(0, 64, 6)],
+                "max_new_tokens": 4,
+            }),
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(root)},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = list(read_sse_events(resp))
+        conn.close()
+        assert events[-1][0] == "done"
+
+        conn = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                          timeout=10)
+        conn.request("GET", "/trace")
+        tresp = conn.getresponse()
+        payload = json.loads(tresp.read())
+        conn.close()
+    finally:
+        fe.stop(close_engine=True)
+    groups = {g["trace_id"]: g["spans"] for g in payload["traces"]}
+    assert root.trace_id in groups
+    by_name = {s["name"]: s for s in groups[root.trace_id]}
+    for name in ("frontend.request", "frontend.stream",
+                 "engine.queue_wait", "engine.prefill",
+                 "engine.decode"):
+        assert name in by_name, sorted(by_name)
+    # the server span parents under the ROUTER's span id
+    assert by_name["frontend.request"]["parent_id"] == root.span_id
+    assert by_name["engine.decode"]["attrs"]["status"] == "DONE"
+    assert by_name["engine.decode"]["events"], "decode step ring empty"
+
+
+def test_queue_wait_span_matches_histogram(net, tracer):
+    """The retroactive queue-wait span and the queue_wait histogram
+    sample come from the SAME measured wait."""
+    eng = ServingEngine(net, max_batch_size=1, max_seq_len=32,
+                        min_bucket=8)
+    handles = []
+    for _ in range(2):  # second request actually queues behind slot 0
+        h = eng.submit(RNG.randint(0, 64, (1, 6)), 3)
+        h.trace = tracer.start_trace("frontend.request")
+        handles.append(h)
+    eng.run_until_idle()
+    assert all(h.status == "DONE" for h in handles)
+    snap = eng.metrics.queue_wait.snapshot()
+    assert snap["count"] == 2
+    waits = sorted(
+        s["end"] - s["start"]
+        for s in tracer.buffer.spans()
+        if s["name"] == "engine.queue_wait"
+    )
+    assert len(waits) == 2
+    assert waits[-1] == pytest.approx(snap["max"], abs=1e-6)
+    # exactly ONE decode span per request, each with step events
+    decodes = [s for s in tracer.buffer.spans()
+               if s["name"] == "engine.decode"]
+    assert len(decodes) == 2
+    assert all(s["events"] for s in decodes)
+    assert all(s["attrs"]["tokens"] == 3 for s in decodes)
+
+
+def test_sample_zero_zero_engine_overhead(net):
+    """The pinned acceptance: sampled-out requests allocate NO spans
+    anywhere in the admission/decode path."""
+    tr = Tracer(process="test", sample=0)
+    prev = set_tracer(tr)
+    try:
+        eng = ServingEngine(net, max_batch_size=2, max_seq_len=32,
+                            min_bucket=8)
+        h = eng.submit(RNG.randint(0, 64, (1, 6)), 4)
+        h.trace = tr.start_trace("frontend.request")  # sampled out
+        assert h.trace is None
+        eng.run_until_idle()
+        assert h.status == "DONE"
+        assert tr.spans_started == 0
+        assert len(tr.buffer) == 0
+        assert eng._traced_live == 0
+    finally:
+        set_tracer(prev)
+
+
+# ------------------------------------------------------------- KV hop
+def test_kv_frame_traceparent_and_worker_span(net, tracer):
+    """The PKV2 hop: the client's kv.transfer span crosses the frame
+    protocol as a traceparent header field, and the worker's
+    worker.prefill span ships BACK and lands in the client buffer."""
+    worker = PrefillWorker(net, weights_version="wv1").start()
+    try:
+        client = RemotePrefillClient(
+            "127.0.0.1", worker.port, expected_weights_version="wv1")
+        root = tracer.start_trace("engine.prefill")
+        prompt = [int(t) for t in RNG.randint(0, 64, 6)]
+        t0, flat = client.prefill(
+            prompt, len(prompt), 8, 8, "bfloat16", 1.0,
+            jax.random.PRNGKey(0), trace=root,
+        )
+        assert isinstance(t0, int) and flat is not None
+    finally:
+        worker.stop()
+    spans = tracer.buffer.get(root.trace_id)
+    by_name = {s["name"]: s for s in spans}
+    assert "kv.transfer" in by_name and "worker.prefill" in by_name
+    wire, wsp = by_name["kv.transfer"], by_name["worker.prefill"]
+    assert wire["parent_id"] == root.span_id
+    assert wsp["parent_id"] == wire["span_id"]
+    assert wsp["process"] == "prefill_worker"
+    assert wire["attrs"]["outcome"] == "ok"
+    assert wire["attrs"]["bytes"] > 0
+    # exemplar recorded on the transfer counter
+    ex = client.transfers.exemplars()
+    assert any(e["trace_id"] == root.trace_id for e in ex.values())
+
+
+def test_kv_frame_v1_compat():
+    """Old-protocol frames (PKV1 magic) still parse — the version bump
+    only ADDED optional header fields."""
+    class _Buf:
+        def __init__(self):
+            self.data = b""
+
+        def sendall(self, b):
+            self.data += b
+
+    buf = _Buf()
+    kv_transfer.send_frame(buf, {"kind": "x", "n": 3}, b"payload")
+    assert buf.data[:4] == kv_transfer.MAGIC  # current = PKV2
+    old = kv_transfer.MAGIC_V1 + buf.data[4:]
+    a, b = socket.socketpair()
+    try:
+        a.sendall(old)
+        hdr, blob = kv_transfer.recv_frame(b)
+        assert hdr == {"kind": "x", "n": 3} and blob == b"payload"
+    finally:
+        a.close()
+        b.close()
+
+
+# -------------------------------------------------------- chrome export
+def test_chrome_export_loads_via_profiler(tmp_path, tracer):
+    router = Tracer(process="router", sample=1)
+    root = router.start_trace("router.request")
+    attempt = router.start_span("router.try_replica", root, replica=0)
+    server = tracer.start_span("frontend.request",
+                               format_traceparent(attempt))
+    server.event("mark", step=1)
+    server.finish()
+    attempt.finish(outcome="done")
+    root.finish(outcome="done")
+    spans = router.buffer.spans() + tracer.buffer.spans()
+    doc = chrome_trace(spans)
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert procs == {"router", "test"}
+    path = str(tmp_path / "trace.json")
+    export_chrome(path, spans)
+    res = profiler.load_profiler_result(path)
+    names = set(res.names())
+    assert {"router.request", "router.try_replica",
+            "frontend.request"} <= names
+    # cross-process stitch tagged the shifted process with its offset
+    stitched = stitch(spans)
+    shifted = [s for s in stitched if s["process"] == "test"]
+    assert all("clock_offset_s" in s["attrs"] for s in shifted)
+
+
+# ----------------------------------------------------------- exemplars
+def test_exemplar_exposition_roundtrip():
+    registry = reg_mod.MetricsRegistry()
+    c = reg_mod.Counter("reqs", prom_name="t_reqs_total")
+    hist = reg_mod.Histogram("lat", prom_name="t_lat_seconds",
+                             buckets=(0.1, 1.0))
+    registry.register_all([c, hist])
+    c.inc(trace_id="aa" * 16)
+    hist.observe(0.05, trace_id="bb" * 16)
+    text = prometheus_text(registry, exemplars=True)
+    assert '# {trace_id="' + "aa" * 16 + '"}' in text
+    assert '# {trace_id="' + "bb" * 16 + '"}' in text
+    parsed, found = parse_prometheus_text(text, exemplars=True)
+    assert parsed["t_reqs_total"] == [({}, 1.0)]
+    by_series = {e["series"]: e for e in found}
+    assert by_series["t_reqs_total"]["exemplar_labels"]["trace_id"] \
+        == "aa" * 16
+    bucket = by_series["t_lat_seconds_bucket"]
+    assert bucket["exemplar_labels"]["trace_id"] == "bb" * 16
+    assert bucket["value"] == 0.05
+    # exemplars are strictly opt-in: default exposition stays classic
+    assert "# {" not in prometheus_text(registry)
+    # strict parser: a malformed exemplar is a loud, dedicated error
+    with pytest.raises(ValueError, match="malformed exemplar"):
+        parse_prometheus_text('x_total 1 # {trace_id=nope} 1\n')
+    with pytest.raises(ValueError, match="malformed exemplar"):
+        parse_prometheus_text('x_total 1 # {trace_id="a"}\n')
+    with pytest.raises(ValueError, match="malformed sample value"):
+        parse_prometheus_text('x_total 1 # {trace_id="a"} notanum\n')
+
+
+# ------------------------------------------------------ flight recorder
+def test_flight_bundle_carries_in_flight_traces(tracer):
+    sp = tracer.start_trace("frontend.request", request_id=9)
+    fr = FlightRecorder(capacity=8)
+    fr.record_step({"step": 1, "loss": 0.5})
+    bundle = fr.bundle(reason="test")
+    assert sp.trace_id in bundle["traces_in_flight"]
+    names = {s["name"] for s in bundle["spans_in_flight"]}
+    assert "frontend.request" in names
+    sp.finish()
+    assert sp.trace_id not in get_tracer().active_trace_ids()

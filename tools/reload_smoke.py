@@ -179,6 +179,16 @@ def _phase0_chaos(failures):
                                   {"ckpt_dir": root})
                 for t in threads:
                     t.join(timeout=120)
+                # the staged swap applies at the tail of the step that
+                # drains the last request — join() returns off the
+                # terminal event, which fires BEFORE that tail, so the
+                # driver may still be short of the apply seam here.
+                # Keep the fault armed until the outcome resolves or
+                # the verdict below races the apply itself.
+                deadline = time.monotonic() + 30
+                while (eng.reload_in_progress
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
             # the fault fired at apply (after the drain) — every
             # stream terminal + exact, engine on the OLD weights
             if m.fired("reload.apply") != 1:

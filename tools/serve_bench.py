@@ -178,6 +178,14 @@ def run_bench(args):
         )
     if wire is not None:
         out["wire"] = wire
+        # HTTP mode runs frontend + engine in-process: their spans are
+        # all in the default tracer, no stitching across hosts needed
+        from paddle_tpu.observability.tracing import get_tracer
+
+        out["trace"] = trace_report(
+            get_tracer().buffer.traces(),
+            top_n=args.trace_top, trace_out=args.trace_out,
+        )
     return engine, handles, out
 
 
@@ -517,6 +525,15 @@ def run_fleet_bench(args):
             },
             "wire": {"ttft": _pctl(ttfts), "itl": _pctl(itls)},
         }
+        # stitched distributed traces: the router's own tracer plus
+        # every replica's /trace endpoint (replica buffers already
+        # carry the KV-client and prefill-worker spans)
+        groups = list(router.tracer.buffer.traces())
+        for p in procs:
+            groups.extend(_fetch_remote_traces("127.0.0.1", p.port))
+        out["trace"] = trace_report(
+            groups, top_n=args.trace_top, trace_out=args.trace_out,
+        )
         return out
     finally:
         if router is not None:
@@ -551,6 +568,66 @@ def _pctl(xs):
         "p99": float(np.percentile(a, 99)),
         "max": float(a.max()),
     }
+
+
+def _fetch_remote_traces(host, port, timeout=10.0):
+    """GET /trace from one fleet process; [] on any failure — trace
+    collection must never fail a bench run."""
+    import http.client
+
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.request("GET", "/trace")
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status != 200:
+            return []
+        return json.loads(body).get("traces", [])
+    except Exception:
+        return []
+
+
+def trace_report(span_groups, top_n=8, trace_out=None):
+    """Stitch every collected trace onto one clock, report the per-hop
+    latency breakdown (p50/p99 per span name across all requests), and
+    optionally record the ``top_n`` SLOWEST requests' full stitched
+    traces to ``trace_out`` — the requests worth staring at."""
+    from paddle_tpu.observability.tracing import stitch
+
+    by_trace = {}
+    for s in stitch(span_groups):
+        if s.get("end") is None:
+            continue
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    durs, roots = {}, []
+    for tid, spans in by_trace.items():
+        for s in spans:
+            durs.setdefault(s["name"], []).append(
+                float(s["end"]) - float(s["start"])
+            )
+        root = next((s for s in spans if not s.get("parent_id")), None)
+        if root is not None:
+            roots.append(
+                (float(root["end"]) - float(root["start"]), tid)
+            )
+    report = {
+        "traces": len(by_trace),
+        "hops": {name: _pctl(v) for name, v in sorted(durs.items())},
+    }
+    if trace_out:
+        roots.sort(reverse=True)
+        slow = [
+            {"trace_id": tid, "duration_s": round(d, 6),
+             "spans": sorted(by_trace[tid],
+                             key=lambda s: float(s["start"]))}
+            for d, tid in roots[:top_n]
+        ]
+        with open(trace_out, "w") as f:
+            json.dump({"slowest": slow}, f, indent=2, default=str)
+        report["trace_out"] = trace_out
+        report["recorded"] = len(slow)
+    return report
 
 
 def run_http_trace(engine, trace):
@@ -694,6 +771,14 @@ def main(argv=None):
                     help="max unique per-request tail tokens after the "
                          "shared prefix (--shared-prefix)")
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="record the --trace-top SLOWEST requests' "
+                         "stitched distributed traces to PATH (JSON); "
+                         "the bench report always carries the per-hop "
+                         "p50/p99 breakdown in http/fleet modes")
+    ap.add_argument("--trace-top", type=int, default=8,
+                    help="how many slowest-request traces --trace-out "
+                         "records")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON report only")
     ap.add_argument("--prom-out", default=None, metavar="PATH",

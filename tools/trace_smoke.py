@@ -1,0 +1,253 @@
+"""trace-smoke — end-to-end gate for distributed request tracing.
+
+Spawns a REAL three-process fleet (a prefill-pool worker and two
+prefill-attached replicas) behind an in-process FleetRouter, drives
+concurrent SSE streams, then asserts the tracing contract:
+
+1. **Cross-process stitch**: at least one request produces ONE stitched
+   trace with spans from all three process kinds — router, replica,
+   prefill worker — carried by the ``traceparent`` header on the HTTP
+   hop and the PKV2 KV-frame header on the prefill hop.
+2. **The hops are all there**: that trace holds the router root +
+   attempt spans, the replica's frontend/queue-wait/prefill/decode
+   spans (decode as ONE span with step events), and the worker's
+   ``worker.prefill`` under the replica's ``kv.transfer``.
+3. **Causal time within a process**: inside each process, every child
+   span starts no earlier than its parent — clock-offset correction is
+   only ever applied BETWEEN processes, never within one.
+4. **Exemplars reach the scrape**: the router ``/metrics`` exposition
+   carries ``# {trace_id="..."}`` exemplar suffixes and round-trips
+   the strict parser.
+
+Exit 0 = gate passed. Wired as ``make trace-smoke`` next to
+``fleet-smoke``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# exemplars are opt-in; the gate asserts the opted-in path end to end
+os.environ["PADDLE_TPU_METRICS_EXEMPLARS"] = "1"
+os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "1"
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SEED = 7
+MODEL = ["--vocab", "64", "--hidden", "32", "--layers", "2",
+         "--heads", "4", "--seed", str(SEED)]
+ENGINE = ["--max-batch", "2", "--max-seq", "64", "--min-bucket", "8",
+          "--page-size", "8"]
+N_REQS = 8
+
+
+def _get_json(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return json.loads(body)
+
+
+def _stream_many(port, reqs):
+    from paddle_tpu.serving import stream_generate
+
+    results = [None] * len(reqs)
+
+    def one(i):
+        ids, m = reqs[i]
+        events, _ = stream_generate(
+            "127.0.0.1", port,
+            {"input_ids": [int(t) for t in ids],
+             "max_new_tokens": int(m)},
+        )
+        results[i] = events
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return results
+
+
+def _proc_kind(process):
+    if process == "router":
+        return "router"
+    if process.startswith("replica"):
+        return "replica"
+    if process == "prefill_worker":
+        return "worker"
+    return process
+
+
+def _check_causal_order(spans, failures, tid):
+    """Within one process, a child never starts before its parent."""
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        p = by_id.get(s.get("parent_id") or "")
+        if p is None or p["process"] != s["process"]:
+            continue
+        if float(s["start"]) < float(p["start"]) - 1e-6:
+            failures.append(
+                f"trace {tid[:8]}: {s['name']} starts before its "
+                f"parent {p['name']} in process {s['process']}"
+            )
+
+
+def main():
+    import numpy as np
+
+    from paddle_tpu.observability import parse_prometheus_text
+    from paddle_tpu.observability.tracing import stitch
+    from paddle_tpu.serving.fleet import FleetRouter
+    from paddle_tpu.serving.fleet.launch import spawn, spawn_all
+
+    failures = []
+    rng = np.random.RandomState(5)
+
+    print("trace_smoke: spawning prefill worker + 2 replicas...")
+    worker = spawn("prefill", MODEL)  # replicas need its port
+    attach = ["--prefill-worker", f"127.0.0.1:{worker.port}"]
+    reps = spawn_all([
+        ("replica", MODEL + ENGINE + attach),
+        ("replica", MODEL + ENGINE + attach),
+    ], env={"PADDLE_TPU_TRACE_SAMPLE": "1"})
+    procs = [worker] + list(reps)
+    router = None
+    try:
+        router = FleetRouter(
+            [("127.0.0.1", r.port) for r in reps],
+            health_interval_s=0.05,
+        ).start()
+        reqs = [(list(map(int, rng.randint(0, 64, (6,)))), 8)
+                for _ in range(N_REQS)]
+        results = _stream_many(router.port, reqs)
+        done = sum(
+            1 for ev in results
+            if ev is not None and ev and ev[-1][0] == "done"
+        )
+        print(f"trace_smoke: {done}/{N_REQS} SSE streams done")
+        if done < N_REQS:
+            failures.append(f"only {done}/{N_REQS} streams completed")
+
+        # ---- collect spans from every process ----------------------
+        groups = list(router.tracer.buffer.traces())
+        for r in reps:
+            payload = _get_json(r.port, "/trace")
+            groups.extend(payload.get("traces", []))
+        stitched = stitch(groups)
+        by_trace = {}
+        for s in stitched:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        print(f"trace_smoke: {len(by_trace)} stitched traces, "
+              f"{len(stitched)} spans")
+
+        # ---- 1+2: one trace spans router+replica+worker, all hops --
+        REQUIRED = {
+            "router": {"router.request", "router.try_replica"},
+            "replica": {"frontend.request", "engine.queue_wait",
+                        "engine.prefill", "engine.decode",
+                        "kv.transfer"},
+            "worker": {"worker.prefill"},
+        }
+        full = []
+        for tid, spans in by_trace.items():
+            names = {}
+            for s in spans:
+                names.setdefault(
+                    _proc_kind(s["process"]), set()).add(s["name"])
+            if all(REQUIRED[k] <= names.get(k, set())
+                   for k in REQUIRED):
+                full.append(tid)
+        if not full:
+            got = {
+                tid[:8]: sorted(
+                    f"{_proc_kind(s['process'])}:{s['name']}"
+                    for s in spans
+                )
+                for tid, spans in list(by_trace.items())[:3]
+            }
+            failures.append(
+                f"no trace stitched across router+replica+worker with "
+                f"all hops; sample: {got}"
+            )
+        else:
+            print(f"trace_smoke: {len(full)}/{len(by_trace)} traces "
+                  f"carry router+replica+worker spans with "
+                  f"queue/prefill/decode hops")
+
+        # ---- decode discipline: ONE decode span, step events -------
+        for tid in full:
+            spans = by_trace[tid]
+            decodes = [s for s in spans if s["name"] == "engine.decode"]
+            if len(decodes) != 1:
+                failures.append(
+                    f"trace {tid[:8]}: {len(decodes)} decode spans "
+                    f"(want exactly 1 per request)"
+                )
+            elif not decodes[0].get("events"):
+                failures.append(
+                    f"trace {tid[:8]}: decode span has no step events"
+                )
+            wp = next(s for s in spans
+                      if s["name"] == "worker.prefill")
+            kv = next(s for s in spans if s["name"] == "kv.transfer")
+            if wp["parent_id"] != kv["span_id"]:
+                failures.append(
+                    f"trace {tid[:8]}: worker.prefill not parented "
+                    f"under kv.transfer"
+                )
+            # ---- 3: causal start order within each process ---------
+            _check_causal_order(spans, failures, tid)
+
+        # ---- 4: exemplars visible in router /metrics ---------------
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode("utf-8")
+        conn.close()
+        _, exemplars = parse_prometheus_text(text, exemplars=True)
+        if '# {trace_id="' not in text:
+            failures.append("router /metrics has no exemplar suffixes")
+        elif not exemplars:
+            failures.append("exemplar suffixes did not parse back")
+        else:
+            with_tid = [e for e in exemplars
+                        if e["exemplar_labels"].get("trace_id")]
+            if not with_tid:
+                failures.append(
+                    f"exemplars missing trace_id labels: {exemplars[:3]}"
+                )
+            else:
+                print(f"trace_smoke: {len(with_tid)} exemplars in "
+                      f"router /metrics, parser round-trip ok")
+        router.stop()
+        router = None
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.terminate()
+    if failures:
+        print("trace_smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("trace_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
