@@ -6,7 +6,8 @@ ENV = JAX_PLATFORMS=cpu
 
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
-	reload-smoke train-chaos-smoke prefix-smoke trace-smoke smoke-all
+	reload-smoke train-chaos-smoke prefix-smoke trace-smoke \
+	spec-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step, incl. collective-divergence) + AST lint +
@@ -133,10 +134,19 @@ prefix-smoke:
 trace-smoke:
 	$(ENV) $(PY) tools/trace_smoke.py
 
+# Speculative-decoding gate: perfect-draft leg (layers zeroed from 1
+# so the exit_layer=1 self-draft is bitwise the target) must stream
+# EXACT-EQUAL to vanilla with mean acceptance length > 1 and a
+# tokens/s/request win; an imperfect-draft leg must roll back
+# rejected-tail verify pages with zero leaks; sampled spec streams
+# must be identical slab-vs-paged (position-addressed sampling keys).
+spec-smoke:
+	$(ENV) $(PY) tools/spec_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
-		train-chaos-smoke prefix-smoke trace-smoke
+		train-chaos-smoke prefix-smoke trace-smoke spec-smoke
 	@echo "smoke-all: every gate green"
 
 test:

@@ -23,10 +23,11 @@ from ..core import tape
 from ..core.tensor import Tensor
 
 
-def _select_next(logits, do_sample, temperature, top_k, top_p, key):
-    """logits [B, V] -> next token ids [B]."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def filter_logits(logits, temperature, top_k, top_p):
+    """The sampling head's distribution shaping, factored out so the
+    speculative acceptance math uses the IDENTICAL filtered logits the
+    compiled decode programs sample from: [B, V] float -> fp32 [B, V]
+    with temperature applied and non-nucleus entries at -inf."""
     scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
         # clamp: top_k >= vocab keeps every token (reference generate
@@ -47,6 +48,21 @@ def _select_next(logits, do_sample, temperature, top_k, top_p, key):
         keep = keep.at[:, 0].set(True)
         cutoff = jnp.where(keep, srt, jnp.inf).min(axis=-1, keepdims=True)
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    return scaled
+
+
+def _select_next(logits, do_sample, temperature, top_k, top_p, key):
+    """logits [B, V] -> next token ids [B]. ``key`` is one key [2] for
+    the whole batch (generate()'s per-step chain) or a per-row [B, 2]
+    key array (the serving engines' per-request position-folded keys —
+    each row samples from its own stream)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = filter_logits(logits, temperature, top_k, top_p)
+    if getattr(key, "ndim", 1) == 2:
+        return jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(key, scaled).astype(jnp.int32)
     return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
 

@@ -345,16 +345,21 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, attn_mask=None, caches=None, pos=None,
-                apply_final_norm=True, page_table=None):
+                apply_final_norm=True, page_table=None, exit_layer=None):
         """``caches``: list of per-layer (k_cache, v_cache) for decode
         (returns (hidden, new_caches)); None for the training path.
         With ``page_table`` the caches are per-layer page arenas
         ([num_pages, page_size, kvH, D] x2) and decode attention runs
         through the table (serving's paged KV pool).
         ``apply_final_norm=False`` returns the pre-norm hidden state so
-        a fused norm+matmul head can absorb ``self.norm``."""
+        a fused norm+matmul head can absorb ``self.norm``.
+        ``exit_layer=N`` runs only the first N decoder layers (the
+        self-speculative draft seam: the truncated stack + the shared
+        head IS the draft model — ``caches`` then carries N entries)."""
         cfg = self.config
         S = int(input_ids.shape[1])
+        layers = (self.layers if exit_layer is None
+                  else list(self.layers)[:int(exit_layer)])
         from ..kernels.rope import build_rope_cache
 
         if caches is not None:
@@ -378,7 +383,7 @@ class LlamaModel(nn.Layer):
             cos_t, sin_t = Tensor(cos), Tensor(sin)
             h = self.embed_tokens(input_ids)
             new_caches = []
-            for layer, cache in zip(self.layers, caches):
+            for layer, cache in zip(layers, caches):
                 h, c2 = layer(h, cos_t, sin_t, attn_mask,
                               cache=cache, pos=pos,
                               page_table=page_table)
@@ -387,7 +392,7 @@ class LlamaModel(nn.Layer):
         cos, sin = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
         cos_t, sin_t = Tensor(cos), Tensor(sin)
         h = self.embed_tokens(input_ids)
-        for layer in self.layers:
+        for layer in layers:
             h = layer(h, cos_t, sin_t, attn_mask)
         return self.norm(h) if apply_final_norm else h
 
@@ -447,13 +452,14 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
         )
 
     def forward(self, input_ids, attn_mask=None, caches=None, pos=None,
-                page_table=None):
+                page_table=None, exit_layer=None):
         B, S = int(input_ids.shape[0]), int(input_ids.shape[1])
         sel = self._head_fusion(B * S)
         if caches is not None:
             h, new_caches = self.model(
                 input_ids, attn_mask, caches=caches, pos=pos,
                 apply_final_norm=sel is None, page_table=page_table,
+                exit_layer=exit_layer,
             )
             if sel is not None:
                 logits = self._fused_head(h, sel)
@@ -464,7 +470,8 @@ class LlamaForCausalLM(LlamaFlopsMixin, nn.Layer):
                 )
             return logits, new_caches
         h = self.model(input_ids, attn_mask,
-                       apply_final_norm=sel is None)
+                       apply_final_norm=sel is None,
+                       exit_layer=exit_layer)
         if sel is not None:
             return self._fused_head(h, sel)
         if self.lm_head is None:

@@ -183,6 +183,31 @@ def read_dense(cache, dtype):
     return cache
 
 
+def slab_row_block(cache, slot):
+    """Inverse of :func:`adopt_into_slab`: the ``[1, S, ...]`` block of
+    decode-slab row ``slot`` (traced) — how the speculative verify
+    program materializes one request's KV as a prefill-layout block."""
+    if is_quantized(cache):
+        return QuantizedKV(
+            jax.lax.dynamic_slice_in_dim(cache.q, slot, 1, axis=0),
+            jax.lax.dynamic_slice_in_dim(cache.scale, slot, 1, axis=0),
+        )
+    return jax.lax.dynamic_slice_in_dim(cache, slot, 1, axis=0)
+
+
+def broadcast_rows(cache, n):
+    """``[1, S, ...]`` block -> ``[n, S, ...]`` broadcast: the
+    speculative verify re-read gives every proposed position its own
+    batch row over the SAME written content, so one decode-shaped
+    program scores all K+1 positions at per-row positions."""
+    if is_quantized(cache):
+        return QuantizedKV(
+            jnp.broadcast_to(cache.q, (n,) + cache.q.shape[1:]),
+            jnp.broadcast_to(cache.scale, (n,) + cache.scale.shape[1:]),
+        )
+    return jnp.broadcast_to(cache, (n,) + cache.shape[1:])
+
+
 # ----------------------------------------------------------- adopt programs
 
 

@@ -30,6 +30,13 @@ from decode ACROSS processes — finished KV pages ship over a
 CRC-checked socket and adopt bit-identically to local prefill, with
 clean local fallback.
 
+Speculative decoding (``speculative.SpeculativeDecoder``) pairs a
+small draft (or the target's own early-exit layers) with either
+engine: the draft proposes K tokens, ONE batched target launch
+verifies them, and acceptance keeps greedy streams EXACT-EQUAL to
+vanilla decode (rejection sampling keeps sampled streams
+distribution-equal) — rounds emit 1..K+1 tokens per verify launch.
+
 Everything is pure Python + JAX and CPU-testable;
 ``tools/serve_bench.py`` replays a synthetic Poisson trace offline
 (``--http`` drives real SSE streams over localhost; ``--fleet N``
@@ -63,6 +70,7 @@ from .paged_engine import PagedServingEngine  # noqa: F401
 from .paged_pool import PagedKVPool, PagesExhausted  # noqa: F401
 from .prefix_cache import PrefixCache, PrefixMatch  # noqa: F401
 from .reload import ReloadError, StagedReload  # noqa: F401
+from .sampling_keys import SamplingKeySource  # noqa: F401
 from .scheduler import (  # noqa: F401
     REASON_ENGINE_CLOSED,
     REASON_PAGES_EXHAUSTED,
@@ -75,3 +83,4 @@ from .scheduler import (  # noqa: F401
     RequestHandle,
     Scheduler,
 )
+from .speculative import SpeculativeDecoder  # noqa: F401

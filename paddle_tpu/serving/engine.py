@@ -46,6 +46,7 @@ from ..models.generation import (
 from ..observability.tracing import get_tracer
 from .kv_pool import KVCachePool
 from .metrics import ServingMetrics
+from .sampling_keys import SamplingKeySource
 from .scheduler import (
     CANCELLED,
     DONE,
@@ -84,6 +85,10 @@ def build_prefill_body(net, do_sample, top_k, top_p):
         logits, caches = prefill(
             net, ids, _unflatten(flat_block), length=length
         )
+        if do_sample:
+            # position-addressed randomness (sampling_keys): the first
+            # sampled token lands at cache position `length`
+            key = jax.random.fold_in(key, length)
         nxt = _select_next(logits, do_sample, temperature, top_k, top_p,
                            key)
         return nxt, _flatten(caches)
@@ -106,6 +111,10 @@ def build_chunk_prefill_body(net, do_sample, top_k, top_p):
         logits, caches = prefill(
             net, ids, _unflatten(flat_block), length=length, pos=pos
         )
+        if do_sample:
+            # same address as the cold path: the sampled token's cache
+            # position is pos + length — warm stays bitwise-equal
+            key = jax.random.fold_in(key, pos + length)
         nxt = _select_next(logits, do_sample, temperature, top_k, top_p,
                            key)
         return nxt, _flatten(caches)
@@ -116,12 +125,15 @@ def build_chunk_prefill_body(net, do_sample, top_k, top_p):
 class _Seq:
     """Host-side state of one running sequence (one slab row)."""
 
-    __slots__ = ("handle", "last_tok", "emitted")
+    __slots__ = ("handle", "last_tok", "emitted", "key")
 
-    def __init__(self, handle, first_tok):
+    def __init__(self, handle, first_tok, key=None):
         self.handle = handle
         self.last_tok = first_tok
         self.emitted = 0  # _append counts (prefill's first token too)
+        # the request's base PRNG key (sampling_keys derivation) as a
+        # host array — decode steps stack the active rows' keys
+        self.key = key
 
     @property
     def pos(self):
@@ -147,7 +159,8 @@ class ServingEngine:
                  max_queue_size=64, max_tokens_in_flight=None,
                  scheduler=None, metrics=None, pool=None,
                  clock=time.monotonic, recompile_guard_max=None,
-                 weights_version=None, reload_template=None):
+                 weights_version=None, reload_template=None,
+                 speculative=None):
         cfg = net.config
         self.net = net
         self.config = cfg
@@ -194,13 +207,15 @@ class ServingEngine:
         self._was_training = net.training
         self._init_kv_backend()
         self._seqs = [None] * self.max_batch_size
-        self._key = jax.random.PRNGKey(seed)
+        self._key = jax.random.PRNGKey(seed)  # warmup example key shape
+        self.keys = SamplingKeySource(seed)
         self.step_count = 0
         # donation only helps (and only works) on accelerators; on the
         # CPU CI it would just emit unusable-donation warnings
         accel = any(d.platform != "cpu" for d in jax.devices())
         self._prefill_fns = {}   # bucket -> jitted fn
         self._adopt_fns = {}     # bucket -> jitted fn
+        self._spec_gather_fn = None  # lazy (speculative verify only)
         self._decode_fn = jax.jit(
             self._decode_body, donate_argnums=(3,) if accel else ()
         )
@@ -236,6 +251,12 @@ class ServingEngine:
         self.trace_guard = TraceGuard(max_compiles=recompile_guard_max)
         self.trace_guard.on_fire(self._on_guard_fire)
         self.trace_guard.watch("serving::decode_step", self._decode_fn)
+        # speculative decoding (serving.speculative): when bound, the
+        # decode phase runs propose+verify rounds instead of the fused
+        # per-token step
+        self.speculative = speculative
+        if speculative is not None:
+            speculative.bind(self)
 
     def _init_kv_backend(self):
         """Allocate the resident decode KV state — the slab here
@@ -265,6 +286,11 @@ class ServingEngine:
         logits, caches = decode_step(
             self.net, tok[:, None], _unflatten(flat), pos
         )
+        if self.do_sample:
+            # `key` is [B, 2] — every row carries its request's base
+            # key; the token sampled this step lands at pos + 1, so
+            # fold per row (the sampling_keys position address)
+            key = jax.vmap(jax.random.fold_in)(key, pos + 1)
         nxt = _select_next(logits, self.do_sample, temperature,
                            self.top_k, self.top_p, key)
         return nxt, _flatten(caches)
@@ -329,10 +355,63 @@ class ServingEngine:
         return out
 
     def _next_key(self):
+        """The admitted request's base PRNG key — one per admission,
+        derived by position-addressable fold (sampling_keys), NOT a
+        mutable split chain: the same workload in the same order gets
+        the same keys on every engine geometry."""
         if not self.do_sample:
+            # greedy ignores the key entirely (argmax head) — hand the
+            # constant placeholder instead of a per-admission derivation
             return self._key
-        self._key, sub = jax.random.split(self._key)
-        return sub
+        return self.keys.next_request_key()
+
+    # ------------------------------------------- speculative backend seams
+    #
+    # speculative.SpeculativeDecoder drives its one-launch verify
+    # through these four hooks. The slab backend is trivial — every row
+    # permanently owns the full [0, S_max) span, so reserve always
+    # succeeds and rollback is free (rejected-tail KV sits behind the
+    # position mask until the row's own later writes overwrite it).
+    # The paged engine overrides all four with demand-grown pages.
+
+    def _spec_reserve(self, slot, hi):
+        """Guarantee backend KV capacity for verify writes up to cache
+        position ``hi``; returns the highest position actually held
+        (may clamp below ``hi`` under page pressure)."""
+        return min(hi, self.max_seq_len - 1)
+
+    def _spec_gather(self, slot, hi):
+        """Materialize row ``slot``'s KV as a prefill-layout ``[1, W]``
+        block covering positions [0, ``hi``]; returns
+        ``(flat_block, W)``."""
+        fn = self._spec_gather_fn
+        if fn is None:
+            from ..quantization.kv import slab_row_block
+
+            def body(flat, s):
+                return [slab_row_block(a, s) for a in flat]
+
+            fn = self._spec_gather_fn = jax.jit(body)
+            self.trace_guard.record_compile(
+                "serving::spec_gather", self.max_seq_len,
+                origin="serving/engine.py",
+            )
+        return fn(self._flat, jnp.int32(slot)), self.max_seq_len
+
+    def _spec_adopt(self, slot, new_block, width, pos):
+        """Land a verify-updated block back as row ``slot``'s KV — the
+        same adopt program admission uses, at bucket ``width``
+        (positions < ``pos`` came back unchanged; [pos, pos+K] carry
+        the verify's writes)."""
+        self._flat = self._run(
+            ("adopt", width), self._adopt_fn(width),
+            self._flat, new_block, jnp.int32(slot),
+        )
+
+    def _spec_rollback(self, slot, new_pos):
+        """Drop verify writes past the accepted span (the row's next
+        token feeds at ``new_pos``). Free on the slab; the paged
+        engine releases the rejected tail's demand-claimed pages."""
 
     # ---------------------------------------------------------- requests
     def _too_long(self, req):
@@ -396,6 +475,8 @@ class ServingEngine:
     def _release_slot(self, slot):
         """Return slot ``slot``'s KV residency to the pool (slab row
         here; row + claimed pages in the paged engine)."""
+        if self.speculative is not None:
+            self.speculative.reset_slot(slot)
         self._slab.release(slot)
 
     def _finish(self, slot, status, reason=None):
@@ -465,6 +546,7 @@ class ServingEngine:
         # would wedge forever)
         slot = self._slab.claim()
         assert slot is not None  # caller checked free_slots
+        key = self._next_key()
         psp = None if handle.trace is None else get_tracer().start_span(
             "engine.prefill", handle.trace, mode="local", bucket=bucket
         )
@@ -474,7 +556,7 @@ class ServingEngine:
                     ("prefill", bucket), self._prefill_fn(bucket),
                     self._params, self._buffers, jnp.asarray(ids),
                     jnp.int32(req.prompt_len), _flatten(blk.caches),
-                    jnp.float32(self.temperature), self._next_key(),
+                    jnp.float32(self.temperature), key,
                 )
                 blk.caches = _unflatten(new_flat)
                 self._flat = self._run(
@@ -510,7 +592,7 @@ class ServingEngine:
         self.metrics.ttft.observe(handle.first_token_time
                                   - handle.submit_time, trace_id=tid)
         self._trace_admitted(handle, slot, wait)
-        self._seqs[slot] = _Seq(handle, t0)
+        self._seqs[slot] = _Seq(handle, t0, key=np.asarray(key))
         self._append(slot, t0)
 
     def _decode_extra(self):
@@ -607,18 +689,25 @@ class ServingEngine:
         active = [i for i, s in enumerate(self._seqs) if s is not None]
         if not active:
             return
+        if self.speculative is not None:
+            # propose + one-launch verify per row instead of the fused
+            # per-token step (speculative.py)
+            self.speculative.decode_once(self)
+            return
         tok = np.zeros((self.max_batch_size,), np.int32)
         pos = np.zeros((self.max_batch_size,), np.int32)
+        keys = np.zeros((self.max_batch_size, 2), np.uint32)
         for i in active:
             tok[i] = self._seqs[i].last_tok
             pos[i] = self._seqs[i].pos
+            keys[i] = self._seqs[i].key
         t0 = self.clock()
         with profiler.RecordEvent("serving::decode_step"):
             nxt, self._flat = self._run(
                 ("decode",), self._decode_fn,
                 self._params, self._buffers, jnp.asarray(tok),
                 self._flat, *self._decode_extra(), jnp.asarray(pos),
-                jnp.float32(self.temperature), self._next_key(),
+                jnp.float32(self.temperature), jnp.asarray(keys),
             )
             nxt = np.asarray(nxt)
         dt = self.clock() - t0
@@ -780,8 +869,11 @@ class ServingEngine:
 
     def _on_weights_swapped(self):
         """Post-swap hook, called with the new weights installed and
-        nothing in flight. Base engines have no derived-from-weights
-        state; the paged engine flushes its prefix cache here."""
+        nothing in flight. The paged engine flushes its prefix cache
+        here (and calls up); speculation re-snapshots the self-spec
+        draft and invalidates old-weights draft caches."""
+        if self.speculative is not None:
+            self.speculative.on_weights_swapped(self)
 
     # ------------------------------------------------------- AOT warmup
     def _warmup_buckets(self):
@@ -803,7 +895,8 @@ class ServingEngine:
             self._params, self._buffers, jnp.zeros((B,), jnp.int32),
             self._flat, *self._decode_extra(),
             jnp.zeros((B,), jnp.int32),
-            jnp.float32(self.temperature), self._key,
+            jnp.float32(self.temperature),
+            jnp.zeros((B, 2), jnp.uint32),
         )
 
     def _adopt_example_args(self, flat_block, bucket):
@@ -953,6 +1046,9 @@ class ServingEngine:
         self.trace_guard.unwatch("serving::decode_step")
         self._prefill_fns.clear()
         self._adopt_fns.clear()
+        self._spec_gather_fn = None
+        if self.speculative is not None:
+            self.speculative.unbind()
 
 
 class StaticBatchEngine:

@@ -314,6 +314,19 @@ class ServingFrontend:
             if slab is not None:
                 # slab rows are the closest capacity analogue
                 out["free_pages"] = slab.free_slots
+        spec = getattr(eng, "speculative", None)
+        if spec is not None:
+            # speculative decoding: acceptance stats plus the verify-
+            # page accounting (transient demand-grown pages show in
+            # page_pool.stats() while held; these counters prove the
+            # rejected tails came back)
+            out["speculative"] = spec.stats()
+            out["speculative"]["pages_claimed"] = getattr(
+                eng, "spec_pages_claimed", 0
+            )
+            out["speculative"]["pages_rolled_back"] = getattr(
+                eng, "spec_pages_rolled_back", 0
+            )
         transport = getattr(eng, "prefill_transport", None)
         if transport is not None:
             out["remote_prefill"] = {

@@ -150,6 +150,26 @@ class ServingMetrics:
             "slot_occupancy", unit="slots", export=False,
             prom_name=f"{ns}_slot_occupancy",
             help="active decode-slab slots sampled per engine step")
+        # speculative decoding (serving.speculative): one round = one
+        # draft proposal pass + one target verify launch
+        self.spec_rounds = Counter(
+            "speculative_rounds",
+            prom_name=f"{ns}_speculative_rounds_total",
+            help="speculative propose+verify rounds run")
+        self.spec_proposed = Counter(
+            "speculative_proposed_tokens",
+            prom_name=f"{ns}_speculative_proposed_tokens_total",
+            help="draft tokens proposed to the verifier")
+        self.spec_accepted = Counter(
+            "speculative_accepted_tokens",
+            prom_name=f"{ns}_speculative_accepted_tokens_total",
+            help="draft tokens the verifier accepted")
+        self.spec_accept_length = Histogram(
+            "speculative_accept_length", unit="toks", export=False,
+            prom_name=f"{ns}_speculative_accept_length",
+            help="tokens emitted per speculative round (accepted "
+                 "prefix + the correction/bonus token; mean > 1 is "
+                 "the whole win)")
         reg = registry
         if reg is None:
             from ..observability import get_registry
@@ -162,6 +182,8 @@ class ServingMetrics:
             self.guard_fires, self.reloads, self.reload_ttft_spike,
             self.ttft, self.itl, self.e2e,
             self.queue_wait, self.queue_depth, self.slot_occupancy,
+            self.spec_rounds, self.spec_proposed, self.spec_accepted,
+            self.spec_accept_length,
         ])
 
     def observe_step(self, queue_depth, active_slots):
@@ -186,7 +208,12 @@ class ServingMetrics:
                 "guard_fires_by_fn": self.guard_fires.by_label(),
                 "reloads": self.reloads.value,
                 "reloads_by_outcome": self.reloads.by_label(),
+                "speculative_rounds": self.spec_rounds.value,
+                "speculative_proposed": self.spec_proposed.value,
+                "speculative_accepted": self.spec_accepted.value,
             },
+            "speculative_accept_length":
+                self.spec_accept_length.snapshot(),
             "reload_ttft_spike": self.reload_ttft_spike.snapshot(),
             "ttft": self.ttft.snapshot(),
             "itl": self.itl.snapshot(),

@@ -108,7 +108,7 @@ class PagedServingEngine(ServingEngine):
                  clock=time.monotonic, recompile_guard_max=None,
                  weights_version=None, prefill_transport=None,
                  reload_template=None, prefix_cache=None,
-                 demand_paging=None):
+                 demand_paging=None, speculative=None):
         ps = int(page_size)
         if ps < 1 or (ps & (ps - 1)):
             raise ValueError(
@@ -159,6 +159,7 @@ class PagedServingEngine(ServingEngine):
             recompile_guard_max=recompile_guard_max,
             weights_version=weights_version,
             reload_template=reload_template,
+            speculative=speculative,
         )
         if self.prefix_cache is not None and recompile_guard_max is None:
             # prefix mode legitimately compiles one gather program per
@@ -220,8 +221,15 @@ class PagedServingEngine(ServingEngine):
         self._free_rows = list(range(self.max_batch_size))[::-1]
         self._gather_fns = {}   # bucket -> jitted fn
         self._chunk_fns = {}    # (bucket, tail_bucket) -> jitted fn
+        # speculative-verify page accounting (the zero-leak pin reads
+        # these: every transient verify page claimed must either stay
+        # owned by the accepting request or come back on rollback)
+        self.spec_pages_claimed = 0
+        self.spec_pages_rolled_back = 0
 
     def _release_slot(self, slot):
+        if self.speculative is not None:
+            self.speculative.reset_slot(slot)
         pages = self._row_pages[slot]
         meta = self._row_meta[slot]
         if (pages and meta is not None and self.prefix_cache is not None
@@ -343,6 +351,9 @@ class PagedServingEngine(ServingEngine):
             self.net, tok[:, None], _unflatten(flat), pos,
             page_table=tbl,
         )
+        if self.do_sample:
+            # per-row position-addressed keys (see the base engine)
+            key = jax.vmap(jax.random.fold_in)(key, pos + 1)
         nxt = _select_next(logits, self.do_sample, temperature,
                            self.top_k, self.top_p, key)
         return nxt, _flatten(caches)
@@ -482,6 +493,81 @@ class PagedServingEngine(ServingEngine):
             self.prefix_cache.evict(need)
             return self.page_pool.claim(n)
 
+    # ------------------------------------------- speculative backend seams
+    def _spec_reserve(self, slot, hi):
+        """Demand-claim pages so row ``slot`` holds KV capacity through
+        cache position ``hi`` (the verify writes [pos, hi]); appended
+        to the row's OWNED pages and table like any demand growth, so
+        occupancy gauges count them while held. Under page pressure the
+        round clamps to what the pool can cover — worst case the
+        request's current position, a one-token vanilla-equivalent
+        verify — instead of shedding anybody."""
+        hi = min(hi, self.max_seq_len - 1)
+        pages = self._row_pages[slot]
+        ps = self.page_size
+        while hi // ps >= len(pages):
+            try:
+                new = self._claim_pages(1)
+            except PagesExhausted:
+                break
+            self._tables[slot, len(pages)] = new[0]
+            pages.append(new[0])
+            self.spec_pages_claimed += 1
+        return min(hi, len(pages) * ps - 1)
+
+    def _spec_gather(self, slot, hi):
+        """Row ``slot``'s owned pages as one prefill-layout block wide
+        enough to cover position ``hi`` — the same bucketed gather
+        program the prefix-cache warm path runs (pad ids -> garbage
+        page 0, masked)."""
+        ps = self.page_size
+        bucket = self.pool.bucket_for(hi + 1)
+        pages = self._row_pages[slot]
+        src = np.zeros((bucket // ps,), np.int32)
+        n = min(len(pages), bucket // ps)
+        src[:n] = pages[:n]
+        with profiler.RecordEvent(f"serving::spec_gather_b{bucket}"):
+            flat_block = self._run(
+                ("gather", bucket), self._gather_fn(bucket),
+                self._flat, jnp.asarray(src),
+            )
+        return flat_block, bucket
+
+    def _spec_adopt(self, slot, new_block, width, pos):
+        """Scatter the verify-updated block back — ONLY the pages the
+        verify may have written (index >= pos // page_size; all owned
+        exclusively: pos >= prompt_len, and shared prefix pages end at
+        the prompt's last full-page boundary). Everything below
+        scatters to garbage page 0, so a shared page is never written
+        even with identical content."""
+        ps = self.page_size
+        pages = self._row_pages[slot]
+        page_ids = np.zeros((width // ps,), np.int32)
+        lo = pos // ps
+        n = min(len(pages), width // ps)
+        page_ids[lo:n] = pages[lo:n]
+        self._flat = self._run(
+            ("adopt", width), self._adopt_fn(width),
+            self._flat, new_block, jnp.asarray(page_ids),
+        )
+
+    def _spec_rollback(self, slot, new_pos):
+        """Release the rejected tail's demand-claimed pages (anything
+        past the page holding ``new_pos``) back to the pool and zero
+        their table entries — the zero-leak pin. Classic (non-demand)
+        mode keeps the row's full up-front span untouched."""
+        if not self._demand_paging:
+            return
+        pages = self._row_pages[slot]
+        keep = new_pos // self.page_size + 1
+        if len(pages) <= keep:
+            return
+        tail = pages[keep:]
+        del pages[keep:]
+        self._tables[slot, keep:keep + len(tail)] = 0
+        self.page_pool.release(tail)
+        self.spec_pages_rolled_back += len(tail)
+
     def _on_weights_swapped(self):
         # the reload-flush satellite: every cached page was computed
         # under the weights that just rotated out — a post-swap request
@@ -491,6 +577,9 @@ class PagedServingEngine(ServingEngine):
         # flush returns them all to the freelist.
         if self.prefix_cache is not None:
             self.prefix_cache.flush(reason="weights_reload")
+        # up-call: speculation re-snapshots the self-spec draft and
+        # invalidates old-weights draft caches
+        super()._on_weights_swapped()
 
     # ---------------------------------------------------------- requests
     def _drop_block(self, blk):
@@ -707,7 +796,7 @@ class PagedServingEngine(ServingEngine):
         self.metrics.ttft.observe(handle.first_token_time
                                   - handle.submit_time, trace_id=tid)
         self._trace_admitted(handle, row, wait)
-        self._seqs[row] = _Seq(handle, t0)
+        self._seqs[row] = _Seq(handle, t0, key=np.asarray(key))
         self._append(row, t0)
 
     # ------------------------------------------------------- AOT warmup

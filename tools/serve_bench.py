@@ -57,7 +57,7 @@ def build_trace(n, rate, seed, vocab, prompt_lo, prompt_hi, new_lo,
     return trace
 
 
-def make_engine(args, net):
+def make_engine(args, net, speculative=None):
     from paddle_tpu.serving import PagedServingEngine, ServingEngine
 
     if args.paged:
@@ -65,13 +65,81 @@ def make_engine(args, net):
             net, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
             cache_dtype=args.cache_dtype, min_bucket=args.min_bucket,
             max_queue_size=args.max_queue, page_size=args.page_size,
-            num_pages=args.num_pages,
+            num_pages=args.num_pages, speculative=speculative,
+            demand_paging=getattr(args, "demand_paging", None),
         )
     return ServingEngine(
         net, max_batch_size=args.max_batch, max_seq_len=args.max_seq,
         cache_dtype=args.cache_dtype, min_bucket=args.min_bucket,
-        max_queue_size=args.max_queue,
+        max_queue_size=args.max_queue, speculative=speculative,
     )
+
+
+def parse_speculate(tokens):
+    """``['draft=self:2', 'k=4']`` -> ``{'draft': ('self', 2), 'k': 4}``.
+
+    ``draft=self:<N>`` runs the target's own first N layers as the
+    draft (no extra weights); ``draft=tiny:<L>`` builds a fresh
+    L-layer half-width draft sharing the vocab."""
+    spec = {"k": 4, "draft": ("self", 1)}
+    for t in tokens:
+        key, _, val = t.partition("=")
+        if key == "k":
+            spec["k"] = int(val)
+        elif key == "draft":
+            kind, _, n = val.partition(":")
+            if kind not in ("self", "tiny"):
+                raise SystemExit(
+                    f"--speculate draft must be self:<N> or tiny:<L>, "
+                    f"got {val!r}"
+                )
+            spec["draft"] = (kind, int(n or 1))
+        else:
+            raise SystemExit(f"unknown --speculate key {key!r}")
+    return spec
+
+
+def make_speculative(args, cfg):
+    """Build the SpeculativeDecoder for ``--speculate`` (None when
+    off)."""
+    if not getattr(args, "speculate", None):
+        return None
+    from paddle_tpu.serving import SpeculativeDecoder
+
+    spec = parse_speculate(args.speculate)
+    kind, n = spec["draft"]
+    if kind == "self":
+        return SpeculativeDecoder(exit_layer=n, k=spec["k"])
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(args.seed + 1)
+    dcfg = LlamaConfig.tiny(
+        vocab_size=cfg.vocab_size,
+        hidden_size=max(cfg.hidden_size // 2, 8),
+        intermediate_size=max(cfg.hidden_size, 16),
+        num_hidden_layers=n,
+        num_attention_heads=max(cfg.num_attention_heads // 2, 1),
+    )
+    draft = LlamaForCausalLM(dcfg)
+    draft.eval()
+    return SpeculativeDecoder(draft, k=spec["k"])
+
+
+def zero_from_layer(net, n):
+    """Zero ``o_proj``/``down_proj`` of every decoder layer >= ``n``:
+    with both residual branches producing exact zeros those layers
+    pass the hidden state through UNTOUCHED, so a ``draft=self:<n>``
+    speculator is bitwise the target (full acceptance). This is the
+    upper-bound shape ``make spec-smoke`` uses to demonstrate the
+    mechanical win on CPU without training a real draft."""
+    import jax.numpy as jnp
+
+    for i, layer in enumerate(net.model.layers):
+        if i < n:
+            continue
+        for lin in (layer.self_attn.o_proj, layer.mlp.down_proj):
+            lin.weight.set_value(jnp.zeros_like(lin.weight.value))
 
 
 def run_bench(args):
@@ -88,7 +156,9 @@ def run_bench(args):
     )
     net = LlamaForCausalLM(cfg)
     net.eval()
-    engine = make_engine(args, net)
+    if getattr(args, "zero_from_layer", None) is not None:
+        zero_from_layer(net, args.zero_from_layer)
+    engine = make_engine(args, net, make_speculative(args, cfg))
     trace = build_trace(
         args.requests, args.rate, args.seed, args.vocab,
         args.prompt_min, args.prompt_max, args.new_min, args.new_max,
@@ -117,6 +187,8 @@ def run_bench(args):
             )
         # warmup tokens must not pollute the report
         engine.metrics = type(engine.metrics)()
+        if engine.speculative is not None:
+            engine.speculative.reset_stats()
 
     peak_active = 0
     if args.http:
@@ -158,6 +230,26 @@ def run_bench(args):
         "metrics": rep,
     }
     out["peak_active_requests"] = peak_active
+    if engine.speculative is not None:
+        out["speculative"] = engine.speculative.stats()
+        # the user-visible form of the win: PER-REQUEST acceptance
+        # length (emitted tokens per verify launch) and per-request
+        # decode throughput over the completed population
+        acc = [h.spec_emitted / h.spec_rounds for h in handles
+               if getattr(h, "spec_rounds", 0)]
+        tps = []
+        for h in handles:
+            t0_, t1_ = (getattr(h, "admit_time", None),
+                        getattr(h, "finish_time", None))
+            if (h.status == "DONE" and h.tokens and t0_ and t1_
+                    and t1_ > t0_):
+                tps.append(len(h.tokens) / (t1_ - t0_))
+        out["speculative"]["per_request_accept_length"] = _pctl(acc)
+        out["speculative"]["tokens_s_per_request"] = _pctl(tps)
+        out["speculative"]["pages_claimed"] = getattr(
+            engine, "spec_pages_claimed", 0)
+        out["speculative"]["pages_rolled_back"] = getattr(
+            engine, "spec_pages_rolled_back", 0)
     page_pool = getattr(engine, "page_pool", None)
     if page_pool is not None:
         # occupancy / exhaustion counters in the record (the paged
@@ -739,6 +831,11 @@ def main(argv=None):
                     help="KV page size in tokens (paged engine)")
     ap.add_argument("--num-pages", type=int, default=None,
                     help="usable page count (default: full coverage)")
+    ap.add_argument("--demand-paging", action="store_true",
+                    default=None,
+                    help="paged engine: claim only prompt pages at "
+                         "admission and grow decode (and speculative "
+                         "verify) pages on demand")
     ap.add_argument("--http", action="store_true",
                     help="replay through the HTTP/SSE front-end over "
                          "localhost; records wire-level TTFT/ITL next "
@@ -770,6 +867,23 @@ def main(argv=None):
     ap.add_argument("--tail-max", type=int, default=8,
                     help="max unique per-request tail tokens after the "
                          "shared prefix (--shared-prefix)")
+    ap.add_argument("--speculate", nargs="+", default=None,
+                    metavar="KEY=VAL",
+                    help="speculative decoding: 'draft=self:<N>' "
+                         "(early-exit draft after N target layers, no "
+                         "extra weights) or 'draft=tiny:<L>' (fresh "
+                         "L-layer half-width draft), plus 'k=<K>' "
+                         "proposal length — e.g. "
+                         "--speculate draft=self:1 k=7; the record "
+                         "gains per-request acceptance length and "
+                         "tokens/s/request")
+    ap.add_argument("--zero-from-layer", type=int, default=None,
+                    metavar="N",
+                    help="zero o_proj/down_proj of every layer >= N so "
+                         "those layers are exact identities — makes "
+                         "draft=self:N bitwise-equal to the target "
+                         "(full acceptance), the spec-smoke "
+                         "upper-bound shape")
     ap.add_argument("--no-warmup", dest="warmup", action="store_false")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="record the --trace-top SLOWEST requests' "
@@ -867,6 +981,17 @@ def main(argv=None):
             f"{out['req_s']} req/s, {out['rejected']} rejected, "
             f"{out['timeouts']} timeouts, steps={out['engine_steps']}"
         )
+        sp = out.get("speculative")
+        if sp:
+            tr = sp["tokens_s_per_request"]
+            print(
+                f"speculative ({sp['mode']} k={sp['k']}): "
+                f"mean accept length {sp['mean_accept_length']} over "
+                f"{sp['rounds']} rounds "
+                f"({sp['accepted']}/{sp['proposed']} proposed tokens "
+                f"accepted), tokens/s/request p50="
+                f"{tr.get('p50', 0.0):.1f}"
+            )
         print(engine.metrics.render())
     return out
 

@@ -1,0 +1,169 @@
+"""spec-smoke — end-to-end gate for speculative decoding.
+
+Three legs over the paged engine (demand paging on, CPU-sized llama):
+
+1. EXACTNESS + WIN: a compute-heavy smoke model (hidden 256, 4 layers)
+   with ``o_proj``/``down_proj`` zeroed from layer 1 — layers 1..3 are
+   exact identities, so the ``exit_layer=1`` self-speculative draft is
+   bitwise the target and every proposal is accepted. Greedy spec
+   streams must be EXACT-EQUAL to vanilla decode, mean acceptance
+   length must beat 1, and tokens/s/request (concurrency 1, second
+   pass so compiles are off the clock) must beat the vanilla engine.
+2. ZERO LEAKS UNDER REJECTION: an UN-zeroed model, where the early-exit
+   draft is frequently wrong — rejected-tail verify pages must be
+   rolled back (``spec_pages_rolled_back > 0``) and the pool must
+   drain to zero with claims == releases. Streams still EXACT-EQUAL.
+3. SAMPLED DETERMINISM: with ``do_sample`` on, the speculative paged
+   stream must equal the speculative slab stream token-for-token (the
+   position-addressed sampling-key pin that makes rejection-sampling
+   acceptance reproducible across engines).
+
+The zeroed-layer trick is an honest UPPER BOUND shape (perfect draft):
+it demonstrates the mechanical speedup without training a real draft;
+leg 2 exercises the rejection machinery the upper bound never hits.
+
+Exit 0 = gate passed. Wired as ``make spec-smoke``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _streams(engine, prompts, max_new):
+    hs = engine.generate(prompts, max_new_tokens=max_new)
+    assert all(h.status == "DONE" for h in hs), [
+        (h.status, h.reason) for h in hs
+    ]
+    return [list(h.tokens) for h in hs]
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import (
+        PagedServingEngine,
+        ServingEngine,
+        SpeculativeDecoder,
+    )
+    from serve_bench import zero_from_layer
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"spec_smoke: {'PASS' if ok else 'FAIL'} {name} {detail}")
+        if not ok:
+            failures.append(name)
+
+    # -- leg 1: perfect-draft exactness + measured win -------------------
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    zero_from_layer(net, 1)  # layers 1..3 -> identity: self:1 is exact
+
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 512, (L,)).tolist()
+               for L in (8, 13, 21, 17)]
+    max_new = 32
+
+    def timed_pass(mk):
+        # pass 1 compiles everything; pass 2 is the timed one
+        eng = mk()
+        _streams(eng, prompts, max_new)
+        if eng.speculative is not None:
+            eng.speculative.reset_stats()
+        t0 = time.monotonic()
+        toks = _streams(eng, prompts, max_new)
+        wall = time.monotonic() - t0
+        return eng, toks, sum(len(t) for t in toks) / wall
+
+    kw = dict(max_batch_size=1, max_seq_len=64, page_size=16,
+              prefix_cache=False, demand_paging=True)
+    van_eng, van_toks, van_tps = timed_pass(
+        lambda: PagedServingEngine(net, **kw))
+    spec_eng, spec_toks, spec_tps = timed_pass(
+        lambda: PagedServingEngine(
+            net, speculative=SpeculativeDecoder(exit_layer=1, k=7),
+            **kw))
+    st = spec_eng.speculative.stats()
+    check("greedy_exact", spec_toks == van_toks)
+    check("mean_accept_gt_1",
+          st["mean_accept_length"] is not None
+          and st["mean_accept_length"] > 1.0,
+          f"(mean accept {st['mean_accept_length']}, "
+          f"{st['accepted']}/{st['proposed']} accepted)")
+    check("tokens_s_win", spec_tps > van_tps,
+          f"(spec {spec_tps:.1f} vs vanilla {van_tps:.1f} tok/s/req, "
+          f"x{spec_tps / max(van_tps, 1e-9):.2f})")
+    pp = spec_eng.page_pool.stats()
+    check("leg1_pool_drained",
+          pp["pages_in_use"] == 0 and pp["claims"] == pp["releases"],
+          f"(in_use {pp['pages_in_use']}, claims {pp['claims']}, "
+          f"releases {pp['releases']})")
+    van_eng.close()
+    spec_eng.close()
+
+    # -- leg 2: imperfect draft -> rollback, zero leaks ------------------
+    paddle.seed(6)
+    cfg2 = LlamaConfig.tiny(
+        vocab_size=97, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+    )
+    net2 = LlamaForCausalLM(cfg2)
+    net2.eval()
+    prompts2 = [rng.randint(1, 97, (L,)).tolist() for L in (5, 11, 19)]
+
+    v2 = PagedServingEngine(net2, **kw)
+    base2 = _streams(v2, prompts2, 16)
+    v2.close()
+    s2 = PagedServingEngine(
+        net2, speculative=SpeculativeDecoder(exit_layer=1, k=4), **kw)
+    spec2 = _streams(s2, prompts2, 16)
+    pp2 = s2.page_pool.stats()
+    check("rejecting_exact", spec2 == base2)
+    check("rollback_fired", s2.spec_pages_rolled_back > 0,
+          f"(claimed {s2.spec_pages_claimed}, "
+          f"rolled back {s2.spec_pages_rolled_back})")
+    check("leg2_zero_leaks",
+          pp2["pages_in_use"] == 0 and pp2["claims"] == pp2["releases"],
+          f"(in_use {pp2['pages_in_use']}, claims {pp2['claims']}, "
+          f"releases {pp2['releases']})")
+    s2.close()
+
+    # -- leg 3: sampled spec determinism across engines ------------------
+    samp = dict(do_sample=True, temperature=0.9, top_k=20, top_p=0.95,
+                seed=7)
+    a = ServingEngine(
+        net2, max_batch_size=2, max_seq_len=64,
+        speculative=SpeculativeDecoder(exit_layer=1, k=4), **samp)
+    slab_toks = _streams(a, prompts2, 16)
+    a.close()
+    b = PagedServingEngine(
+        net2, speculative=SpeculativeDecoder(exit_layer=1, k=4),
+        **kw, **samp)
+    paged_toks = _streams(b, prompts2, 16)
+    b.close()
+    check("sampled_slab_eq_paged", slab_toks == paged_toks)
+
+    if failures:
+        print(f"spec_smoke: FAILED ({failures})")
+        return 1
+    print("spec_smoke: all green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
