@@ -7,16 +7,17 @@ ENV = JAX_PLATFORMS=cpu
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
 	reload-smoke train-chaos-smoke prefix-smoke trace-smoke \
-	spec-smoke smoke-all
+	spec-smoke memlint-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step, incl. collective-divergence) + AST lint +
 # the distributed-correctness passes (rank-conditional/off-main-thread
-# collectives, lock-order/unlocked-write/blocking-under-lock) +
-# API-surface audit, diffed against the checked-in baseline. Exit
-# nonzero on any new finding.
+# collectives, lock-order/unlocked-write/blocking-under-lock) + the
+# donation-aware HBM footprint pass (hbm-budget-exceeded/peak-doubling/
+# transient-blowup) + API-surface audit, diffed against the checked-in
+# baseline. Exit nonzero on any new finding.
 lint:
-	$(ENV) $(PY) tools/tpu_lint.py --audit-api --concurrency
+	$(ENV) $(PY) tools/tpu_lint.py --audit-api --concurrency --memory
 
 # Source-only lint (seconds): for tight edit loops.
 lint-fast:
@@ -25,7 +26,8 @@ lint-fast:
 # Accept the current findings (each new entry needs a documented `why`
 # before review).
 lint-update:
-	$(ENV) $(PY) tools/tpu_lint.py --update-baseline --concurrency
+	$(ENV) $(PY) tools/tpu_lint.py --update-baseline --concurrency \
+		--memory
 
 # Tier-1: the suite the driver gates on (kept `not slow`).
 tier1:
@@ -143,10 +145,22 @@ trace-smoke:
 spec-smoke:
 	$(ENV) $(PY) tools/spec_smoke.py
 
+# HBM-footprint gate: slab + paged + speculative engine warmups must
+# fill the per-program peak-bytes table with ZERO estimator-vs-
+# memory_analysis drift (±20% on every compiled program), the train
+# step must agree under donation and publish its gauge, a seeded tiny
+# budget must fire hbm-budget-exceeded (default silent) with
+# peak-doubling firing undonated/silent donated, and the virtual-mesh
+# 7B per-chip aval math must reproduce the pp-sharded 18.38 GiB
+# analytic figure (merged into LOWER_7B.json).
+memlint-smoke:
+	$(ENV) $(PY) tools/memlint_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
-		train-chaos-smoke prefix-smoke trace-smoke spec-smoke
+		train-chaos-smoke prefix-smoke trace-smoke spec-smoke \
+		memlint-smoke
 	@echo "smoke-all: every gate green"
 
 test:

@@ -27,6 +27,10 @@ offline, the way upstream gates kernels through compile-time checks:
   (``instrument_locks`` / ``PADDLE_TPU_LOCK_SENTINEL=1``) that catch
   ACTUAL lock-order inversions and long holds under the chaos
   harnesses, publishing ``paddle_analysis_lock_*`` metrics.
+- :mod:`memory_lint` — donation-aware live-range HBM footprint
+  estimator over closed jaxprs (``hbm-budget-exceeded``,
+  ``peak-doubling``, ``transient-blowup``), validated against
+  ``compiled.memory_analysis()`` where the installed jax exposes it.
 - :mod:`baseline` — the ratchet: CI fails only on findings not in the
   checked-in baseline (``tools/tpu_lint_baseline.json``).
 
@@ -51,6 +55,20 @@ from .jaxpr_lint import (
     lint_fn,
     lint_jitted,
 )
+from .memory_lint import (
+    DEVICE_HBM_BUDGETS,
+    MemoryConfig,
+    MemoryEstimate,
+    budget_for_device_kind,
+    drift_finding,
+    estimate_closed,
+    estimate_fn,
+    lint_estimate,
+    lint_memory_closed,
+    lint_memory_fn,
+    per_chip_bytes,
+    xla_memory_stats,
+)
 from .lock_sentinel import (
     LockSentinel,
     SentinelLock,
@@ -71,6 +89,10 @@ from .trace_guard import (
 __all__ = [
     "Finding", "Report", "Severity", "LintConfig",
     "lint_closed_jaxpr", "lint_fn", "lint_jitted",
+    "MemoryConfig", "MemoryEstimate", "DEVICE_HBM_BUDGETS",
+    "budget_for_device_kind", "per_chip_bytes", "estimate_closed",
+    "estimate_fn", "lint_estimate", "lint_memory_closed",
+    "lint_memory_fn", "xla_memory_stats", "drift_finding",
     "lint_source", "lint_file", "lint_path",
     "collective_lint", "concurrency_lint", "lock_sentinel",
     "TraceGuard", "get_guard", "use_guard", "record_compile",

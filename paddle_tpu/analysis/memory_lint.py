@@ -1,0 +1,571 @@
+"""Donation-aware live-range HBM footprint analysis over closed jaxprs.
+
+Every memory claim this repo makes (the 7B per-chip budget table, the
+paged-vs-slab concurrency wins, int8 KV capacity) was hand-analytic
+until now; this pass derives a peak-resident-bytes figure from the
+*program itself*, so an OOM in a decode step, a prefill bucket or a
+speculative verify program is discoverable before any chip time is
+burned.
+
+The model walks a closed jaxpr's eqns as a timeline:
+
+- undonated inputs and captured consts are resident for the whole
+  program (XLA holds the caller's buffers alive);
+- a DONATED input dies at its last use, and when that last use produces
+  an output of the same shape/dtype the buffer is reused in place (the
+  aliasing XLA actually performs) — the donation credit;
+- an intermediate lives from its defining eqn to its last use; program
+  outputs live from their defining eqn to the end;
+- while an eqn executes, its outputs coexist with its operands, and a
+  structured-control-flow eqn (scan/while/cond/pjit/shard_map) adds its
+  sub-jaxpr's own internal transient peak (one loop iteration's
+  internals — XLA reuses the body buffers across trips).
+
+``peak_bytes`` is the max over that timeline. It deliberately ignores
+fusion (XLA fuses elementwise chains into zero materialized
+intermediates), so it is an *upper-bound-shaped estimate*, validated
+against ``compiled.memory_analysis()`` where the installed jax exposes
+it (:func:`xla_memory_stats` / :func:`drift_finding` — drift beyond the
+gate is a counted finding, not a silent miss).
+
+Per-chip figures use ``sharding.shard_shape`` on any leaf that carries
+a sharding (:func:`per_chip_bytes` — the ``lower_7b.measured_per_chip``
+discipline, generalized): intermediates without sharding metadata are
+counted full-size, so the per-chip peak is exact for the
+state-dominated programs it gates (the 7B layouts) and conservative
+elsewhere.
+
+Rules (ratcheted through the same baseline as every other lint):
+
+- ``hbm-budget-exceeded``  estimated peak above the device-kind budget
+                           table (or an explicit ``budget_bytes=``).
+- ``peak-doubling``        the whole-program peak holds >= 2x the
+                           program's own argument bytes — the
+                           missed-donation / extra-copy shape (a train
+                           or optimizer step that double-buffers its
+                           state).
+- ``transient-blowup``     one eqn materializes a single output above a
+                           configurable fraction of budget (the
+                           attention-matrix / one-hot blowup shape).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+
+from .findings import Finding, Report, Severity
+from .jaxpr_lint import (
+    ClosedJaxpr,
+    Var,
+    _aval_str,
+    _donated_flags,
+    _nbytes,
+    _src,
+    _sub_jaxprs,
+)
+
+_GIB = 1 << 30
+
+#: device_kind (``jax.devices()[0].device_kind``) prefix -> HBM bytes.
+#: Matched longest-prefix-first, case-insensitive. The cpu row is a
+#: stand-in budget so dogfooding on the CPU backend exercises the same
+#: rule path (host RAM class, not a chip claim).
+DEVICE_HBM_BUDGETS = {
+    "TPU v3": 16 * _GIB,
+    "TPU v4": 32 * _GIB,
+    "TPU v5 lite": 16 * _GIB,
+    "TPU v5e": 16 * _GIB,
+    "TPU v5p": 95 * _GIB,
+    "TPU v5": 95 * _GIB,
+    "TPU v6 lite": 32 * _GIB,
+    "TPU v6e": 32 * _GIB,
+    "cpu": 64 * _GIB,
+}
+
+
+def budget_for_device_kind(kind):
+    """HBM budget for a device-kind string (longest matching prefix of
+    :data:`DEVICE_HBM_BUDGETS`), or None when the kind is unknown."""
+    if not kind:
+        return None
+    k = str(kind).lower()
+    best = None
+    for prefix, bytes_ in DEVICE_HBM_BUDGETS.items():
+        if k.startswith(prefix.lower()):
+            if best is None or len(prefix) > best[0]:
+                best = (len(prefix), bytes_)
+    return None if best is None else best[1]
+
+
+@dataclasses.dataclass
+class MemoryConfig:
+    """Budgets and rule thresholds. Tests shrink them to force
+    firings; the CLI uses the defaults against the device table."""
+
+    #: explicit budget; None -> look up ``device_kind`` in the table
+    budget_bytes: int | None = None
+    #: None -> ``jax.devices()[0].device_kind``
+    device_kind: str | None = None
+    #: fraction of the budget a program may use before the budget rule
+    #: fires (headroom for the allocator, infeed, and the runtime)
+    budget_fraction: float = 0.9
+    peak_doubling_ratio: float = 2.0
+    #: floor below which peak-doubling stays silent (tiny test graphs
+    #: double constantly and harmlessly)
+    min_peak_doubling_bytes: int = 64 << 20
+    #: single-output transient threshold, as a fraction of budget
+    transient_fraction: float = 0.5
+    min_transient_bytes: int = 64 << 20
+
+    def resolved_budget(self):
+        if self.budget_bytes is not None:
+            return int(self.budget_bytes)
+        kind = self.device_kind
+        if kind is None:
+            try:
+                kind = jax.devices()[0].device_kind
+            except Exception:
+                return None
+        return budget_for_device_kind(kind)
+
+
+def per_chip_bytes(x):
+    """Bytes of one shard of ``x`` (an aval, jax.Array or
+    ShapeDtypeStruct): ``sharding.shard_shape`` when a sharding is
+    attached, full size otherwise — the ``lower_7b`` measurement
+    discipline as a reusable primitive."""
+    shape = tuple(getattr(x, "shape", ()) or ())
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        return 0
+    sh = getattr(x, "sharding", None)
+    if sh is not None and hasattr(sh, "shard_shape"):
+        try:
+            shape = tuple(sh.shard_shape(shape))
+        except Exception:
+            pass
+    return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """One program's footprint: the whole-program byte classes plus the
+    timeline peak and its provenance."""
+
+    graph: str
+    args_bytes: int
+    donated_bytes: int
+    consts_bytes: int
+    outputs_bytes: int
+    peak_bytes: int
+    peak_where: str          # eqn provenance at the peak instant
+    max_single_bytes: int    # largest single eqn output anywhere
+    max_single_aval: str
+    max_single_where: str
+    n_eqns: int
+    #: args bytes with sharded leaves scaled by shard_shape (equals
+    #: args_bytes when no input carries a sharding)
+    per_chip_args_bytes: int
+
+    @property
+    def per_chip_peak_bytes(self):
+        """Peak with the args' sharding applied; intermediates carry no
+        sharding metadata and stay full-size (exact for
+        state-dominated programs, conservative elsewhere)."""
+        return self.peak_bytes - self.args_bytes + self.per_chip_args_bytes
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["per_chip_peak_bytes"] = self.per_chip_peak_bytes
+        d["peak_gib"] = round(self.peak_bytes / _GIB, 4)
+        d["per_chip_peak_gib"] = round(self.per_chip_peak_bytes / _GIB, 4)
+        return d
+
+
+def _is_var(v):
+    return isinstance(v, Var)
+
+
+# ------------------------------------------------------- fusion discount
+# XLA loop-fuses an elementwise producer into its single consumer (the
+# whole adam update chain is ONE kernel with zero materialized
+# intermediates); counting every chain link would overestimate
+# elementwise-heavy programs ~2x (measured on the dogfood optimizer
+# step). An elementwise output with exactly one fusible consumer — and
+# any pure aliasing op's output — is therefore not charged a buffer.
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "neg", "sign", "abs", "max", "min",
+    "pow", "integer_pow", "sqrt", "rsqrt", "exp", "log", "log1p",
+    "expm1", "tanh", "logistic", "erf", "erfc", "erf_inv", "sin",
+    "cos", "floor", "ceil", "round", "clamp", "select_n", "rem",
+    "and", "or", "xor", "not", "eq", "ne", "ge", "gt", "le", "lt",
+    "convert_element_type", "is_finite", "nextafter", "square",
+    "cbrt", "atan2", "real", "imag",
+}
+#: consumers an elementwise producer fuses INTO (elementwise chains,
+#: reductions, shape ops). A dot/conv/scatter consumer reads a
+#: materialized operand — no discount.
+_FUSIBLE_CONSUMERS = _ELEMENTWISE | {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin",
+    "broadcast_in_dim", "reshape", "squeeze", "expand_dims",
+    "transpose", "slice", "rev",
+}
+#: pure metadata ops: the output aliases the operand's buffer
+_ALIAS_PRIMS = {"reshape", "squeeze", "expand_dims",
+                "bitcast_convert_type"}
+
+
+def _consumer_prims(jaxpr):
+    """Var -> list of consuming primitive names at this jaxpr level
+    (program outvars additionally count as a 'return' consumer)."""
+    cons = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if _is_var(v):
+                cons.setdefault(v, []).append(eqn.primitive.name)
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            cons.setdefault(v, []).append("return")
+    return cons
+
+
+def _fused_away(eqn, v, consumers):
+    """True when ``v`` (an output of ``eqn``) never owns a buffer."""
+    prim = eqn.primitive.name
+    c = consumers.get(v, ())
+    if prim in _ALIAS_PRIMS:
+        # a program output must own its buffer (its aliased operand is
+        # freed at the alias point; the result is returned)
+        return "return" not in c
+    if prim not in _ELEMENTWISE:
+        return False
+    # XLA duplicates a cheap elementwise producer into EVERY fusible
+    # consumer (no buffer even with fan-out); one non-fusible consumer
+    # (dot/conv/scatter) forces materialization
+    return bool(c) and all(p in _FUSIBLE_CONSUMERS for p in c)
+
+
+def _transient_peak(jaxpr):
+    """Internal liveness peak of a sub-jaxpr: consts + intermediates
+    over its own timeline. Its invars are bound to outer buffers (the
+    outer walk already counts them) and its outvars alias the outer
+    eqn's outputs, so neither is pinned here — this is the *extra*
+    memory one trip through the body holds."""
+    live = {}
+    for cv in jaxpr.constvars:
+        live[cv] = _nbytes(cv.aval)
+    last = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last[v] = i
+    consumers = _consumer_prims(jaxpr)
+    live_bytes = sum(live.values())
+    peak = live_bytes
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(
+            _nbytes(v.aval) for v in eqn.outvars
+            if not _fused_away(eqn, v, consumers)
+        )
+        sub_t = max(
+            (_transient_peak(s) for s in _sub_jaxprs(eqn)), default=0
+        )
+        peak = max(peak, live_bytes + out_b + sub_t)
+        for v in eqn.outvars:
+            if _is_var(v) and last.get(v, -1) > i \
+                    and not _fused_away(eqn, v, consumers):
+                live[v] = _nbytes(v.aval)
+                live_bytes += live[v]
+        for v in {v for v in eqn.invars if _is_var(v)}:
+            if last.get(v) == i and v in live:
+                live_bytes -= live.pop(v)
+    return peak
+
+
+def estimate_closed(closed, *, graph="", donated=None, arg_shardings=None,
+                    config=None):
+    """Walk one closed jaxpr and return a :class:`MemoryEstimate`.
+
+    ``donated``: per-invar bools (the production call site's
+    ``donate_argnums``, flattened — ``jaxpr_lint._donated_flags``).
+    ``arg_shardings``: optional per-invar sharding objects for the
+    per-chip figures (traced avals on this jax don't carry shardings,
+    so the example args' must be passed alongside).
+    """
+    del config  # config gates the rules, not the estimate
+    jaxpr = closed.jaxpr if isinstance(closed, ClosedJaxpr) else closed
+    invars, n = jaxpr.invars, len(jaxpr.eqns)
+    donated = list(donated) if donated is not None else []
+    donated += [False] * (len(invars) - len(donated))
+    shardings = list(arg_shardings) if arg_shardings is not None else []
+    shardings += [None] * (len(invars) - len(shardings))
+
+    args_bytes = sum(_nbytes(v.aval) for v in invars)
+    donated_bytes = sum(
+        _nbytes(v.aval) for v, d in zip(invars, donated) if d
+    )
+    consts_bytes = sum(_nbytes(v.aval) for v in jaxpr.constvars)
+    outputs_bytes = sum(
+        _nbytes(getattr(v, "aval", None)) if hasattr(v, "aval") else 0
+        for v in jaxpr.outvars
+    )
+    per_chip_args = 0
+    for v, sh in zip(invars, shardings):
+        if sh is not None and hasattr(sh, "shard_shape"):
+            try:
+                shard = tuple(sh.shard_shape(tuple(v.aval.shape)))
+                per_chip_args += int(
+                    np.prod(shard, dtype=np.int64)
+                ) * np.dtype(v.aval.dtype).itemsize
+                continue
+            except Exception:
+                pass
+        per_chip_args += _nbytes(v.aval)
+
+    # ---- liveness ----------------------------------------------------
+    last = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            last[v] = n  # program outputs live to the end
+    # donation pairing: a donated input whose shape/dtype matches a
+    # program output is aliased in place by XLA (the output IS the
+    # donated buffer, written through the whole program) — pin the
+    # input to program end and never charge the paired output.
+    # Donated-but-unmatched inputs die at their last use instead.
+    out_slots = {}
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            aval = getattr(v, "aval", None)
+            if aval is not None and getattr(aval, "shape", None) \
+                    is not None:
+                k = (tuple(aval.shape), np.dtype(aval.dtype).name)
+                out_slots.setdefault(k, []).append(v)
+    paired_out = set()
+    live = {cv: _nbytes(cv.aval) for cv in jaxpr.constvars}
+    for v, d in zip(invars, donated):
+        live[v] = _nbytes(v.aval)
+        if not d:
+            last[v] = n  # undonated: resident whole program
+            continue
+        k = (tuple(v.aval.shape), np.dtype(v.aval.dtype).name)
+        slots = out_slots.get(k)
+        if slots:
+            w = slots.pop()
+            if w is not v:
+                paired_out.add(w)
+            last[v] = n  # the buffer lives on as the output
+        # else: donated and consumed — dies at its natural last use
+
+    consumers = _consumer_prims(jaxpr)
+    live_bytes = sum(live.values())
+    peak, peak_where = live_bytes, "entry"
+    max_single, max_single_aval, max_single_where = 0, "", ""
+
+    def _scan_single(jx):
+        """Largest single eqn output at any depth (transient rule)."""
+        nonlocal max_single, max_single_aval, max_single_where
+        for eqn in jx.eqns:
+            for ov in eqn.outvars:
+                nb = _nbytes(getattr(ov, "aval", None)) if hasattr(
+                    ov, "aval") else 0
+                if nb > max_single:
+                    max_single = nb
+                    max_single_aval = _aval_str(ov.aval)
+                    max_single_where = _src(eqn) or eqn.primitive.name
+            for sub in _sub_jaxprs(eqn):
+                _scan_single(sub)
+
+    _scan_single(jaxpr)
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(
+            _nbytes(v.aval) for v in eqn.outvars
+            if v not in paired_out
+            and not _fused_away(eqn, v, consumers)
+        )
+        sub_t = max(
+            (_transient_peak(s) for s in _sub_jaxprs(eqn)), default=0
+        )
+        during = live_bytes + out_b + sub_t
+        if during > peak:
+            peak = during
+            peak_where = _src(eqn) or eqn.primitive.name
+        for v in eqn.outvars:
+            if _is_var(v) and last.get(v, -1) > i and v not in live \
+                    and v not in paired_out \
+                    and not _fused_away(eqn, v, consumers):
+                live[v] = _nbytes(v.aval)
+                live_bytes += live[v]
+        for v in {v for v in eqn.invars if _is_var(v)}:
+            if last.get(v) == i and v in live:
+                live_bytes -= live.pop(v)
+    peak = max(peak, live_bytes)
+
+    return MemoryEstimate(
+        graph=graph, args_bytes=args_bytes, donated_bytes=donated_bytes,
+        consts_bytes=consts_bytes, outputs_bytes=outputs_bytes,
+        peak_bytes=peak, peak_where=peak_where,
+        max_single_bytes=max_single, max_single_aval=max_single_aval,
+        max_single_where=max_single_where, n_eqns=n,
+        per_chip_args_bytes=per_chip_args,
+    )
+
+
+def lint_estimate(est, *, config=None):
+    """The three ratcheted rules over one :class:`MemoryEstimate`."""
+    cfg = config or MemoryConfig()
+    rep = Report()
+    budget = cfg.resolved_budget()
+    usable = None if budget is None else int(budget * cfg.budget_fraction)
+    if usable is not None and est.peak_bytes > usable:
+        rep.add(Finding(
+            rule="hbm-budget-exceeded", severity=Severity.ERROR,
+            message=(
+                f"estimated peak {est.peak_bytes / _GIB:.2f} GiB exceeds "
+                f"{cfg.budget_fraction:.0%} of the "
+                f"{budget / _GIB:.0f} GiB device budget "
+                f"(peak at {est.peak_where or 'entry'})"
+            ),
+            graph=est.graph, where=est.peak_where,
+            detail=f"budget:{budget >> 30}GiB",
+        ))
+    base = est.args_bytes + est.consts_bytes
+    if (
+        base >= cfg.min_peak_doubling_bytes
+        and est.peak_bytes >= cfg.peak_doubling_ratio * base
+    ):
+        rep.add(Finding(
+            rule="peak-doubling", severity=Severity.WARNING,
+            message=(
+                f"peak {est.peak_bytes / _GIB:.2f} GiB is "
+                f"{est.peak_bytes / max(base, 1):.1f}x the program's own "
+                f"{base / _GIB:.2f} GiB of arguments — the missed-"
+                f"donation / extra-copy shape (donate the state or drop "
+                f"the copy; peak at {est.peak_where or 'entry'})"
+            ),
+            graph=est.graph, where=est.peak_where,
+            detail=f"ratio>={cfg.peak_doubling_ratio:g}",
+        ))
+    if (
+        usable is not None
+        and est.max_single_bytes >= cfg.min_transient_bytes
+        and est.max_single_bytes >= cfg.transient_fraction * budget
+    ):
+        rep.add(Finding(
+            rule="transient-blowup", severity=Severity.WARNING,
+            message=(
+                f"one eqn materializes {est.max_single_aval} "
+                f"({est.max_single_bytes / _GIB:.2f} GiB, "
+                f">{cfg.transient_fraction:.0%} of the "
+                f"{budget / _GIB:.0f} GiB budget) at "
+                f"{est.max_single_where}"
+            ),
+            graph=est.graph, where=est.max_single_where,
+            detail=f"single:{est.max_single_aval}",
+        ))
+    return rep
+
+
+def lint_memory_closed(closed, *, graph="", donated=None,
+                       arg_shardings=None, config=None):
+    """Estimate + rules in one call (what tpu_lint's --memory runs)."""
+    est = estimate_closed(
+        closed, graph=graph, donated=donated, arg_shardings=arg_shardings,
+    )
+    return lint_estimate(est, config=config), est
+
+
+def _leaf_shardings(args, static_argnums=()):
+    static = set(static_argnums or ())
+    out = []
+    for i, a in enumerate(args):
+        if i in static:
+            continue
+        for leaf in jax.tree_util.tree_leaves(a):
+            out.append(getattr(leaf, "sharding", None))
+    return out
+
+
+def estimate_fn(fn, *args, graph="", donate_argnums=(), static_argnums=(),
+                **kwargs):
+    """Trace ``fn`` with example args and estimate the footprint,
+    reading donation from the *production* call site's
+    ``donate_argnums`` and per-chip sharding from the example leaves."""
+    closed = jax.make_jaxpr(fn, static_argnums=tuple(static_argnums))(
+        *args, **kwargs
+    )
+    donated = _donated_flags(args, donate_argnums, static_argnums)
+    shardings = _leaf_shardings(args, static_argnums)
+    for v in kwargs.values():
+        leaves = jax.tree_util.tree_leaves(v)
+        donated += [False] * len(leaves)
+        shardings += [getattr(x, "sharding", None) for x in leaves]
+    return estimate_closed(
+        closed, graph=graph or getattr(fn, "__name__", "fn"),
+        donated=donated, arg_shardings=shardings,
+    )
+
+
+def lint_memory_fn(fn, *args, graph="", donate_argnums=(),
+                   static_argnums=(), config=None, **kwargs):
+    est = estimate_fn(
+        fn, *args, graph=graph, donate_argnums=donate_argnums,
+        static_argnums=static_argnums, **kwargs
+    )
+    return lint_estimate(est, config=config), est
+
+
+# ---------------------------------------------------------------- XLA gate
+def xla_memory_stats(compiled):
+    """``compiled.memory_analysis()`` as a plain dict with a derived
+    ``peak_bytes`` (args + outputs + temps - donation aliases), or None
+    when the installed jax/backend doesn't expose it."""
+    try:
+        ma = compiled.memory_analysis()
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        tmp = int(ma.temp_size_in_bytes)
+        alias = int(ma.alias_size_in_bytes)
+    except Exception:
+        return None
+    return {
+        "argument_size_in_bytes": arg,
+        "output_size_in_bytes": out,
+        "temp_size_in_bytes": tmp,
+        "alias_size_in_bytes": alias,
+        "peak_bytes": arg + out + tmp - alias,
+    }
+
+
+def drift_finding(est, stats, *, tolerance=0.2, slack_bytes=1 << 20):
+    """Validate the estimator against XLA's own accounting: None when
+    ``est.peak_bytes`` is within ``tolerance`` (plus an absolute slack
+    floor for tiny programs) of the XLA-derived peak, else a counted
+    ``memory-analysis-drift`` finding. The estimator ignores fusion so
+    it sits ABOVE the XLA figure; the gate bounds both directions —
+    an underestimate is the dangerous one."""
+    xp = int(stats["peak_bytes"])
+    allowed = max(tolerance * xp, slack_bytes)
+    dev = est.peak_bytes - xp
+    if abs(dev) <= allowed:
+        return None
+    return Finding(
+        rule="memory-analysis-drift", severity=Severity.WARNING,
+        message=(
+            f"estimated peak {est.peak_bytes} B vs XLA "
+            f"memory_analysis {xp} B "
+            f"({'+' if dev >= 0 else ''}{dev / max(xp, 1):.0%}, gate "
+            f"±{tolerance:.0%}) — the live-range model drifted from "
+            f"the compiler; re-derive before trusting the budget table"
+        ),
+        graph=est.graph,
+        detail=f"drift:{'over' if dev > 0 else 'under'}",
+    )

@@ -102,6 +102,10 @@ class CompiledTrainStep:
         # optimizer state (structure discovered on the first call)
         self._fp8_state = None
         self._fp8_bytes_saved = 0
+        # step-argument avals captured at first invoke — the
+        # memory_report() trace input (HBM footprint next to the
+        # StepMeter gauges)
+        self._step_args_sds = None
 
     def attach_checkpoint(self, manager):
         """Wire a ``checkpoint.CheckpointManager`` into the step loop:
@@ -634,6 +638,15 @@ class CompiledTrainStep:
     def _invoke(self, *step_args):
         """Run the jitted step, translating XLA's unbounded-while reverse-AD
         limitation into an actionable paddle-level error."""
+        if self._step_args_sds is None:
+            # avals only — donation below frees the buffers, the
+            # shapes/dtypes stay valid for memory_report()'s re-trace
+            self._step_args_sds = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(
+                    jnp.shape(a), jnp.result_type(a)
+                ),
+                step_args,
+            )
         try:
             return self._step_fn(*step_args)
         except ValueError as e:
@@ -652,6 +665,53 @@ class CompiledTrainStep:
                     "inference-only."
                 ) from e
             raise
+
+    def memory_report(self):
+        """Donation-aware live-range HBM estimate of the compiled step
+        (``analysis.memory_lint``): peak resident bytes with params/
+        opt-state/buffers donated, next to the StepMeter's timing
+        gauges. Re-traces the step body at the captured argument avals
+        (no FLOPs, no compile); None before the first step. The trace
+        swaps tracers through the imperative layers, so the network's
+        concrete state is restored before returning."""
+        if self._step_fn is None or self._step_args_sds is None:
+            return None
+        from .. import analysis
+        from ..parallel import layout as layout_mod
+
+        params = {k: p.value for k, p in self.network.named_parameters()}
+        buffers = {k: b.value for k, b in self.network.named_buffers()}
+        try:
+            with layout_mod.use_policy(self._layout_policy):
+                est = analysis.estimate_fn(
+                    self._step_fn, *self._step_args_sds,
+                    graph="train_step", donate_argnums=(0, 1, 2),
+                )
+        finally:
+            self.network.load_functional_state(params, buffers)
+        return est.to_dict()
+
+    def _publish_memory_gauge(self):
+        """Opt-in (``PADDLE_TPU_TRAIN_MEMORY_GAUGE=1``): publish the
+        train step's estimated peak as a gauge on the first real step.
+        Off by default — the re-trace costs one extra trace of the
+        step body at warmup."""
+        import os
+
+        if not os.environ.get("PADDLE_TPU_TRAIN_MEMORY_GAUGE"):
+            return
+        rep = self.memory_report()
+        if rep is None:
+            return
+        from .. import observability as obs
+
+        g = obs.get_registry().gauge(
+            "paddle_train_step_peak_bytes",
+            help="estimated peak resident bytes of the compiled train "
+                 "step (memory_lint live-range model, donation-aware)",
+            unit="bytes",
+        )
+        g.set(float(rep["peak_bytes"]))
 
     def _record_telemetry(self, dt, in_vals, loss, warmup):
         """Publish one step into the process StepMeter (observability).
@@ -677,6 +737,8 @@ class CompiledTrainStep:
                 # analytic per-step HBM delta of routing the matmul
                 # weights through fp8 (counted at trace time)
                 meter.note_fp8_bytes_saved(self._fp8_bytes_saved)
+            if warmup:
+                self._publish_memory_gauge()
         except Exception:
             pass
 

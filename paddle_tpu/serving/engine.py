@@ -184,6 +184,11 @@ class ServingEngine:
         # compile cache (the /healthz `compile_cache_hits` field)
         self._warmed = set()
         self.compile_cache_hits = 0
+        # per-program HBM footprint table (memory_lint estimate + XLA
+        # memory_analysis where available), filled by warmup() and
+        # surfaced as /healthz `memory` + the
+        # paddle_serving_program_peak_bytes gauge family
+        self.program_memory = {}
         self.max_batch_size = int(max_batch_size)
         self.max_seq_len = int(max_seq_len)
         self.clock = clock
@@ -380,10 +385,11 @@ class ServingEngine:
         (may clamp below ``hi`` under page pressure)."""
         return min(hi, self.max_seq_len - 1)
 
-    def _spec_gather(self, slot, hi):
-        """Materialize row ``slot``'s KV as a prefill-layout ``[1, W]``
-        block covering positions [0, ``hi``]; returns
-        ``(flat_block, W)``."""
+    def _spec_gather_prog(self):
+        """The (jitted, uncompiled) slab gather program — one row of
+        the slab materialized as a prefill-layout ``[1, S_max]``
+        block. Built lazily so warmup and first-use share one
+        program object."""
         fn = self._spec_gather_fn
         if fn is None:
             from ..quantization.kv import slab_row_block
@@ -396,6 +402,13 @@ class ServingEngine:
                 "serving::spec_gather", self.max_seq_len,
                 origin="serving/engine.py",
             )
+        return fn
+
+    def _spec_gather(self, slot, hi):
+        """Materialize row ``slot``'s KV as a prefill-layout ``[1, W]``
+        block covering positions [0, ``hi``]; returns
+        ``(flat_block, W)``."""
+        fn = self._spec_gather_prog()
         return fn(self._flat, jnp.int32(slot)), self.max_seq_len
 
     def _spec_adopt(self, slot, new_block, width, pos):
@@ -904,7 +917,7 @@ class ServingEngine:
 
     def _program_signature(self, name):
         cfg = self.config
-        return {
+        sig = {
             "program": name,
             "engine": type(self).__name__,
             "max_batch": self.max_batch_size,
@@ -923,9 +936,31 @@ class ServingEngine:
                 "kv_heads": int(cfg.kv_heads),
             },
         }
+        if name.startswith("spec_") and self.speculative is not None:
+            # draft geometry/acceptance depth change the traced program
+            # — a cache hit across different speculative configs would
+            # install the wrong executable
+            sig["speculative"] = self.speculative.signature()
+        return sig
+
+    def _verify_widths(self, buckets):
+        """Block widths the speculative verify can see. The slab
+        gathers every row at full width; the paged engine overrides
+        with its bucket ladder."""
+        return [self.max_seq_len]
+
+    def _warm_spec_gather(self, cache, stats, buckets):
+        """Pre-compile the KV-gather program(s) the speculative round
+        issues before every verify. Slab: one full-width row gather."""
+        self._warm_one(
+            cache, "spec_gather", ("spec_gather",),
+            self._spec_gather_prog(),
+            (self._flat, jnp.int32(0)),
+            lambda comp: setattr(self, "_spec_gather_fn", comp), stats,
+        )
 
     def _warm_one(self, cache, name, trace_key, jitfn, args, install,
-                  stats):
+                  stats, donate=()):
         if trace_key in self._warmed:
             return  # idempotent: the installed executable stands
         stats["programs"] += 1
@@ -939,12 +974,64 @@ class ServingEngine:
                 self._warmed.add(trace_key)
                 self.compile_cache_hits += 1
                 stats["aot_hits"] += 1
+                self._memory_note(name, jitfn, args, donate, comp)
                 return
         comp = jitfn.lower(*args).compile()
         install(comp)
         self._warmed.add(trace_key)
         if cache is not None and cache.save(key, comp, meta):
             stats["aot_saves"] += 1
+        self._memory_note(name, jitfn, args, donate, comp)
+
+    def _memory_note(self, name, fn, args, donate, comp):
+        """Record one warmed program's HBM footprint: the live-range
+        estimate (memory_lint, with THIS process's actual donation) and
+        the compiled executable's own ``memory_analysis()`` where the
+        backend exposes it, drift already judged. Analysis can never
+        fail a warmup."""
+        try:
+            from .. import analysis
+
+            est = analysis.estimate_fn(
+                fn, *args, graph=name, donate_argnums=donate,
+            )
+            entry = est.to_dict()
+            stats = analysis.xla_memory_stats(comp)
+            if stats is not None:
+                entry["xla"] = stats
+                drift = analysis.drift_finding(est, stats)
+                entry["drift"] = None if drift is None else drift.message
+            self.program_memory[name] = entry
+        except Exception:
+            pass
+
+    def memory_report(self):
+        """The per-program footprint table warmup() filled — the
+        /healthz ``memory`` block and serve_bench's ``memory``
+        record. None before warmup."""
+        if not self.program_memory:
+            return None
+        return {
+            "programs": dict(self.program_memory),
+            "max_peak_bytes": max(
+                e["peak_bytes"] for e in self.program_memory.values()
+            ),
+        }
+
+    def _publish_memory_gauges(self):
+        try:
+            from ..observability import get_registry
+
+            g = get_registry().gauge(
+                "paddle_serving_program_peak_bytes",
+                help="estimated peak resident bytes per compiled "
+                     "serving program (memory_lint live-range model)",
+                unit="bytes",
+            )
+            for name, entry in self.program_memory.items():
+                g.set(float(entry["peak_bytes"]), program=name)
+        except Exception:
+            pass
 
     def warmup(self, aot_cache=None, buckets=None):
         """Compile every fixed-shape program — the decode step plus
@@ -973,6 +1060,7 @@ class ServingEngine:
                 cache, "decode", ("decode",), self._decode_fn,
                 self._decode_example_args(),
                 lambda comp: setattr(self, "_decode_fn", comp), stats,
+                donate=(3,) if self._donate else (),
             )
             if decode_fresh:
                 self.trace_guard.record_compile(
@@ -992,6 +1080,7 @@ class ServingEngine:
                         self._prefill_fn(b), pargs,
                         lambda comp, b=b: self._prefill_fns
                         .__setitem__(b, comp), stats,
+                        donate=(4,) if self._donate else (),
                     )
                     self._warm_one(
                         cache, f"adopt_b{b}", ("adopt", b),
@@ -999,13 +1088,21 @@ class ServingEngine:
                         self._adopt_example_args(flat, b),
                         lambda comp, b=b: self._adopt_fns
                         .__setitem__(b, comp), stats,
+                        donate=(0,) if self._donate else (),
                     )
                 finally:
                     self.pool.free(blk)
+            if self.speculative is not None:
+                # PR 16 residual: the speculative inventory (draft
+                # prefill/decode, steady-state verify, gather) warms
+                # and AOT-persists with everything else — the first
+                # speculative round pays zero compiles
+                self.speculative.warmup(self, cache, stats, buckets)
         finally:
             # lowering traces the program bodies — skipping the
             # restore leaks tracers into any LATER snapshot of the net
             self._restore_net_state()
+        self._publish_memory_gauges()
         return stats
 
     def close(self):

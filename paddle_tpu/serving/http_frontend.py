@@ -336,6 +336,13 @@ class ServingFrontend:
                 "fallbacks": getattr(eng, "remote_prefill_fallbacks",
                                      0),
             }
+        mem = getattr(eng, "memory_report", None)
+        mem = mem() if callable(mem) else None
+        if mem is not None:
+            # the full warmed-program HBM footprint inventory (the
+            # memory_lint live-range estimate per compiled program,
+            # with XLA memory_analysis + drift where available)
+            out["memory"] = mem
         return out
 
     def _handle_post(self, h):

@@ -494,6 +494,26 @@ class PagedServingEngine(ServingEngine):
             return self.page_pool.claim(n)
 
     # ------------------------------------------- speculative backend seams
+    def _verify_widths(self, buckets):
+        """Paged verify blocks are bucketed gathers — one verify
+        program per prompt bucket, not one full-width program."""
+        return list(buckets)
+
+    def _warm_spec_gather(self, cache, stats, buckets):
+        """The speculative round's per-bucket gather — the SAME
+        programs (and ``("gather", b)`` warm keys) the prefix-cache
+        warm path compiles, so with a prefix cache attached this is an
+        idempotent no-op pass."""
+        ps = self.page_size
+        for b in buckets:
+            self._warm_one(
+                cache, f"gather_b{b}", ("gather", b),
+                self._gather_fn(b),
+                (self._flat, jnp.zeros((b // ps,), jnp.int32)),
+                lambda comp, b=b: self._gather_fns
+                .__setitem__(b, comp), stats,
+            )
+
     def _spec_reserve(self, slot, hi):
         """Demand-claim pages so row ``slot`` holds KV capacity through
         cache position ``hi`` (the verify writes [pos, hi]); appended
@@ -845,6 +865,7 @@ class PagedServingEngine(ServingEngine):
                             cargs,
                             lambda comp, b=b, tb=tb: self._chunk_fns
                             .__setitem__((b, tb), comp), stats,
+                            donate=(5,) if self._donate else (),
                         )
                 finally:
                     self.pool.free(blk)

@@ -50,9 +50,13 @@ hooks (``_spec_gather`` / ``_spec_adopt`` / ``_spec_reserve`` /
 bucketed gather -> verify -> adopt-pages pipeline into pages the
 request owns, demand-claims transient pages for the proposed tail and
 releases the rejected tail's pages on rollback (zero-leak-pinned).
-Known gaps: no tree/Medusa multi-branch drafts; per-row rounds trade
-batched-decode throughput for latency (the win is measured at low
-concurrency); speculative programs compile lazily (not in warmup).
+The whole speculative program inventory — draft prefill per bucket,
+draft decode, steady-state verify per block width, the KV gather —
+pre-compiles in ``engine.warmup()`` and persists through the AOT
+compile cache (``jit/aot_cache.py``), so the first speculative round
+pays zero compiles. Known gaps: no tree/Medusa multi-branch drafts;
+per-row rounds trade batched-decode throughput for latency (the win
+is measured at low concurrency).
 """
 from __future__ import annotations
 
@@ -338,9 +342,10 @@ class SpeculativeDecoder:
         # speculative program inventory: draft prefill per bucket,
         # verify per (block width, chunk length) — chunk length is
         # k+1 in steady state, smaller only on the last round(s) of a
-        # request — plus draft decode and the gather program
+        # request — plus draft decode and the gather program(s)
+        # (per-bucket on the paged engine, warmed up front)
         nb = len(engine._warmup_buckets())
-        engine.trace_guard.max_compiles += nb * (self.k + 2) + 4
+        engine.trace_guard.max_compiles += nb * (self.k + 3) + 4
 
     def unbind(self):
         """Engine close: drop compiled programs and draft state."""
@@ -393,6 +398,93 @@ class SpeculativeDecoder:
         self.rounds = self.proposed = 0
         self.accepted = self.emitted = 0
         self.draft_ingests = 0
+
+    def signature(self):
+        """The AOT-cache key extra for ``spec_*`` programs: every knob
+        that changes a traced speculative program body. A cache hit
+        across different draft geometries would install the wrong
+        executable."""
+        sig = {
+            "mode": self.mode,
+            "k": self.k,
+            "exit_layer": self.exit_layer,
+            "draft_cache_dtype": str(self.draft_cache_dtype),
+            "sequential": self._sequential,
+        }
+        if self.exit_layer is None and self._draft is not None:
+            dc = self._draft.config
+            sig["draft_model"] = {
+                "vocab": int(dc.vocab_size),
+                "hidden": int(dc.hidden_size),
+                "inter": int(dc.intermediate_size),
+                "layers": int(dc.num_hidden_layers),
+                "heads": int(dc.num_attention_heads),
+                "kv_heads": int(dc.kv_heads),
+            }
+        return sig
+
+    # ------------------------------------------------------- AOT warmup
+    def warmup(self, eng, cache, stats, buckets):
+        """Pre-compile (or AOT-cache-load) the whole speculative
+        inventory before first traffic — called from the engine's
+        ``warmup()`` with its cache/stats so the programs ride the
+        same persistence and show in the same ``program_memory``
+        table. Warms: draft prefill per prompt bucket, the draft
+        decode step, the verify program per (block width, k+1) plus
+        the (width, 1) last-round shape, and the backend's KV gather
+        program(s). Every compile lands on a trace-guard key recorded
+        at build time, so a LATER compile on those keys is a storm
+        finding."""
+        dp, db = self._dparams, self._dbuffers
+        dflat = _flatten(alloc_kv_caches(
+            self._draft.config, 1, eng.max_seq_len,
+            self.draft_cache_dtype,
+        ))
+        try:
+            for b in buckets:
+                eng._warm_one(
+                    cache, f"spec_draft_prefill_b{b}",
+                    ("spec_dprefill", b), self._draft_prefill(b),
+                    (dp, db, jnp.zeros((1, b), jnp.int32), dflat,
+                     jnp.int32(b)),
+                    lambda comp, b=b: self._draft_prefill_fns
+                    .__setitem__(b, comp), stats,
+                )
+            eng._warm_one(
+                cache, "spec_draft_decode", ("spec_ddecode",),
+                self._draft_decode(),
+                (dp, db, jnp.zeros((1, 1), jnp.int32), dflat,
+                 jnp.int32(0)),
+                lambda comp: setattr(self, "_draft_decode_fn", comp),
+                stats,
+            )
+            for w in eng._verify_widths(buckets):
+                flatb = _flatten(alloc_kv_caches(
+                    eng.config, 1, w, eng.cache_dtype,
+                ))
+                # the whole chunk ladder: k+1 in steady state, every
+                # shorter length on a request's final rounds (k_eff
+                # clamps to the tokens still owed)
+                for k1 in range(1, self.k + 2):
+                    eng._warm_one(
+                        cache, f"spec_verify_w{w}_k{k1}",
+                        ("spec_verify", w, k1),
+                        self._verify_fn(w, k1),
+                        (eng._params, eng._buffers,
+                         jnp.zeros((1, k1), jnp.int32), flatb,
+                         jnp.int32(0)),
+                        lambda comp, w=w, k1=k1: self._verify_fns
+                        .__setitem__((w, k1), comp), stats,
+                    )
+            eng._warm_spec_gather(cache, stats, buckets)
+            # the lowerings above already swapped tracers through the
+            # draft's imperative layers once — the first-trace restore
+            # below covers them, so runtime _drun need not re-restore
+            for b in buckets:
+                self._draft_traced.add(("dprefill", b))
+            self._draft_traced.add(("ddecode",))
+        finally:
+            self._restore_draft()
 
     # ------------------------------------------------- compiled programs
     def _restore_draft(self):

@@ -1,0 +1,321 @@
+"""paddle_tpu.analysis.memory_lint — donation-aware HBM footprint pass.
+
+One minimal positive + one negative case per ratcheted rule, the
+liveness mechanics the estimator's numbers rest on (donation pairing,
+control-flow recursion, per-chip aval math), the CPU agreement gate
+against ``compiled.memory_analysis()``, and the serving pins the pass
+ships with: the speculative inventory pre-compiles in ``warmup()`` so
+first traffic pays ZERO compiles (AOT round-trip included), and
+``/healthz`` carries the per-program peak-bytes block.
+"""
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import MemoryConfig, Severity
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N = 128
+NB = N * N * 4  # bytes of one (N, N) float32 buffer
+
+
+def rules_of(rep):
+    return {f.rule for f in rep}
+
+
+# ------------------------------------------------------- donation pairing
+def test_donation_subtraction():
+    """A donated input whose shape/dtype matches a program output is
+    aliased in place: the paired output is never charged, so donation
+    halves the single-buffer update's peak."""
+    def f(x):
+        return x + 1.0
+
+    x = jnp.ones((N, N), jnp.float32)
+    undonated = analysis.estimate_fn(f, x, graph="g")
+    donated = analysis.estimate_fn(f, x, graph="g", donate_argnums=(0,))
+
+    assert undonated.peak_bytes == 2 * NB
+    assert donated.peak_bytes == NB
+    assert donated.donated_bytes == NB
+    assert undonated.donated_bytes == 0
+
+
+def test_donated_unmatched_dies_at_last_use():
+    """A donated input with NO matching-shape output cannot alias; it
+    is still released at its last use rather than pinned to the end."""
+    def f(x):
+        return x.sum()
+
+    x = jnp.ones((N, N), jnp.float32)
+    undonated = analysis.estimate_fn(f, x, graph="g")
+    donated = analysis.estimate_fn(f, x, graph="g", donate_argnums=(0,))
+    # both peaks are dominated by x itself; donation must not INCREASE
+    # anything, and the donated input must still be counted as donated
+    assert donated.peak_bytes <= undonated.peak_bytes
+    assert donated.donated_bytes == NB
+
+
+# ------------------------------------------------- control-flow recursion
+def test_scan_body_transient_counted():
+    """The estimator recurses into scan: a matmul temp living only
+    inside the body must still raise the whole-program peak above the
+    carry-in/carry-out floor."""
+    def f(c):
+        def body(c, _):
+            t = jnp.tanh(c @ c)
+            return t @ c, None
+
+        out, _ = jax.lax.scan(body, c, None, length=2)
+        return out
+
+    est = analysis.estimate_fn(f, jnp.ones((N, N), jnp.float32),
+                               graph="g")
+    # carry + out alone would be 2 buffers; the body temp makes >= 3
+    assert est.peak_bytes >= 3 * NB
+    assert est.max_single_bytes >= NB
+
+
+def test_cond_branch_transient_counted():
+    """cond recursion: the heavier branch's transient sets the peak
+    even though the other branch is the identity."""
+    def f(p, x):
+        def heavy(x):
+            return jnp.tanh(x @ x) @ x
+
+        return jax.lax.cond(p, heavy, lambda x: x, x)
+
+    est = analysis.estimate_fn(
+        f, jnp.asarray(True), jnp.ones((N, N), jnp.float32), graph="g",
+    )
+    assert est.peak_bytes >= 3 * NB
+
+
+# -------------------------------------------------- per-chip (aval) math
+class _HalfSharding:
+    """Duck-typed stand-in for a jax Sharding: first axis split 2-way.
+    The real multi-device integration is proven by memlint-smoke's 7B
+    virtual-mesh cross-check; tier-1 pins the pure math."""
+
+    def shard_shape(self, shape):
+        return (shape[0] // 2,) + tuple(shape[1:])
+
+
+def test_per_chip_bytes_sharded_vs_replicated():
+    class Leaf:
+        shape = (8, 4)
+        dtype = np.float32
+        sharding = _HalfSharding()
+
+    assert analysis.per_chip_bytes(Leaf()) == 8 * 4 * 4 // 2
+    # no sharding attached -> full size (replicated discipline)
+    assert analysis.per_chip_bytes(jnp.ones((8, 4), jnp.float32)) \
+        == 8 * 4 * 4
+
+
+def test_per_chip_peak_uses_shard_shapes():
+    closed = jax.make_jaxpr(lambda x: x + 1.0)(
+        jnp.ones((N, N), jnp.float32)
+    )
+    est = analysis.estimate_closed(
+        closed, graph="g", arg_shardings=[_HalfSharding()],
+    )
+    # per-chip peak replaces the replicated args term with the
+    # shard-shape-derived one; everything else stays whole-program
+    assert est.per_chip_peak_bytes \
+        == est.peak_bytes - est.args_bytes + est.args_bytes // 2
+
+
+# ---------------------------------------------------- hbm-budget-exceeded
+def _matmul_chain(x, w1, w2):
+    return jnp.tanh(x @ w1) @ w2
+
+
+def _chain_args():
+    rng = np.random.RandomState(11)
+    return tuple(
+        jnp.asarray(rng.randn(N, N), jnp.float32) for _ in range(3)
+    )
+
+
+def test_budget_rule_positive():
+    cfg = MemoryConfig(budget_bytes=1 << 10, budget_fraction=1.0)
+    rep, est = analysis.lint_memory_fn(
+        _matmul_chain, *_chain_args(), graph="g", config=cfg,
+    )
+    hits = [f for f in rep if f.rule == "hbm-budget-exceeded"]
+    assert hits and hits[0].severity == Severity.ERROR
+    assert est.peak_bytes > (1 << 10)
+
+
+def test_budget_rule_negative_default_budget():
+    rep, _ = analysis.lint_memory_fn(
+        _matmul_chain, *_chain_args(), graph="g", config=MemoryConfig(),
+    )
+    assert "hbm-budget-exceeded" not in rules_of(rep)
+
+
+# ---------------------------------------------------------- peak-doubling
+def test_peak_doubling_fires_undonated_silent_donated():
+    """The missed-donation shape the rule exists for: an in-place
+    parameter update that holds old+new state live when the caller
+    forgets donate_argnums."""
+    cfg = MemoryConfig(min_peak_doubling_bytes=1 << 10)
+
+    def step(params):
+        return jax.tree_util.tree_map(lambda p: p * 0.9 + 0.1, params)
+
+    params = {"w": jnp.ones((N, N), jnp.float32),
+              "b": jnp.ones((N,), jnp.float32)}
+    undonated, _ = analysis.lint_memory_fn(
+        step, params, graph="g", config=cfg,
+    )
+    donated, _ = analysis.lint_memory_fn(
+        step, params, graph="g", donate_argnums=(0,), config=cfg,
+    )
+    assert "peak-doubling" in rules_of(undonated)
+    assert "peak-doubling" not in rules_of(donated)
+
+
+def test_peak_doubling_floor_keeps_tiny_graphs_silent():
+    def step(params):
+        return jax.tree_util.tree_map(lambda p: p * 0.9, params)
+
+    rep, _ = analysis.lint_memory_fn(
+        step, {"w": jnp.ones((N, N), jnp.float32)}, graph="g",
+        config=MemoryConfig(),  # default 64 MiB floor
+    )
+    assert "peak-doubling" not in rules_of(rep)
+
+
+# ------------------------------------------------------- transient-blowup
+def test_transient_blowup_positive():
+    cfg = MemoryConfig(budget_bytes=1 << 24, transient_fraction=0.001,
+                       min_transient_bytes=1 << 10)
+    rep, est = analysis.lint_memory_fn(
+        _matmul_chain, *_chain_args(), graph="g", config=cfg,
+    )
+    assert "transient-blowup" in rules_of(rep)
+    assert est.max_single_bytes >= NB
+
+
+def test_transient_blowup_negative_default():
+    rep, _ = analysis.lint_memory_fn(
+        _matmul_chain, *_chain_args(), graph="g", config=MemoryConfig(),
+    )
+    assert "transient-blowup" not in rules_of(rep)
+
+
+# --------------------------------------- memory_analysis() agreement gate
+def test_memory_analysis_agreement_cpu():
+    """The estimator must sit within the drift gate of XLA's own
+    accounting for a real compiled program on this backend."""
+    args = _chain_args()
+    est = analysis.estimate_fn(_matmul_chain, *args, graph="g")
+    comp = jax.jit(_matmul_chain).lower(*args).compile()
+    stats = analysis.xla_memory_stats(comp)
+    assert stats is not None and stats["peak_bytes"] > 0
+    assert analysis.drift_finding(est, stats) is None
+
+
+def test_drift_finding_fires_when_model_is_wrong():
+    args = _chain_args()
+    est = analysis.estimate_fn(_matmul_chain, *args, graph="g")
+    comp = jax.jit(_matmul_chain).lower(*args).compile()
+    stats = analysis.xla_memory_stats(comp)
+    wrong = dataclasses.replace(est, peak_bytes=est.peak_bytes * 10)
+    f = analysis.drift_finding(wrong, stats, slack_bytes=0)
+    assert f is not None and f.rule == "memory-analysis-drift"
+    assert "over" in f.detail
+
+
+# ------------------------------------- serving pins (warm spec inventory)
+@pytest.fixture(scope="module")
+def spec_engine(tmp_path_factory):
+    """A warmed slab engine with self-draft speculative decoding and an
+    AOT compile cache — shared by the zero-compile, AOT round-trip and
+    /healthz pins below."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine, SpeculativeDecoder
+
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(
+        vocab_size=97, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    cache_dir = str(tmp_path_factory.mktemp("aot"))
+    eng = ServingEngine(
+        net, max_batch_size=2, max_seq_len=32,
+        speculative=SpeculativeDecoder(exit_layer=1, k=2),
+    )
+    stats = eng.warmup(aot_cache=cache_dir)
+    yield eng, net, cache_dir, stats
+    eng.close()
+
+
+def test_spec_warmup_inventory_and_zero_compile_traffic(spec_engine):
+    """PR 16 residual, pinned: the whole speculative inventory (draft
+    prefill/decode, verify chunk ladder, gather) compiles in warmup(),
+    so the first speculative round adds ZERO trace-guard entries."""
+    eng, _, _, stats = spec_engine
+    table = eng.program_memory
+    assert stats["programs"] == len(table) > 0
+    for want in ("spec_draft_prefill_b", "spec_draft_decode",
+                 "spec_verify_w", "spec_gather"):
+        assert any(n.startswith(want) for n in table), (want,
+                                                        sorted(table))
+    # the verify ladder covers every runtime chunk length k_eff+1
+    widths = {n for n in table if n.startswith("spec_verify_w")}
+    assert len(widths) == 3  # k=2 -> k1 in {1, 2, 3}
+
+    before = {k: len(v) for k, v in eng.trace_guard._sigs.items()}
+    hs = eng.generate([[3, 1, 4], [1, 5, 9, 2, 6]], max_new_tokens=6)
+    assert all(h.status == "DONE" for h in hs)
+    after = {k: len(v) for k, v in eng.trace_guard._sigs.items()}
+    assert after == before, {
+        k: (before.get(k), n) for k, n in after.items()
+        if before.get(k) != n
+    }
+    assert not eng.trace_guard.findings
+
+
+def test_spec_inventory_aot_round_trip(spec_engine):
+    """A second engine over the same AOT cache warms with 100% hits —
+    the speculative programs persist like every other program."""
+    _, net, cache_dir, stats = spec_engine
+    from paddle_tpu.serving import ServingEngine, SpeculativeDecoder
+
+    eng2 = ServingEngine(
+        net, max_batch_size=2, max_seq_len=32,
+        speculative=SpeculativeDecoder(exit_layer=1, k=2),
+    )
+    s2 = eng2.warmup(aot_cache=cache_dir)
+    eng2.close()
+    assert s2["programs"] == stats["programs"]
+    assert s2["aot_hits"] == s2["programs"], s2
+
+
+def test_healthz_carries_memory_block(spec_engine):
+    """/healthz reports the per-program peak-bytes table next to the
+    compile-entries pin (the capacity-planning surface)."""
+    from paddle_tpu.serving.http_frontend import ServingFrontend
+
+    eng, _, _, _ = spec_engine
+    fe = ServingFrontend(eng)
+    snap = fe._health_snapshot()
+    assert "memory" in snap
+    mem = snap["memory"]
+    assert mem["max_peak_bytes"] > 0
+    assert set(mem["programs"]) == set(eng.program_memory)
+    for rec in mem["programs"].values():
+        assert rec["peak_bytes"] > 0

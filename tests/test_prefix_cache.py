@@ -237,7 +237,13 @@ def test_chunk_plan_never_overflows_the_bucket(net):
 
 
 # ------------------------------------------------------- warm-path exactness
-@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+# (the bf16 arena's warm-wave exactness is gated every merge by `make
+# prefix-smoke` over HTTP; tier-1 keeps the int8 arena, which also
+# carries the dtype-independent COW/page-boundary/hit-counter pins)
+@pytest.mark.parametrize("dtype", [
+    pytest.param("bfloat16", marks=pytest.mark.slow),
+    "int8",
+])
 def test_warm_streams_exact_vs_cold_and_generate(net, dtype):
     """The tentpole pin: warm-prefix streams (full hits, partial-tail
     COW hits, divergence exactly at a page boundary, identical full
@@ -456,6 +462,9 @@ def test_mixed_churn_zero_leaked_pages_zero_refcount_drift(net):
 
 
 # --------------------------------------------------------------- reload flush
+@pytest.mark.slow  # gated every merge by `make prefix-smoke` (mid-run
+# weight reload must flush the store; post-swap waves miss cleanly and
+# stream exact on the new weights, over HTTP)
 def test_reload_flushes_prefix_cache_exact_after_swap(net, tmp_path):
     """The satellite pin: a weight swap flushes the store; a post-swap
     same-prefix request MISSES (never adopts old-weights KV) and its
@@ -581,8 +590,10 @@ def test_warmup_covers_gather_and_chunk_programs(net):
     chunked-prefill inventory, so the FIRST warm hit pays zero
     compiles — and any later compile on those keys is a storm finding,
     not silence."""
+    # max_seq_len=32 keeps the bucket ladder to two entries — the
+    # count formulas below pin the full inventory shape regardless
     eng = PagedServingEngine(
-        net, max_batch_size=2, max_seq_len=64, page_size=8,
+        net, max_batch_size=2, max_seq_len=32, page_size=8,
         min_bucket=16, prefix_cache=True,
     )
     stats = eng.warmup()

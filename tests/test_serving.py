@@ -627,6 +627,8 @@ def test_cache_dtype_validated_at_api_seam(net):
                     dtype="complex64")
 
 
+@pytest.mark.slow  # gated every merge by `make quant-smoke` (the
+# int8-vs-fp32 agreement budget over HTTP + int8 KV pages)
 def test_int8_kv_greedy_agreement_budget_pinned(net):
     """The quantized-KV exactness RATCHET: greedy decode with int8 KV
     must agree with the bf16 stream for at least the pinned prefix, and
@@ -663,6 +665,8 @@ def test_int8_kv_greedy_agreement_budget_pinned(net):
         assert err <= PINNED_LOGIT_ERR, (L, err)
 
 
+@pytest.mark.slow  # gated every merge by `make quant-smoke` (live
+# int8 decode == saved artifact == paged int8 HTTP stream, exact)
 def test_int8_kv_engines_exact_vs_generate(net):
     """Quantization must not open a gap between the serving paths: the
     slab AND paged engines with ``cache_dtype="int8"`` produce token

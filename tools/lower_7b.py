@@ -135,6 +135,54 @@ def measured_per_chip(params, opt_state, pp_axis="pp"):
     }
 
 
+def memory_cross_check(built, budget, tolerance=0.10):
+    """Cross-check the analytic v5p-64 table against the memory_lint
+    per-chip aval math: ``analysis.per_chip_bytes`` (the SAME
+    ``sharding.shard_shape`` accounting the serving/train footprint
+    estimators use) re-derives the per-chip state bytes of the built
+    7B from its sharded avals. For the pp-sharded-state layout the
+    state figure must land within ``tolerance`` of the analytic
+    effective total — the 18.4 GiB/chip north-star pin checked from
+    two independent directions (closed-form formula vs per-leaf
+    sharded-aval sum)."""
+    from paddle_tpu import analysis
+
+    params, opt_state = built["params"], built["opt_state"]
+    rows = {
+        "params": sum(
+            analysis.per_chip_bytes(v) for v in params.values()
+        ),
+        "adam_m": sum(
+            analysis.per_chip_bytes(a[0]) for a in opt_state.values()
+        ),
+        "adam_v": sum(
+            analysis.per_chip_bytes(a[1]) for a in opt_state.values()
+        ),
+    }
+    total = sum(rows.values())
+    analytic = budget["effective_total_gib"] * GiB
+    out = {
+        "rows_gib": {k: round(v / GiB, 4) for k, v in rows.items()},
+        "state_per_chip_gib": round(total / GiB, 4),
+        "analytic_effective_gib": budget["effective_total_gib"],
+        "ratio_vs_analytic": round(total / analytic, 4),
+        "pp_sharded_state": budget["pp_sharded_state"],
+        "tolerance": tolerance,
+        "note": "per-chip state bytes re-derived through "
+                "analysis.per_chip_bytes (memory_lint's shard_shape "
+                "accounting) on the BUILD mesh",
+    }
+    if budget["pp_sharded_state"]:
+        within = abs(total - analytic) <= tolerance * analytic
+        out["within_tolerance"] = within
+        assert within, (
+            f"memory_lint per-chip state {total / GiB:.2f} GiB vs "
+            f"analytic {analytic / GiB:.2f} GiB: outside "
+            f"±{tolerance:.0%}"
+        )
+    return out
+
+
 def build_7b(dp=2, pp=2, mp=2, sep=1, B=8, S=4096, micro_batches=4,
              cfg=None, min_params=6.5e9, layout="tp-pp-dp"):
     """Build the abstract 7B hybrid trainer under a layout policy on the
@@ -368,6 +416,7 @@ def lower_7b(dp=2, pp=2, mp=2, sep=1, B=8, S=4096, micro_batches=4,
         pp_sharded_state=pol.pp_shard_optimizer_state,
     )
     assert budget["fits"], f"7B does not fit v5p-64: {budget}"
+    mem_cross = memory_cross_check(built, budget)
 
     report = {
         "ok": True,
@@ -383,6 +432,7 @@ def lower_7b(dp=2, pp=2, mp=2, sep=1, B=8, S=4096, micro_batches=4,
         "mp_sharded_params": len(tp_sharded),
         "fp32_full_vocab_avals": n_full_vocab_fp32,
         "measured_per_chip": measured,
+        "memory_cross_check": mem_cross,
         "v5p64_budget": budget,
     }
     print("lower_7b: " + json.dumps(report))

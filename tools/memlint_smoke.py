@@ -1,0 +1,308 @@
+"""memlint_smoke — CI gate for the donation-aware HBM footprint pass.
+
+Proves the memory_lint estimator against real compiled programs and the
+north-star budget math, end to end:
+
+1. ENGINE INVENTORY + AGREEMENT: a slab engine and a paged engine
+   (prefix cache + speculative decoding attached) run ``warmup()`` —
+   every fixed-shape program (decode, per-bucket prefill/adopt,
+   gather/chunk ladder, draft prefill/decode, verify ladder, spec
+   gather) must land in ``engine.program_memory`` with an XLA
+   ``memory_analysis()`` record and ZERO drift findings (the estimator
+   within the ±20% gate on every program), and the
+   ``paddle_serving_program_peak_bytes`` gauge must render per program.
+2. TRAIN STEP: one compiled train step's ``memory_report()`` must
+   agree with the executable's own ``memory_analysis()`` under
+   donation, and (env-gated) publish ``paddle_train_step_peak_bytes``.
+3. SEEDED RULES: a deterministically tiny budget must fire
+   ``hbm-budget-exceeded`` (and the default budget must NOT); the
+   UNDONATED train step must fire ``peak-doubling`` while the donated
+   one stays silent — the missed-donation shape the rule exists for.
+4. 7B PER-CHIP CROSS-CHECK (virtual 8-device CPU mesh subprocess): the
+   memory_lint aval math (``analysis.per_chip_bytes``) re-derives the
+   pp-sharded-state per-chip figure from the abstract 7B's sharded
+   avals and must reproduce the analytic 18.38 GiB within tolerance;
+   the result is merged into LOWER_7B.json.
+
+Exit 0 when every phase holds, 1 with a named failure otherwise.
+
+    python tools/memlint_smoke.py          # or: make memlint-smoke
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_TPU_TRAIN_MEMORY_GAUGE", "1")
+
+GiB = 1024 ** 3
+
+
+def _tiny_cfg():
+    from paddle_tpu.models import LlamaConfig
+
+    return LlamaConfig.tiny(
+        vocab_size=97, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4,
+    )
+
+
+def phase_engine_inventory():
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import get_registry
+    from paddle_tpu.serving import (
+        PagedServingEngine,
+        ServingEngine,
+        SpeculativeDecoder,
+    )
+
+    paddle.seed(5)
+    from paddle_tpu.models import LlamaForCausalLM
+
+    net = LlamaForCausalLM(_tiny_cfg())
+    net.eval()
+
+    def check(engine, want_prefixes):
+        stats = engine.warmup()
+        table = engine.program_memory
+        assert stats["programs"] == len(table), (stats, sorted(table))
+        for p in want_prefixes:
+            assert any(n == p or n.startswith(p) for n in table), (
+                f"program {p!r} missing from inventory: {sorted(table)}"
+            )
+        missing_xla = [n for n, e in table.items() if "xla" not in e]
+        assert not missing_xla, (
+            f"memory_analysis() unavailable for: {missing_xla}"
+        )
+        drifts = {
+            n: e["drift"] for n, e in table.items() if e.get("drift")
+        }
+        assert not drifts, (
+            f"estimator outside the ±20% memory_analysis gate: {drifts}"
+        )
+        rep = engine.memory_report()
+        assert rep["max_peak_bytes"] > 0
+        engine.close()
+        return len(table)
+
+    n_slab = check(
+        ServingEngine(net, max_batch_size=4, max_seq_len=64,
+                      speculative=SpeculativeDecoder(exit_layer=2, k=3)),
+        ("decode", "prefill_b", "adopt_b", "spec_draft_prefill_b",
+         "spec_draft_decode", "spec_verify_w", "spec_gather"),
+    )
+    n_paged = check(
+        PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
+                           page_size=16, prefix_cache=True,
+                           demand_paging=True,
+                           speculative=SpeculativeDecoder(exit_layer=2,
+                                                          k=3)),
+        ("decode", "prefill_b", "adopt_b", "gather_b", "chunk_b",
+         "spec_draft_prefill_b", "spec_draft_decode", "spec_verify_w"),
+    )
+    text = get_registry().prometheus_text()
+    gauges = [
+        ln for ln in text.splitlines()
+        if ln.startswith("paddle_serving_program_peak_bytes{")
+    ]
+    assert gauges, "paddle_serving_program_peak_bytes gauge not rendered"
+    print(f"memlint_smoke: engine inventory OK — {n_slab} slab + "
+          f"{n_paged} paged programs, all with memory_analysis "
+          f"agreement, {len(gauges)} gauge series")
+
+
+def phase_train_step():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import analysis
+    from paddle_tpu import optimizer as popt
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit.trainer import CompiledTrainStep
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.nn.layer.loss import CrossEntropyLoss
+    from paddle_tpu.observability import get_registry
+
+    paddle.seed(7)
+    net = LlamaForCausalLM(_tiny_cfg())
+    opt = popt.AdamW(
+        learning_rate=1e-3,
+        parameters=[p for _, p in net.named_parameters()],
+    )
+
+    def loss_fn(logits, labels):
+        return CrossEntropyLoss()(
+            Tensor(logits.value.reshape(-1, logits.value.shape[-1])),
+            Tensor(labels.value.reshape(-1)),
+        )
+
+    cts = CompiledTrainStep(net, loss_fn, opt)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(1, 97, (2, 8)), jnp.int32)
+    lbl = jnp.asarray(rng.randint(1, 97, (2, 8)))
+    cts([Tensor(ids)], [Tensor(lbl)])
+
+    rep = cts.memory_report()
+    assert rep and rep["peak_bytes"] > rep["donated_bytes"] > 0, rep
+
+    # the report must agree with the executable's own accounting
+    params = {k: p.value for k, p in net.named_parameters()}
+    buffers = {k: b.value for k, b in net.named_buffers()}
+    est = analysis.estimate_fn(
+        cts._step_fn, *cts._step_args_sds,
+        graph="train_step", donate_argnums=(0, 1, 2),
+    )
+    comp = cts._step_fn.lower(*cts._step_args_sds).compile()
+    net.load_functional_state(params, buffers)
+    stats = analysis.xla_memory_stats(comp)
+    assert stats is not None, "memory_analysis() unavailable for train step"
+    drift = analysis.drift_finding(est, stats)
+    assert drift is None, (
+        f"train step estimate {est.peak_bytes} vs XLA "
+        f"{stats['peak_bytes']}: {drift and drift.message}"
+    )
+
+    line = [
+        ln for ln in get_registry().prometheus_text().splitlines()
+        if ln.startswith("paddle_train_step_peak_bytes")
+        and not ln.startswith("#")
+    ]
+    assert line and float(line[0].split()[-1]) > 0, line
+    print(f"memlint_smoke: train step OK — est {est.peak_bytes} B vs "
+          f"XLA {stats['peak_bytes']} B, gauge published")
+    return cts, params, buffers
+
+
+def phase_seeded_rules(cts, params, buffers):
+    import jax
+
+    from paddle_tpu import analysis
+    from paddle_tpu.core import tape
+    from paddle_tpu.core.tensor import Tensor
+
+    net = cts.network
+
+    def fwd(params, buffers, ids):
+        net.load_functional_state(params, buffers)
+        net.eval()
+        with tape.trace_scope(), tape.no_grad():
+            out = net(Tensor(ids))
+        return out.value
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    ids = jnp.asarray(np.arange(16, dtype=np.int32).reshape(2, 8) % 97)
+
+    # positive: a deterministically tiny budget must fire the ERROR
+    tiny = analysis.MemoryConfig(budget_bytes=1 << 10,
+                                 budget_fraction=1.0)
+    findings, est = analysis.lint_memory_fn(
+        fwd, params, buffers, ids, graph="llama_forward", config=tiny,
+    )
+    net.load_functional_state(params, buffers)
+    rules = {f.rule for f in findings}
+    assert "hbm-budget-exceeded" in rules, (rules, est.peak_bytes)
+
+    # negative: the default (cpu, 64 GiB) budget must stay silent
+    findings2, _ = analysis.lint_memory_fn(
+        fwd, params, buffers, ids, graph="llama_forward",
+        config=analysis.MemoryConfig(),
+    )
+    net.load_functional_state(params, buffers)
+    assert not findings2.findings, findings2.findings
+
+    # peak-doubling: the UNDONATED train step holds old+new state live
+    # (the missed-donation shape); the donated one must stay silent.
+    # min_peak_doubling_bytes drops to admit the tiny model.
+    pcfg = analysis.MemoryConfig(min_peak_doubling_bytes=1 << 10)
+    sds = cts._step_args_sds
+    undonated, _ = analysis.lint_memory_fn(
+        cts._step, *sds, graph="train_step_undonated", config=pcfg,
+    )
+    net.load_functional_state(params, buffers)
+    donated, _ = analysis.lint_memory_fn(
+        cts._step, *sds, graph="train_step_donated",
+        donate_argnums=(0, 1, 2), config=pcfg,
+    )
+    net.load_functional_state(params, buffers)
+    u_rules = {f.rule for f in undonated.findings}
+    d_rules = {f.rule for f in donated.findings}
+    assert "peak-doubling" in u_rules, u_rules
+    assert "peak-doubling" not in d_rules, d_rules
+    print("memlint_smoke: seeded rules OK — budget violation detected, "
+          "peak-doubling fires undonated / silent donated")
+
+
+def phase_7b_cross_check():
+    from tools.vmesh import run_in_virtual_cpu_mesh
+
+    payload = (
+        "import sys; sys.path.insert(0, '.');\n"
+        "import json\n"
+        "from tools.lower_7b import (_per_chip_budget, build_7b,\n"
+        "                            memory_cross_check)\n"
+        "built = build_7b(layout='pp-sharded-state')\n"
+        "budget = _per_chip_budget(built['cfg'], built['n_params'],\n"
+        "                          tp=4, pp=2, dp=4, b_micro=1,\n"
+        "                          seq=4096, hbm_gib=95,\n"
+        "                          pp_sharded_state=True)\n"
+        "out = memory_cross_check(built, budget)\n"
+        "print('MEMCROSS ' + json.dumps(out))\n"
+    )
+    proc = run_in_virtual_cpu_mesh(8, payload, REPO, timeout=1500)
+    marker = [
+        ln for ln in proc.stdout.splitlines()
+        if ln.startswith("MEMCROSS ")
+    ]
+    assert proc.returncode == 0 and marker, (
+        f"7B cross-check subprocess failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    out = json.loads(marker[0][len("MEMCROSS "):])
+    assert out["within_tolerance"], out
+    # the north-star number itself: per-chip state must reproduce the
+    # analytic pp-sharded 18.38 GiB figure
+    assert abs(out["state_per_chip_gib"]
+               - out["analytic_effective_gib"]) \
+        <= 0.10 * out["analytic_effective_gib"], out
+
+    # persist next to the layout's other proven figures
+    path = os.path.join(REPO, "LOWER_7B.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    layouts = doc.setdefault("layouts", {})
+    layouts.setdefault("pp-sharded-state", {})["memory_cross_check"] = out
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"memlint_smoke: 7B cross-check OK — "
+          f"{out['state_per_chip_gib']} GiB/chip via per_chip_bytes vs "
+          f"{out['analytic_effective_gib']} GiB analytic "
+          f"(ratio {out['ratio_vs_analytic']})")
+
+
+def main():
+    try:
+        phase_engine_inventory()
+        cts, params, buffers = phase_train_step()
+        phase_seeded_rules(cts, params, buffers)
+        phase_7b_cross_check()
+    except AssertionError as e:
+        print(f"memlint_smoke: FAIL — {e}", file=sys.stderr)
+        return 1
+    print("memlint_smoke: all phases OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

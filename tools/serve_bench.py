@@ -166,6 +166,11 @@ def run_bench(args):
 
     # warmup: compile the decode step + the prompt buckets off the clock
     if args.warmup:
+        # the full fixed-shape inventory (decode, every bucket's
+        # prefill/adopt, gather/chunk, speculative programs) — this
+        # also fills engine.program_memory, the per-program peak-bytes
+        # table the record carries
+        engine.warmup()
         for bucket in sorted({
             engine.pool.bucket_for(p.shape[1]) for _, p, _ in trace
         }):
@@ -230,6 +235,12 @@ def run_bench(args):
         "metrics": rep,
     }
     out["peak_active_requests"] = peak_active
+    mem = engine.memory_report()
+    if mem is not None:
+        # the warmup-time HBM footprint table: estimated peak resident
+        # bytes per compiled program (memory_lint live-range model),
+        # with XLA memory_analysis + drift verdicts where available
+        out["memory"] = mem
     if engine.speculative is not None:
         out["speculative"] = engine.speculative.stats()
         # the user-visible form of the win: PER-REQUEST acceptance
@@ -316,6 +327,14 @@ def run_kv_compare(args):
         "metric": "serve_kv_compare",
         "equal_hbm_budget_bytes": arena,
         "int8_arena_bytes": eng_i.page_pool.arena_bytes(),
+        # compiled-program peak next to the arena budget: the arena is
+        # only PART of the resident picture — the per-program estimate
+        # covers weights + transients too (full tables nested in the
+        # per-dtype records)
+        "program_peak_bytes_max": {
+            "bfloat16": (rec_b.get("memory") or {}).get("max_peak_bytes"),
+            "int8": (rec_i.get("memory") or {}).get("max_peak_bytes"),
+        },
         "token_slots": {"bfloat16": slots_b, "int8": slots_i},
         "slots_ratio": round(slots_i / max(slots_b, 1), 3),
         "request_resident_bytes_mean": {
@@ -403,9 +422,10 @@ def run_shared_prefix(args):
         return handles, time.monotonic() - t0
 
     def warm_compiles(engine):
-        # compile decode + the prompt bucket (and, with a cache, the
-        # gather/chunk programs) off the clock; the publisher request
+        # the fixed-shape inventory (also fills the per-program
+        # peak-bytes table), then the publisher request — which
         # doubles as the cache seed
+        engine.warmup()
         h = engine.submit(trace[0][1], 2)
         engine.run_until_idle()
         assert h.status == "DONE", (h.status, h.reason)
@@ -420,6 +440,7 @@ def run_shared_prefix(args):
     warm_compiles(cold)
     cold_handles, cold_wall = replay(cold)
     cold_rep = cold.metrics.report()
+    cold_mem = cold.memory_report()
     cold.close()
 
     # ---- warm: publisher seeds the prefix, every replay request hits
@@ -435,6 +456,7 @@ def run_shared_prefix(args):
     warm_rep = warm.metrics.report()
     pstats = warm.prefix_cache.stats()
     pool_stats = warm.page_pool.stats()
+    warm_mem = warm.memory_report()
     warm.close()
 
     def pct(rep):
@@ -469,6 +491,13 @@ def run_shared_prefix(args):
         "prefix_cache": pstats,
         "page_pool": pool_stats,
         "hbm_saved_bytes_peak": saved_peak[0],
+        # per-program peak-bytes next to the page-arena numbers; warm
+        # carries the gather/chunk warm-path programs cold never
+        # compiles
+        "memory": {
+            "cold": cold_mem,
+            "warm": warm_mem,
+        },
     }
 
 

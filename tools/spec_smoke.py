@@ -1,6 +1,6 @@
 """spec-smoke — end-to-end gate for speculative decoding.
 
-Three legs over the paged engine (demand paging on, CPU-sized llama):
+Four legs over the paged engine (demand paging on, CPU-sized llama):
 
 1. EXACTNESS + WIN: a compute-heavy smoke model (hidden 256, 4 layers)
    with ``o_proj``/``down_proj`` zeroed from layer 1 — layers 1..3 are
@@ -17,6 +17,11 @@ Three legs over the paged engine (demand paging on, CPU-sized llama):
    stream must equal the speculative slab stream token-for-token (the
    position-addressed sampling-key pin that makes rejection-sampling
    acceptance reproducible across engines).
+4. INT8 KV SEQUENTIAL VERIFY: with ``cache_dtype="int8"`` the decoder
+   must take the sequential-unrolled verify path (per-token fp32 scale
+   updates make the vanilla data flow the only bitwise-safe one) and
+   the greedy speculative stream must stay EXACT-EQUAL to vanilla int8
+   decode, pages drained to zero.
 
 The zeroed-layer trick is an honest UPPER BOUND shape (perfect draft):
 it demonstrates the mechanical speedup without training a real draft;
@@ -157,6 +162,23 @@ def main():
     paged_toks = _streams(b, prompts2, 16)
     b.close()
     check("sampled_slab_eq_paged", slab_toks == paged_toks)
+
+    # -- leg 4: int8 KV -> sequential-unrolled verify, still exact -------
+    vi = PagedServingEngine(net2, cache_dtype="int8", **kw)
+    base_i8 = _streams(vi, prompts2, 16)
+    vi.close()
+    spec_i8 = SpeculativeDecoder(exit_layer=2, k=3)
+    si = PagedServingEngine(net2, speculative=spec_i8,
+                            cache_dtype="int8", **kw)
+    toks_i8 = _streams(si, prompts2, 16)
+    ppi = si.page_pool.stats()
+    check("int8_sequential_verify", spec_i8._sequential)
+    check("int8_greedy_exact", toks_i8 == base_i8)
+    check("leg4_zero_leaks",
+          ppi["pages_in_use"] == 0 and ppi["claims"] == ppi["releases"],
+          f"(in_use {ppi['pages_in_use']}, claims {ppi['claims']}, "
+          f"releases {ppi['releases']})")
+    si.close()
 
     if failures:
         print(f"spec_smoke: FAILED ({failures})")

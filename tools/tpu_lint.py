@@ -15,14 +15,18 @@ new findings, 1 otherwise.
     python tools/tpu_lint.py --audit-api       # also gate API surface
     python tools/tpu_lint.py --ast-only        # skip graph tracing (fast)
     python tools/tpu_lint.py --concurrency     # + collective/lock rules
+    python tools/tpu_lint.py --memory          # + HBM footprint rules
 
 ``--concurrency`` adds the distributed-correctness passes: the
 collective AST rules (rank-conditional-collective,
 collective-off-main-thread) over the whole tree and the host
 lock-discipline pass (lock-order-inversion, unlocked-shared-write,
 blocking-call-under-lock) over the threaded runtimes. The jaxpr-level
-collective-divergence rule always runs with the graph passes. ``make
-lint`` runs with ``--audit-api --concurrency``.
+collective-divergence rule always runs with the graph passes.
+``--memory`` adds the donation-aware live-range HBM footprint pass
+(hbm-budget-exceeded, peak-doubling, transient-blowup) over the same
+graph inventory. ``make lint`` runs with ``--audit-api --concurrency
+--memory``.
 
 Runs on CPU (JAX_PLATFORMS=cpu is forced): tracing needs no chip, and
 that is the point — hazards are caught before the graph ever reaches
@@ -150,8 +154,15 @@ def _tiny_net():
     return net
 
 
-def graph_reports(config=None, verbose=False):
-    """Trace + lint the production graphs. Returns a Report."""
+def graph_reports(config=None, verbose=False, memory=False,
+                  mem_config=None, mem_tables=None):
+    """Trace + lint the production graphs. Returns a Report.
+
+    ``memory=True`` additionally runs the donation-aware live-range
+    footprint pass (:mod:`paddle_tpu.analysis.memory_lint`) over every
+    traced graph — same ratchet, new rules (hbm-budget-exceeded /
+    peak-doubling / transient-blowup). ``mem_tables`` (a dict) is
+    filled with each graph's estimate for ``--json`` output."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -162,6 +173,7 @@ def graph_reports(config=None, verbose=False):
     from paddle_tpu.parallel import mesh as mesh_mod
 
     cfg = config or analysis.LintConfig(min_donation_bytes=32 << 10)
+    mcfg = mem_config or analysis.MemoryConfig()
     if not mesh_mod.mesh_defined():
         mesh_mod.init_mesh()  # collective rule judges against real axes
 
@@ -175,6 +187,24 @@ def graph_reports(config=None, verbose=False):
         net.load_functional_state(params, buffers)
         net.eval()
 
+    def memlint(fn, *args, graph, donate_argnums=(), static_argnums=()):
+        """The memory pass over one production graph (its own trace —
+        the example args and donation mirror the lint_fn call)."""
+        if not memory:
+            return
+        findings, est = analysis.lint_memory_fn(
+            fn, *args, graph=graph, donate_argnums=donate_argnums,
+            static_argnums=static_argnums, config=mcfg,
+        )
+        rep.extend(findings)
+        if mem_tables is not None:
+            mem_tables[graph] = est.to_dict()
+        if verbose:
+            print(f"  memory: {graph} peak "
+                  f"{est.peak_bytes / (1 << 20):.2f} MiB "
+                  f"(args {est.args_bytes / (1 << 20):.2f} MiB)",
+                  flush=True)
+
     # ---- llama eval forward -------------------------------------------
     def fwd(params, buffers, ids):
         net.load_functional_state(params, buffers)
@@ -187,6 +217,8 @@ def graph_reports(config=None, verbose=False):
         print("tracing llama_forward ...", flush=True)
     rep.extend(analysis.lint_fn(fwd, params, buffers, ids,
                                 graph="llama_forward", config=cfg))
+    restore()
+    memlint(fwd, params, buffers, ids, graph="llama_forward")
     restore()
 
     # ---- fused train step: forward + backward + AdamW update ----------
@@ -221,6 +253,12 @@ def graph_reports(config=None, verbose=False):
         config=cfg,
     ))
     restore()
+    memlint(
+        cts._step, params, opt_state, buffers, jnp.float32(1e-3),
+        jnp.float32(1.0), jax.random.PRNGKey(0), (ids,), (labels,),
+        graph="llama_train_step", donate_argnums=(0, 1, 2),
+    )
+    restore()
 
     # ---- serving compiled decode-step ---------------------------------
     from paddle_tpu.serving import ServingEngine
@@ -240,6 +278,14 @@ def graph_reports(config=None, verbose=False):
         config=cfg,
     ))
     restore()
+    memlint(
+        eng._decode_body, eng._params, eng._buffers,
+        jnp.zeros((B,), jnp.int32), eng._flat,
+        jnp.zeros((B,), jnp.int32), jnp.float32(1.0),
+        jax.random.PRNGKey(0),
+        graph="serving_decode_step", donate_argnums=(3,),
+    )
+    restore()
     eng.close()
 
     # ---- standalone optimizer step (the eager hot kernel) -------------
@@ -257,6 +303,13 @@ def graph_reports(config=None, verbose=False):
         static_argnums=(10,),
         config=cfg,
     ))
+    memlint(
+        _adam_update.__wrapped__, p, p, p, p, jnp.float32(1e-3),
+        jnp.float32(0.9), jnp.float32(0.999), jnp.float32(1e-8),
+        jnp.float32(1.0), jnp.float32(0.0), False,
+        graph="optimizer_step", donate_argnums=(0, 1, 2),
+        static_argnums=(10,),
+    )
 
     # ---- leaked-tracer check over the dogfooded net -------------------
     rep.extend(analysis.lint_leaked_tracers(net, graph="llama_net"))
@@ -307,6 +360,10 @@ def main(argv=None):
     ap.add_argument("--concurrency", action="store_true",
                     help="also run the collective + lock-discipline "
                          "passes (make lint's default)")
+    ap.add_argument("--memory", action="store_true",
+                    help="also run the donation-aware live-range HBM "
+                         "footprint pass over every traced graph "
+                         "(make lint's default)")
     ap.add_argument("--baseline", default=BASELINE_PATH)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
@@ -319,12 +376,16 @@ def main(argv=None):
             ap.error("--update-baseline regenerates from ALL passes; "
                      "drop --ast-only")
         args.concurrency = True
+        args.memory = True
 
     from paddle_tpu import analysis
 
     rep = analysis.Report()
+    mem_tables = {}
     if not args.ast_only:
-        rep.extend(graph_reports(verbose=args.verbose))
+        rep.extend(graph_reports(verbose=args.verbose,
+                                 memory=args.memory,
+                                 mem_tables=mem_tables))
     rep.extend(source_reports(concurrency=args.concurrency))
 
     if args.update_baseline:
@@ -356,6 +417,8 @@ def main(argv=None):
             "stale_baseline_keys": stale,
             "counts": rep.counts(),
         }
+        if mem_tables:
+            out["memory"] = mem_tables
         if audit_rep is not None:
             out["api_audit"] = audit_rep
             out["api_audit_missing"] = audit_missing
