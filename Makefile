@@ -7,7 +7,7 @@ ENV = JAX_PLATFORMS=cpu
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
 	reload-smoke train-chaos-smoke prefix-smoke trace-smoke \
-	spec-smoke memlint-smoke smoke-all
+	spec-smoke memlint-smoke slo-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step, incl. collective-divergence) + AST lint +
@@ -156,11 +156,21 @@ spec-smoke:
 memlint-smoke:
 	$(ENV) $(PY) tools/memlint_smoke.py
 
+# SLO observability gate: tight-budget interactive class over a
+# throttled engine — mixed-class burst lands slo_class-labeled TTFT
+# series (exemplars parse strict), the fast burn-rate alert must fire
+# within 3 scrape intervals of the breach (visible in /alerts,
+# /healthz, the alerts gauge, and the flight bundle), the fleet
+# router must surface it in its own /metrics, recovery must clear it
+# everywhere, and serve_bench --mix must emit the per-class slo block.
+slo-smoke:
+	$(ENV) $(PY) tools/slo_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
 		train-chaos-smoke prefix-smoke trace-smoke spec-smoke \
-		memlint-smoke
+		memlint-smoke slo-smoke
 	@echo "smoke-all: every gate green"
 
 test:

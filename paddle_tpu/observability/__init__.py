@@ -43,6 +43,20 @@ from .registry import (
     MetricsRegistry,
     get_registry,
 )
+from .slo import (
+    DEFAULT_CLASS,
+    BurnRateRule,
+    SLOClass,
+    SLOMonitor,
+    SLORegistry,
+    UnknownSLOClassError,
+    attainment_report,
+    default_burn_rules,
+    default_classes,
+    get_slo_registry,
+    set_slo_registry,
+    within_budget,
+)
 from .step_meter import (
     StepMeter,
     analytic_flops_per_token,
@@ -54,6 +68,7 @@ from .step_meter import (
     peak_flops_per_device,
     set_step_meter,
 )
+from .timeseries import TimeSeriesRing
 from .tracing import (
     Span,
     SpanBuffer,
@@ -82,6 +97,11 @@ __all__ = [
     "device_memory_stats", "batch_geometry",
     "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
     "tagged_snapshot", "merge_snapshots", "merged_report",
+    "TimeSeriesRing",
+    "SLOClass", "SLORegistry", "SLOMonitor", "BurnRateRule",
+    "UnknownSLOClassError", "DEFAULT_CLASS",
+    "get_slo_registry", "set_slo_registry", "default_classes",
+    "default_burn_rules", "attainment_report", "within_budget",
     "Span", "SpanBuffer", "SpanContext", "Tracer",
     "get_tracer", "set_tracer", "set_process_name",
     "parse_traceparent", "format_traceparent", "remote_child_span",

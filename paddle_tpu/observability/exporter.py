@@ -153,15 +153,68 @@ def prometheus_text(registry=None, exemplars=None):
                     f"{_fmt_value(s['value'])}"
                 )
         elif kind == "histogram":
-            for b in d.get("buckets", []):
-                le = b["le"]
-                le_s = "+Inf" if math.isinf(le) else _fmt_value(float(le))
-                lines.append(
-                    f'{name}_bucket{{le="{le_s}"}} {b["count"]}'
-                    f"{ex_suffix(b.get('exemplar'))}"
+            series = d.get("series", [])
+            if not series:
+                for b in d.get("buckets", []):
+                    le = b["le"]
+                    le_s = ("+Inf" if math.isinf(le)
+                            else _fmt_value(float(le)))
+                    lines.append(
+                        f'{name}_bucket{{le="{le_s}"}} {b["count"]}'
+                        f"{ex_suffix(b.get('exemplar'))}"
+                    )
+                lines.append(f"{name}_sum {_fmt_value(d.get('sum', 0.0))}")
+                lines.append(f"{name}_count {d.get('count', 0)}")
+            else:
+                # same no-mixing discipline as counters: a labeled
+                # histogram family emits per-child buckets/_sum/_count
+                # plus a blank-labeled remainder for any unlabeled
+                # observes — never a bare aggregate alongside children
+                # (sum(rate(..._bucket[5m])) would double-count).
+                blank = {k: "" for s in series for k in s["labels"]}
+
+                def emit_child(labels, buckets, csum, ccount, ex_ok=True):
+                    for b in buckets:
+                        le = b["le"]
+                        le_s = ("+Inf" if math.isinf(le)
+                                else _fmt_value(float(le)))
+                        lb = dict(labels)
+                        lb["le"] = le_s
+                        ex = ex_suffix(b.get("exemplar")) if ex_ok else ""
+                        lines.append(
+                            f'{name}_bucket{_fmt_labels(lb)} '
+                            f'{b["count"]}{ex}'
+                        )
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(labels)} "
+                        f"{_fmt_value(csum)}"
+                    )
+                    lines.append(
+                        f"{name}_count{_fmt_labels(labels)} {ccount}"
+                    )
+
+                for s in series:
+                    emit_child(s["labels"], s["buckets"], s.get("sum", 0.0),
+                               s.get("count", 0))
+                rest_count = d.get("count", 0) - sum(
+                    s.get("count", 0) for s in series
                 )
-            lines.append(f"{name}_sum {_fmt_value(d.get('sum', 0.0))}")
-            lines.append(f"{name}_count {d.get('count', 0)}")
+                if rest_count:
+                    rest_sum = d.get("sum", 0.0) - sum(
+                        s.get("sum", 0.0) for s in series
+                    )
+                    rest_buckets = []
+                    for i, b in enumerate(d.get("buckets", [])):
+                        child_c = sum(
+                            s["buckets"][i]["count"] for s in series
+                        )
+                        # remainder carries no exemplar: the parent's
+                        # slot exemplar may belong to a labeled observe
+                        rest_buckets.append(
+                            {"le": b["le"], "count": b["count"] - child_c}
+                        )
+                    emit_child(blank, rest_buckets, rest_sum, rest_count,
+                               ex_ok=False)
         else:
             for s in d.get("series", []):
                 lines.append(

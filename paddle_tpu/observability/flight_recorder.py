@@ -84,6 +84,9 @@ class FlightRecorder:
         self._prev_excepthook = None
         self._dump_count = 0
         self.last_dump_path = None
+        # name -> zero-arg callable; each contributes one bundle section
+        # (e.g. the SLO monitor's active alerts + recent window samples)
+        self._sections = {}
 
     # ------------------------------------------------------------ feeding
     def record_step(self, record):
@@ -107,6 +110,19 @@ class FlightRecorder:
         with self._lock:
             return list(self._events)
 
+    def add_section(self, name, fn):
+        """Register a provider whose ``fn()`` output lands under
+        ``bundle()['sections'][name]``. Replace-on-register, matching
+        the metrics registry: the newest owner of a name wins (an
+        engine reload re-attaching its monitor must not stack stale
+        providers)."""
+        with self._lock:
+            self._sections[str(name)] = fn
+
+    def remove_section(self, name):
+        with self._lock:
+            self._sections.pop(str(name), None)
+
     # ------------------------------------------------------------ dumping
     def bundle(self, reason="on_demand", exc=None, sync=True):
         """The diagnostic bundle as a plain dict (lazy values
@@ -123,6 +139,14 @@ class FlightRecorder:
         with self._lock:
             steps = [dict(r) for r in self._ring]
             events = [dict(e) for e in self._events]
+            providers = list(self._sections.items())
+        sections = {}
+        for sec_name, fn in providers:
+            # a broken provider must never take the crash dump with it
+            try:
+                sections[sec_name] = fn()
+            except Exception as e:  # pragma: no cover - defensive
+                sections[sec_name] = {"error": repr(e)}
         info = {"python": sys.version.split()[0]}
         try:
             import jax
@@ -172,6 +196,7 @@ class FlightRecorder:
             "events": events,
             "traces_in_flight": traces_in_flight,
             "spans_in_flight": spans_in_flight,
+            "sections": sections,
             "registry": registry_snap,
             "env": info,
         })

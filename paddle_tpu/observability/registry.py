@@ -241,6 +241,37 @@ class Gauge(_Metric):
         return {"type": self.metric_type, "series": series}
 
 
+class _HistogramChild:
+    """Per-label-set running state of a labeled :class:`Histogram`
+    (cumulative count/sum/buckets plus a small sliding window for
+    per-label percentiles). Mutated only under the parent's lock."""
+
+    __slots__ = ("count", "sum", "bucket_counts", "exemplars", "window")
+
+    def __init__(self, nslots, window_maxlen):
+        self.count = 0
+        self.sum = 0.0
+        self.bucket_counts = [0] * nslots
+        self.exemplars = [None] * nslots
+        self.window = collections.deque(maxlen=int(window_maxlen))
+
+
+class _BoundHistogram:
+    """``hist.labels(...)`` binding: observe() lands on BOTH the parent
+    aggregate and the labeled child, under one lock acquisition. Bind
+    once (e.g. at request admission) and the hot loop pays exactly the
+    unlabeled observe() cost — no per-sample label-dict allocation."""
+
+    __slots__ = ("_hist", "_key")
+
+    def __init__(self, hist, key):
+        self._hist = hist
+        self._key = key
+
+    def observe(self, v, trace_id=None):
+        self._hist.observe(v, trace_id=trace_id, labels_key=self._key)
+
+
 class Histogram(_Metric):
     """Sample distribution with bounded memory.
 
@@ -258,12 +289,18 @@ class Histogram(_Metric):
     observations while p50/p90/p99/min/max describe only the window;
     ``snapshot()['window_count']`` says how many samples the window
     currently holds so dashboards can tell the two populations apart.
-    """
+
+    Label support mirrors :class:`Counter`: ``labels(**labels)`` returns
+    a bound child whose ``observe`` updates the parent aggregate AND the
+    child's own cumulative count/sum/buckets (one lock acquisition), so
+    the unlabeled totals never need a sum over children at read time and
+    a mixed family stays double-count-free in the exposition (children +
+    blank-label remainder)."""
 
     metric_type = "histogram"
 
     def __init__(self, name, help="", unit="s", maxlen=65536,
-                 buckets=None, prom_name=None):
+                 buckets=None, prom_name=None, child_window=4096):
         super().__init__(name, help=help, unit=unit, prom_name=prom_name)
         self._samples = collections.deque(maxlen=int(maxlen))
         self._count = 0
@@ -275,8 +312,26 @@ class Histogram(_Metric):
         # each bucket slot (None until one arrives) — links a latency
         # bucket straight to a representative distributed trace
         self._exemplars = [None] * (len(self.buckets) + 1)
+        # labels_key -> _HistogramChild; bounded by label cardinality
+        # (slo_class is a small closed set)
+        self._children = {}
+        self._child_window = int(child_window)
 
-    def observe(self, v, trace_id=None):
+    def labels(self, **labels):
+        """A bound child for ``labels`` (the parent itself when empty).
+        Resolving allocates; the returned binding's observe() does not —
+        resolve once at admission, observe per token."""
+        if not labels:
+            return self
+        key = _labels_key(labels)
+        with self._lock:
+            if key not in self._children:
+                self._children[key] = _HistogramChild(
+                    len(self._bucket_counts), self._child_window
+                )
+        return _BoundHistogram(self, key)
+
+    def observe(self, v, trace_id=None, labels_key=None):
         v = float(v)
         with self._lock:
             self._samples.append(v)
@@ -288,6 +343,20 @@ class Histogram(_Metric):
                 self._exemplars[idx] = {
                     "trace_id": str(trace_id), "value": v,
                 }
+            if labels_key is not None:
+                ch = self._children.get(labels_key)
+                if ch is None:
+                    ch = self._children[labels_key] = _HistogramChild(
+                        len(self._bucket_counts), self._child_window
+                    )
+                ch.count += 1
+                ch.sum += v
+                ch.bucket_counts[idx] += 1
+                ch.window.append(v)
+                if trace_id is not None:
+                    ch.exemplars[idx] = {
+                        "trace_id": str(trace_id), "value": v,
+                    }
 
     @property
     def count(self):
@@ -367,6 +436,12 @@ class Histogram(_Metric):
             exemplars = [
                 None if e is None else dict(e) for e in self._exemplars
             ]
+            children = [
+                (key, ch.count, ch.sum, list(ch.bucket_counts),
+                 [None if e is None else dict(e) for e in ch.exemplars],
+                 sorted(ch.window))
+                for key, ch in self._children.items()
+            ]
         d = {"type": self.metric_type, "count": count,
              "window_count": len(window)}
         if window:
@@ -393,6 +468,33 @@ class Histogram(_Metric):
         buckets.append(inf_b)
         d["buckets"] = buckets
         d.setdefault("sum", total)
+        if children:
+            series = []
+            for key, c_count, c_sum, c_counts, c_ex, c_win in sorted(
+                children, key=lambda it: it[0]
+            ):
+                cb, acc2 = [], 0
+                for i, (ub, c) in enumerate(zip(self.buckets, c_counts)):
+                    acc2 += c
+                    b = {"le": ub, "count": acc2}
+                    if c_ex[i] is not None:
+                        b["exemplar"] = c_ex[i]
+                    cb.append(b)
+                inf_cb = {"le": float("inf"), "count": acc2 + c_counts[-1]}
+                if c_ex[-1] is not None:
+                    inf_cb["exemplar"] = c_ex[-1]
+                cb.append(inf_cb)
+                s = {"labels": dict(key), "count": c_count, "sum": c_sum,
+                     "buckets": cb}
+                if c_win:
+                    def cpct(p):
+                        k = max(0, min(len(c_win) - 1,
+                                       int(round(p / 100.0
+                                                 * (len(c_win) - 1)))))
+                        return c_win[k]
+                    s.update(p50=cpct(50), p99=cpct(99))
+                series.append(s)
+            d["series"] = series
         return d
 
 

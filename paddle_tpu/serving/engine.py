@@ -125,15 +125,23 @@ def build_chunk_prefill_body(net, do_sample, top_k, top_p):
 class _Seq:
     """Host-side state of one running sequence (one slab row)."""
 
-    __slots__ = ("handle", "last_tok", "emitted", "key")
+    __slots__ = ("handle", "last_tok", "emitted", "key",
+                 "slo_itl", "slo_e2e")
 
-    def __init__(self, handle, first_tok, key=None):
+    def __init__(self, handle, first_tok, key=None, slo_itl=None,
+                 slo_e2e=None):
         self.handle = handle
         self.last_tok = first_tok
         self.emitted = 0  # _append counts (prefill's first token too)
         # the request's base PRNG key (sampling_keys derivation) as a
         # host array — decode steps stack the active rows' keys
         self.key = key
+        # per-SLO-class bound histogram children, resolved ONCE at
+        # admission (observability.slo): the decode hot loop observes
+        # straight into them — zero per-token label resolution, the
+        # same pinning discipline as the _traced_live gate
+        self.slo_itl = slo_itl
+        self.slo_e2e = slo_e2e
 
     @property
     def pos(self):
@@ -437,11 +445,15 @@ class ServingEngine:
         )
 
     def submit(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
-               priority=0, deadline_s=None, on_token=None, on_event=None):
+               priority=0, deadline_s=None, slo_class=None,
+               on_token=None, on_event=None):
         """Enqueue one request; always returns a RequestHandle (status
         REJECTED with ``.reason`` set on backpressure — submit never
         blocks and never throws for load reasons).
 
+        ``slo_class`` names the request's SLO traffic class
+        (``interactive`` when None; see ``observability.slo``) — it
+        labels the TTFT/ITL/E2E histograms this request lands in.
         ``on_token(tok, handle)`` streams each emitted token as the
         engine produces it; ``on_event(handle)`` fires exactly once at
         the terminal transition (including submit-time rejects — a
@@ -449,6 +461,7 @@ class ServingEngine:
         req = Request(
             input_ids, max_new_tokens, eos_token_id=eos_token_id,
             priority=priority, deadline_s=deadline_s,
+            slo_class=slo_class,
         )
         self.metrics.submitted.inc()
         if self._closed:
@@ -505,7 +518,9 @@ class ServingEngine:
         elif status == TIMEOUT:
             self.metrics.timeouts.inc()
         tid = None if h.trace is None else h.trace.trace_id
-        self.metrics.e2e.observe(now - h.submit_time, trace_id=tid)
+        (seq.slo_e2e or self.metrics.e2e).observe(
+            now - h.submit_time, trace_id=tid
+        )
         sp = h._decode_span
         if sp is not None:
             h._decode_span = None
@@ -602,10 +617,14 @@ class ServingEngine:
         self.metrics.admitted.inc()
         self.metrics.prefill_tokens.inc(req.prompt_len)
         self.metrics.queue_wait.observe(wait, trace_id=tid)
-        self.metrics.ttft.observe(handle.first_token_time
-                                  - handle.submit_time, trace_id=tid)
+        slo_ttft, slo_itl, slo_e2e = self.metrics.slo_children(
+            req.slo_class
+        )
+        slo_ttft.observe(handle.first_token_time - handle.submit_time,
+                         trace_id=tid)
         self._trace_admitted(handle, slot, wait)
-        self._seqs[slot] = _Seq(handle, t0, key=np.asarray(key))
+        self._seqs[slot] = _Seq(handle, t0, key=np.asarray(key),
+                                slo_itl=slo_itl, slo_e2e=slo_e2e)
         self._append(slot, t0)
 
     def _decode_extra(self):
@@ -736,9 +755,12 @@ class ServingEngine:
                     sp.event("decode_step", step=self.step_count,
                              occupancy=occ, dt_s=dt)
         for i in active:
-            if self._seqs[i] is None:
+            seq = self._seqs[i]
+            if seq is None:
                 continue  # finished by an earlier row this step
-            self.metrics.itl.observe(dt)
+            # per-class child bound at admission: no label resolution
+            # (and no allocation) on this per-token path
+            (seq.slo_itl or self.metrics.itl).observe(dt)
             self._append(i, nxt[i])
 
     def run_until_idle(self, max_steps=100_000):
@@ -1190,9 +1212,9 @@ class StaticBatchEngine:
         self._total_len = None
 
     def submit(self, input_ids, *, priority=0, deadline_s=None,
-               on_token=None, on_event=None):
+               slo_class=None, on_token=None, on_event=None):
         req = Request(input_ids, 1, priority=priority,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, slo_class=slo_class)
         self.metrics.submitted.inc()
         if req.prompt_len != self.prompt_len:
             h = RequestHandle(req, on_token=on_token, on_event=on_event)
@@ -1272,10 +1294,13 @@ class StaticBatchEngine:
                 self.metrics.tokens_out.inc(new)
                 self.metrics.prefill_tokens.inc(self.prompt_len)
                 self.metrics.queue_wait.observe(t0 - h.submit_time)
-                self.metrics.ttft.observe(now - h.submit_time)
+                slo_ttft, slo_itl, slo_e2e = self.metrics.slo_children(
+                    h.request.slo_class
+                )
+                slo_ttft.observe(now - h.submit_time)
                 if new > 1:
-                    self.metrics.itl.observe(dt / new)
-                self.metrics.e2e.observe(now - h.submit_time)
+                    slo_itl.observe(dt / new)
+                slo_e2e.observe(now - h.submit_time)
                 for t in h.tokens:
                     h._fire_token(t)
                 h._fire_terminal()

@@ -136,13 +136,19 @@ class RouterMetrics:
             prom_name=f"{ns}_replica_prefix_hits",
             help="prefix-cache hits from the replica's last status "
                  "(absent series = replica runs no prefix cache)")
+        self.replica_alerts = Gauge(
+            "fleet_replica_alerts_active",
+            prom_name=f"{ns}_replica_alerts_active",
+            help="1 while the replica reports this burn-rate alert "
+                 "active in its /healthz alerts block, 0 once cleared "
+                 "(labels: replica, rule, slo_class)")
         reg = registry or get_registry()
         reg.register_all([
             self.requests, self.http_requests, self.retries, self.shed,
             self.breaker_opens, self.stream_aborts, self.ttft,
             self.replica_healthy, self.replica_free_pages,
             self.replica_queue_depth, self.replica_active,
-            self.replica_prefix_hits,
+            self.replica_prefix_hits, self.replica_alerts,
         ])
 
 
@@ -161,6 +167,9 @@ class ReplicaState:
         self.failures = 0           # consecutive request-path failures
         self.breaker_open_until = 0.0
         self.requests_routed = 0
+        # (rule, slo_class) pairs seen active in the last scrape — the
+        # set difference drives 1 -> 0 gauge transitions on clear
+        self.alert_keys = set()
 
     @property
     def url(self):
@@ -188,6 +197,7 @@ class ReplicaState:
             "reload_in_progress": st.get("reload_in_progress"),
             "compile_cache_hits": st.get("compile_cache_hits"),
             "prefix_cache": st.get("prefix_cache"),
+            "alerts": st.get("alerts"),
         }
 
 
@@ -363,6 +373,23 @@ class FleetRouter:
         hits = (status.get("prefix_cache") or {}).get("hits")
         if hits is not None:
             m.replica_prefix_hits.set(float(hits), replica=idx)
+        # burn-rate alert aggregation: mirror the replica's active set
+        # into the router gauge, clearing (1 -> 0) series that vanished
+        active = (status.get("alerts") or {}).get("active") or []
+        keys = set()
+        for a in active:
+            if not isinstance(a, dict):
+                continue
+            key = (str(a.get("rule")), str(a.get("slo_class")))
+            keys.add(key)
+        with self._lock:
+            prev, r.alert_keys = r.alert_keys, keys
+        for rule, cls in keys:
+            m.replica_alerts.set(1, replica=idx, rule=rule,
+                                 slo_class=cls)
+        for rule, cls in prev - keys:
+            m.replica_alerts.set(0, replica=idx, rule=rule,
+                                 slo_class=cls)
 
     def _scrape_all(self):
         # one thread per replica: a few unreachable hosts hanging to
@@ -504,6 +531,28 @@ class FleetRouter:
                 self.metrics.http_requests.inc(label="200")
             elif path == "/trace":
                 self._send_json(h, 200, trace_payload(self.tracer))
+            elif path == "/alerts":
+                # fleet-wide SLO view: every replica's active alert
+                # block from the last /healthz scrape, in one response
+                with self._lock:
+                    reps = [
+                        {
+                            "index": r.index,
+                            "host": r.host,
+                            "port": r.port,
+                            "alerts": (r.status or {}).get("alerts"),
+                        }
+                        for r in self.replicas
+                    ]
+                total = sum(
+                    len(((rep["alerts"] or {}).get("active")) or [])
+                    for rep in reps
+                )
+                self._send_json(h, 200, {
+                    "role": "fleet-router",
+                    "active_total": total,
+                    "replicas": reps,
+                })
             elif path in ("/healthz", "/replicas"):
                 now = self.clock()
                 reps = [r.summary(now) for r in self.replicas]

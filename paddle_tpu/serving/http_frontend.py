@@ -45,6 +45,7 @@ from __future__ import annotations
 import collections
 import json
 import math
+import os
 import queue
 import threading
 import time
@@ -113,12 +114,29 @@ class ServingFrontend:
     """
 
     def __init__(self, engine, host="127.0.0.1", port=0, registry=None,
-                 stream_timeout_s=120.0):
+                 stream_timeout_s=120.0, slo_monitor=None):
         self.engine = engine
         self.host = host
         self.port = int(port)
         self.metrics = FrontendMetrics(registry=registry)
         self.stream_timeout_s = float(stream_timeout_s)
+        # SLO observability plane: the monitor backs /alerts and the
+        # healthz alerts block. A caller-provided monitor is used as-is
+        # (the caller owns its sampling); otherwise one is created and
+        # its background sampler starts with the frontend when
+        # PADDLE_TPU_SLO_INTERVAL (seconds) is set.
+        if slo_monitor is None:
+            from ..observability.slo import SLOMonitor
+
+            iv = os.environ.get("PADDLE_TPU_SLO_INTERVAL")
+            slo_monitor = SLOMonitor(
+                registry=registry,
+                interval_s=float(iv) if iv else 5.0,
+            )
+            self._own_slo_monitor = bool(iv)
+        else:
+            self._own_slo_monitor = False
+        self.slo_monitor = slo_monitor
         # graceful drain: a draining frontend stops ADMITTING (new
         # generate requests get 503 {"reason": "draining"}) but keeps
         # the driver stepping, so every in-flight stream finishes —
@@ -149,6 +167,8 @@ class ServingFrontend:
             target=self._drive, name="paddle-serve-driver", daemon=True,
         )
         self._driver_thread.start()
+        if self._own_slo_monitor:
+            self.slo_monitor.start()
         return self
 
     def stop(self, close_engine=False):
@@ -157,6 +177,8 @@ class ServingFrontend:
         hang (``close_engine=True`` cancels in-flight requests, which
         fires their terminal callbacks)."""
         self._stop.set()
+        if self._own_slo_monitor:
+            self.slo_monitor.stop()
         if close_engine:
             with self._lock:
                 try:
@@ -237,6 +259,8 @@ class ServingFrontend:
                 self.metrics.http_requests.inc(label="200")
             elif path == "/trace":
                 self._send_json(h, 200, trace_payload())
+            elif path == "/alerts":
+                self._send_json(h, 200, self.slo_monitor.status())
             elif path == "/healthz":
                 self._send_json(h, 200, self.health())
             else:
@@ -289,6 +313,9 @@ class ServingFrontend:
             "compile_cache_hits": getattr(eng, "compile_cache_hits", 0),
             "max_queue_size": getattr(eng.scheduler, "max_queue_size",
                                       None),
+            # burn-rate alert block: what the fleet router aggregates —
+            # a fleet-wide SLO breach is one /healthz scrape away
+            "alerts": self.slo_monitor.alerts_block(),
         }
         guard = getattr(eng, "trace_guard", None)
         if guard is not None:
@@ -393,6 +420,19 @@ class ServingFrontend:
                 max_new = int(body["max_new_tokens"])
                 if max_new < 1:
                     raise ValueError("max_new_tokens must be >= 1")
+            # resolve the SLO class at the wire: unknown -> 400 right
+            # here; absent -> the default class. Only an explicit field
+            # is forwarded to submit (an engine without the kwarg —
+            # user-supplied stub — still takes default-class traffic).
+            from ..observability.slo import DEFAULT_CLASS, get_slo_registry
+
+            slo_class = DEFAULT_CLASS
+            if body.get("slo_class") is not None:
+                raw = body["slo_class"]
+                if not isinstance(raw, str):
+                    raise ValueError("slo_class must be a string")
+                slo_class = get_slo_registry().validate(raw)
+                kwargs["slo_class"] = slo_class
         except Exception as e:
             self._send_json(h, 400, {"error": f"bad request: {e}"})
             return
@@ -427,12 +467,14 @@ class ServingFrontend:
                             "frontend.request", ctx,
                             request_id=handle.request.request_id,
                             prompt_len=handle.request.prompt_len,
+                            slo_class=slo_class,
                         )
                     else:
                         handle.trace = tr.start_trace(
                             "frontend.request",
                             request_id=handle.request.request_id,
                             prompt_len=handle.request.prompt_len,
+                            slo_class=slo_class,
                         )
         except TypeError as e:
             # a field the wrapped engine doesn't take (StaticBatchEngine

@@ -71,8 +71,9 @@ class Histogram(_reg.Histogram):
                          buckets=buckets, prom_name=prom_name)
         self._export = export
 
-    def observe(self, v, trace_id=None):
-        super().observe(float(v), trace_id=trace_id)
+    def observe(self, v, trace_id=None, labels_key=None):
+        super().observe(float(v), trace_id=trace_id,
+                        labels_key=labels_key)
         if self._export:
             from .. import profiler
 
@@ -185,6 +186,27 @@ class ServingMetrics:
             self.spec_rounds, self.spec_proposed, self.spec_accepted,
             self.spec_accept_length,
         ])
+        # slo_class -> (ttft_child, itl_child, e2e_child). Lives on the
+        # metrics OBJECT (not the engine) so the cache dies with the
+        # instrument it binds to — serve_bench swaps engine.metrics
+        # wholesale after warmup, and a cache held elsewhere would keep
+        # observing into the discarded histograms.
+        self._slo_children = {}
+
+    def slo_children(self, slo_class):
+        """Per-class bound children of the latency histograms, resolved
+        once per class per metrics instance. Called at ADMISSION only;
+        the returned bindings are what the hot loops observe into, so
+        the per-token path never touches a label dict."""
+        ch = self._slo_children.get(slo_class)
+        if ch is None:
+            ch = (
+                self.ttft.labels(slo_class=slo_class),
+                self.itl.labels(slo_class=slo_class),
+                self.e2e.labels(slo_class=slo_class),
+            )
+            self._slo_children[slo_class] = ch
+        return ch
 
     def observe_step(self, queue_depth, active_slots):
         self.queue_depth.observe(queue_depth)

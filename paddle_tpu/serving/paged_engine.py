@@ -813,10 +813,14 @@ class PagedServingEngine(ServingEngine):
         self.metrics.admitted.inc()
         self.metrics.prefill_tokens.inc(req.prompt_len)
         self.metrics.queue_wait.observe(wait, trace_id=tid)
-        self.metrics.ttft.observe(handle.first_token_time
-                                  - handle.submit_time, trace_id=tid)
+        slo_ttft, slo_itl, slo_e2e = self.metrics.slo_children(
+            req.slo_class
+        )
+        slo_ttft.observe(handle.first_token_time - handle.submit_time,
+                         trace_id=tid)
         self._trace_admitted(handle, row, wait)
-        self._seqs[row] = _Seq(handle, t0, key=np.asarray(key))
+        self._seqs[row] = _Seq(handle, t0, key=np.asarray(key),
+                               slo_itl=slo_itl, slo_e2e=slo_e2e)
         self._append(row, t0)
 
     # ------------------------------------------------------- AOT warmup

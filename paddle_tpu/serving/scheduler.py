@@ -54,7 +54,7 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, input_ids, max_new_tokens, *, eos_token_id=None,
-                 priority=0, deadline_s=None):
+                 priority=0, deadline_s=None, slo_class=None):
         import numpy as np
 
         ids = np.asarray(input_ids)
@@ -75,6 +75,14 @@ class Request:
         self.eos_token_id = eos_token_id
         self.priority = int(priority)
         self.deadline_s = deadline_s  # relative seconds; resolved at submit
+        # SLO traffic class (observability.slo): labels the latency
+        # histograms and the trace root. The scheduler itself is
+        # class-blind today — budget-aware admission is the follow-up.
+        if slo_class is None:
+            from ..observability.slo import DEFAULT_CLASS
+
+            slo_class = DEFAULT_CLASS
+        self.slo_class = str(slo_class)
         self.request_id = next(Request._ids)
 
     @property

@@ -700,7 +700,8 @@ class SpeculativeDecoder:
         m.spec_proposed.inc(k_eff)
         m.spec_accepted.inc(a)
         m.spec_accept_length.observe(len(out), trace_id=tid)
-        m.itl.observe(dt / len(out))
+        # per-SLO-class child bound at admission (zero label work here)
+        (seq.slo_itl or m.itl).observe(dt / len(out))
         if vsp is not None:
             vsp.finish(proposed=k_eff, accepted=a, emitted=len(out))
         for t in out:

@@ -626,6 +626,10 @@ def test_warmup_covers_gather_and_chunk_programs(net):
     _assert_drained(eng)
 
 
+@pytest.mark.slow  # gated every merge by `make reload-smoke` (replica
+# relaunches warm from the shared AOT cache: zero new compile entries
+# at first traffic); the gather/chunk inventory SHAPE stays tier-1 via
+# test_warmup_covers_gather_and_chunk_programs
 def test_warmup_gather_chunk_round_trips_aot_cache(net, tmp_path):
     """A relaunched prefix engine with the same geometry must LOAD the
     gather/chunk executables from the AOT cache instead of compiling

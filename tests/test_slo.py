@@ -1,0 +1,303 @@
+"""SLO observability plane — ring math, burn-rate lifecycle, per-class
+histogram labels, and the zero-overhead admission contract.
+
+The strong checks: windowed delta/rate math must match hand-computed
+values (counter resets tolerated), the fast/slow burn-rate pair must
+fire together on a sudden breach and clear in ORDER (fast first as its
+window rolls off, slow holding through the tail), and the greedy
+decode hot loop must resolve per-class histogram children exactly ONCE
+per admission — never per token.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import TimeSeriesRing
+from paddle_tpu.observability.exporter import (
+    parse_prometheus_text,
+    prometheus_text,
+)
+from paddle_tpu.observability.flight_recorder import FlightRecorder
+from paddle_tpu.observability.registry import MetricsRegistry
+from paddle_tpu.observability.slo import (
+    BurnRateRule,
+    SLOClass,
+    SLOMonitor,
+    SLORegistry,
+    UnknownSLOClassError,
+    attainment_report,
+    default_classes,
+    within_budget,
+)
+from paddle_tpu.serving import ServingEngine, ServingMetrics
+
+RNG = np.random.RandomState(13)
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(5)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _tight_registry():
+    return SLORegistry([
+        SLOClass("interactive", ttft_p99_s=0.25, itl_p99_s=5.0,
+                 e2e_p99_s=60.0, target=0.9),
+    ])
+
+
+# ----------------------------------------------------------- ring math
+def test_ring_bounded_under_long_runs():
+    ring = TimeSeriesRing(capacity=64)
+    for t in range(5000):
+        ring.append(float(t), {"c": float(t)})
+    assert len(ring) == 64
+    tail = ring.last(3)
+    assert [t for t, _ in tail] == [4997.0, 4998.0, 4999.0]
+    # the window's baseline sample sits just BEFORE the window start
+    win = ring.window(2.0, now=4999.0)
+    assert [t for t, _ in win] == [4996.0, 4997.0, 4998.0, 4999.0]
+    with pytest.raises(ValueError):
+        TimeSeriesRing(capacity=1)
+
+
+def test_ring_delta_and_rate_hand_computed():
+    ring = TimeSeriesRing(capacity=16)
+    ring.append(0.0, {"c": 10.0})
+    ring.append(1.0, {"c": 14.0})
+    ring.append(2.0, {"c": 20.0})
+    ring.append(3.0, {"c": 26.0})
+    assert ring.delta("c") == 16.0
+    # window [2, 3] plus the t=1 baseline: covers increments over (1, 3]
+    assert ring.delta("c", window_s=1.0, now=3.0) == 12.0
+    assert ring.rate("c") == pytest.approx(16.0 / 3.0)
+    assert ring.delta("missing") == 0.0
+    assert ring.latest("c") == 26.0
+    assert ring.latest("missing", default=-1.0) == -1.0
+
+
+def test_ring_counter_reset_tolerated():
+    """An engine reload re-registers a cumulative series at zero; the
+    down-step must contribute NOTHING, not a negative spike."""
+    ring = TimeSeriesRing(capacity=16)
+    for t, v in enumerate([10.0, 14.0, 2.0, 5.0]):
+        ring.append(float(t), {"c": v})
+    assert ring.delta("c") == (14.0 - 10.0) + (5.0 - 2.0)
+    assert ring.rate("c") == pytest.approx(7.0 / 3.0)
+
+
+def test_within_budget_interpolation():
+    buckets = [{"le": 0.1, "count": 4}, {"le": 1.0, "count": 8},
+               {"le": float("inf"), "count": 10}]
+    assert within_budget(buckets, 0.1) == 4.0  # exact at a boundary
+    assert within_budget(buckets, 0.55) == pytest.approx(6.0)
+    assert within_budget(buckets, 1.0) == 8.0
+    # +Inf mass breaches: past every finite bound we cannot vouch
+    assert within_budget(buckets, 5.0) == 8.0
+
+
+# ------------------------------------------------------- class registry
+def test_slo_registry_validate_and_defaults():
+    reg = SLORegistry()
+    assert reg.names() == ["agent", "batch", "interactive", "rag"]
+    assert reg.validate(None) == "interactive"
+    assert reg.validate("") == "interactive"
+    assert reg.validate("rag") == "rag"
+    with pytest.raises(UnknownSLOClassError):
+        reg.validate("nope")
+    assert {c.name for c in default_classes()} == set(reg.names())
+    with pytest.raises(ValueError):
+        SLOClass("bad", ttft_p99_s=1, itl_p99_s=1, e2e_p99_s=1,
+                 target=1.5)
+
+
+# ------------------------------------------- burn-rate alert lifecycle
+def test_burn_rate_fast_slow_fire_and_clear_ordering():
+    """Sudden breach: both windows fire on the next sample. Recovery:
+    the FAST window rolls the breach off first and clears while the
+    slow window still holds it (anti-flap), then slow clears too —
+    with matching flight-recorder events in order."""
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    rec = FlightRecorder()
+    rule = BurnRateRule("ord_ttft", "interactive", metric="ttft",
+                        fast_window_s=2.0, slow_window_s=8.0,
+                        fast_burn=2.0, slow_burn=1.0, min_requests=2)
+    mon = SLOMonitor(registry=reg, slo_registry=_tight_registry(),
+                     rules=[rule], recorder=rec)
+    child = m.ttft.labels(slo_class="interactive")
+
+    mon.sample(now=0.0)
+    for _ in range(5):
+        child.observe(0.01)          # healthy
+    mon.sample(now=1.0)
+    assert mon.active_alerts() == []
+    assert mon.attainment("interactive", "ttft", 2.0, now=1.0) == 1.0
+
+    for _ in range(4):
+        child.observe(0.9)           # sudden total breach
+    mon.sample(now=2.0)
+    active = {a["rule"]: a for a in mon.active_alerts()}
+    assert set(active) == {"ord_ttft:fast", "ord_ttft:slow"}
+    # fast window (0, 2]: 9 requests, 5 within -> burn (1-5/9)/0.1
+    assert active["ord_ttft:fast"]["burn"] == pytest.approx(
+        (1 - 5 / 9) / 0.1)
+    assert active["ord_ttft:fast"]["severity"] == "fast"
+
+    for _ in range(6):
+        child.observe(0.01)          # recovery traffic
+    mon.sample(now=3.0)
+    # fast window has rolled the breach off by 5.5; slow still holds it
+    mon.sample(now=5.5)
+    active = [a["rule"] for a in mon.active_alerts()]
+    assert active == ["ord_ttft:slow"]
+
+    mon.sample(now=14.0)             # slow window rolls off too
+    mon.sample(now=15.0)
+    assert mon.active_alerts() == []
+
+    ordered = [(e["kind"], e["rule"]) for e in rec.events()
+               if e["kind"].startswith("slo_alert")]
+    assert ordered == [
+        ("slo_alert", "ord_ttft:fast"),
+        ("slo_alert", "ord_ttft:slow"),
+        ("slo_alert_cleared", "ord_ttft:fast"),
+        ("slo_alert_cleared", "ord_ttft:slow"),
+    ]
+    # the gauge mirrors the lifecycle: both series ended at 0
+    gauge = reg.get("paddle_alerts_active")
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in gauge.data()["series"]}
+    assert all(v == 0 for v in series.values())
+    assert len(series) == 2
+
+
+def test_monitor_thin_window_suppressed():
+    """min_requests keeps one slow request at 3 a.m. from paging."""
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    rule = BurnRateRule("thin_ttft", "interactive", metric="ttft",
+                        fast_window_s=2.0, slow_window_s=8.0,
+                        min_requests=3)
+    mon = SLOMonitor(registry=reg, slo_registry=_tight_registry(),
+                     rules=[rule], recorder=FlightRecorder())
+    child = m.ttft.labels(slo_class="interactive")
+    child.observe(0.01)                     # series exists at baseline
+    mon.sample(now=0.0)
+    child.observe(0.9)                      # ONE breach in the window
+    mon.sample(now=1.0)
+    assert mon.active_alerts() == []
+    assert mon.attainment("interactive", "ttft", 2.0, now=1.0) == 0.0
+
+
+def test_flight_bundle_slo_section_and_status():
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    rec = FlightRecorder()
+    mon = SLOMonitor(registry=reg, slo_registry=_tight_registry(),
+                     recorder=rec)
+    m.ttft.labels(slo_class="interactive").observe(0.01)
+    mon.sample(now=0.0)
+    mon.sample(now=1.0)
+    sec = rec.bundle()["sections"]["slo"]
+    assert sec["active_alerts"] == []
+    assert [s["t"] for s in sec["window_samples"]] == [0.0, 1.0]
+    assert sec["window_samples"][-1]["values"][
+        "ttft.interactive.total"] == 1.0
+    status = mon.status()
+    assert status["samples"] == 2
+    assert [r["name"] for r in status["rules"]] == ["interactive_ttft"]
+    assert [c["name"] for c in status["classes"]] == ["interactive"]
+    # a provider that throws must not take the bundle down
+    rec.add_section("boom", lambda: 1 / 0)
+    assert "error" in rec.bundle()["sections"]["boom"]
+
+
+# ------------------------------------- per-class labels on the engines
+def test_default_and_explicit_class_labeling(net):
+    reg = MetricsRegistry()
+    eng = ServingEngine(net, max_batch_size=2, max_seq_len=64,
+                        min_bucket=8,
+                        metrics=ServingMetrics(registry=reg))
+    p = RNG.randint(0, 64, (1, 6))
+    eng.submit(p, 3)                       # no class -> interactive
+    eng.submit(p, 3, slo_class="rag")
+    eng.run_until_idle()
+    for hist in (eng.metrics.ttft, eng.metrics.e2e):
+        d = hist.data()
+        got = {s["labels"]["slo_class"]: s["count"]
+               for s in d["series"]}
+        assert got == {"interactive": 1, "rag": 1}
+        assert d["count"] == 2             # parent aggregate intact
+    rep = attainment_report(registry=reg,
+                            slo_registry=SLORegistry())
+    assert rep["rag"]["ttft"]["total"] == 1
+    assert 0.0 <= rep["rag"]["ttft"]["attainment"] <= 1.0
+
+
+def test_hot_loop_resolves_children_once_per_admission(net):
+    """The decode loop must NEVER resolve histogram children: one
+    ``slo_children`` call per admission, one ``labels`` resolution per
+    class ever (cached on the metrics object)."""
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    calls = {"children": 0, "labels": 0}
+    orig_children = m.slo_children
+    orig_labels = m.itl.labels
+
+    def counting_children(cls):
+        calls["children"] += 1
+        return orig_children(cls)
+
+    def counting_labels(**kw):
+        calls["labels"] += 1
+        return orig_labels(**kw)
+
+    m.slo_children = counting_children
+    m.itl.labels = counting_labels
+    eng = ServingEngine(net, max_batch_size=2, max_seq_len=64,
+                        min_bucket=8, metrics=m)
+    p = RNG.randint(0, 64, (1, 6))
+    eng.submit(p, 8)
+    eng.submit(p, 8)
+    eng.run_until_idle()
+    assert calls["children"] == 2          # once per admission
+    assert calls["labels"] == 1            # cached after first resolve
+    assert m.itl.count >= 14               # the tokens still landed
+    child_count = m.itl.data()["series"][0]["count"]
+    assert child_count == m.itl.count
+
+
+# -------------------------------------- exposition round-trip + labels
+def test_labeled_histogram_exposition_roundtrip():
+    reg = MetricsRegistry()
+    m = ServingMetrics(registry=reg)
+    m.ttft.observe(0.02)                               # bare aggregate
+    m.ttft.labels(slo_class="interactive").observe(0.03, trace_id="ab12")
+    m.ttft.labels(slo_class="rag").observe(0.3)
+    text = prometheus_text(reg, exemplars=True)
+    series, exemplars = parse_prometheus_text(text, exemplars=True)
+    counts = {s[0].get("slo_class", ""): s[1]
+              for s in series["paddle_serving_ttft_seconds_count"]}
+    # labeled children + blank-label remainder partition the parent
+    assert counts == {"interactive": 1.0, "rag": 1.0, "": 1.0}
+    ex = [e for e in exemplars
+          if e["exemplar_labels"].get("trace_id") == "ab12"]
+    assert ex and ex[0]["labels"]["slo_class"] == "interactive"
+    # every labeled bucket family ends cumulative at its child count
+    inf = [
+        (lb, v)
+        for lb, v in series["paddle_serving_ttft_seconds_bucket"]
+        if lb["le"] == "+Inf"
+    ]
+    assert sorted((lb.get("slo_class", ""), v) for lb, v in inf) == [
+        ("", 1.0), ("interactive", 1.0), ("rag", 1.0),
+    ]

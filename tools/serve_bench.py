@@ -40,9 +40,9 @@ import time
 
 
 def build_trace(n, rate, seed, vocab, prompt_lo, prompt_hi, new_lo,
-                new_hi):
-    """[(arrival_s, prompt ids, max_new)] — Poisson arrivals, uniform
-    lengths; fully determined by ``seed``."""
+                new_hi, slo_class="interactive"):
+    """[(arrival_s, prompt ids, max_new, slo_class)] — Poisson
+    arrivals, uniform lengths; fully determined by ``seed``."""
     import numpy as np
 
     rng = np.random.RandomState(seed)
@@ -53,8 +53,110 @@ def build_trace(n, rate, seed, vocab, prompt_lo, prompt_hi, new_lo,
         L = int(rng.randint(prompt_lo, prompt_hi + 1))
         m = int(rng.randint(new_lo, new_hi + 1))
         trace.append((float(arrivals[i]), rng.randint(0, vocab, (1, L)),
-                      m))
+                      m, slo_class))
     return trace
+
+
+# --mix scenario names -> the SLO class their requests are tagged with
+MIX_SCENARIOS = ("chat", "rag", "batch", "agent")
+
+
+def build_mix_trace(mix, n, rate, seed, vocab, prompt_lo, prompt_hi,
+                    new_lo, new_hi):
+    """Named scenario mix: ``mix`` is a comma list from
+    ``chat,rag,batch,agent``; ``n`` requests are split evenly across the
+    named scenarios, each with its own arrival SHAPE (not just its own
+    rate), then merged into one arrival-sorted open-loop trace:
+
+    - ``chat`` (class ``interactive``): multi-turn sessions — 3 turns
+      per session, turns spaced a few token-times apart, each turn's
+      prompt longer than the last (the growing conversation context);
+    - ``rag`` (class ``rag``): shared-prefix bursts — one retrieval
+      context per burst, 4 near-simultaneous requests over it (the
+      prefix-cache shape);
+    - ``batch`` (class ``batch``): a flash-crowd ramp — arrivals
+      concentrated toward the tail of the horizon, the thundering-herd
+      shape that overruns admission;
+    - ``agent`` (class ``agent``): steady Poisson tool-loop turns.
+
+    Deterministic in ``seed``."""
+    import numpy as np
+
+    names = [s.strip() for s in str(mix).split(",") if s.strip()]
+    if not names:
+        raise SystemExit("--mix needs at least one scenario name")
+    for s in names:
+        if s not in MIX_SCENARIOS:
+            raise SystemExit(
+                f"unknown --mix scenario {s!r} "
+                f"(known: {', '.join(MIX_SCENARIOS)})"
+            )
+    rng = np.random.RandomState(seed)
+    horizon = n / max(rate, 1e-6)  # nominal trace duration, seconds
+    share = max(1, n // len(names))
+    events = []
+
+    def prompt(length):
+        length = int(max(prompt_lo, min(prompt_hi, length)))
+        return rng.randint(0, vocab, (1, length))
+
+    for name in names:
+        k = share
+        if name == "chat":
+            turns = 3
+            sessions = max(1, k // turns)
+            for _ in range(sessions):
+                start = float(rng.uniform(0.0, horizon * 0.8))
+                base = int(rng.randint(prompt_lo, prompt_hi + 1))
+                for t in range(turns):
+                    gap = float(rng.exponential(
+                        max(0.5 / rate, 1e-3))) * (t + 1)
+                    events.append((
+                        start + t * gap,
+                        prompt(base + 4 * t),  # context grows per turn
+                        int(rng.randint(new_lo, new_hi + 1)),
+                        "interactive",
+                    ))
+        elif name == "rag":
+            burst_sz = 4
+            bursts = max(1, k // burst_sz)
+            for _ in range(bursts):
+                start = float(rng.uniform(0.0, horizon * 0.9))
+                # one retrieval context, shared verbatim by the burst
+                ctx = prompt(prompt_hi)
+                for j in range(burst_sz):
+                    ids = ctx.copy()
+                    if ids.shape[1] > 1:
+                        # distinct question tail on the shared context
+                        ids[0, -1] = int(rng.randint(0, vocab))
+                    events.append((
+                        start + j * 0.002,
+                        ids,
+                        int(rng.randint(new_lo, new_hi + 1)),
+                        "rag",
+                    ))
+        elif name == "batch":
+            for _ in range(k):
+                # sqrt ramp: density grows linearly toward the tail
+                u = float(rng.uniform())
+                events.append((
+                    horizon * (0.5 + 0.5 * (u ** 0.5)),
+                    prompt(int(rng.randint(prompt_lo, prompt_hi + 1))),
+                    int(rng.randint(new_lo, new_hi + 1)),
+                    "batch",
+                ))
+        else:  # agent: steady poisson over the whole horizon
+            gaps = rng.exponential(horizon / max(k, 1), size=k)
+            t_at = np.minimum(np.cumsum(gaps), horizon)
+            for t in t_at:
+                events.append((
+                    float(t),
+                    prompt(int(rng.randint(prompt_lo, prompt_hi + 1))),
+                    int(rng.randint(new_lo, new_hi + 1)),
+                    "agent",
+                ))
+    events.sort(key=lambda e: e[0])
+    return events
 
 
 def make_engine(args, net, speculative=None):
@@ -159,10 +261,16 @@ def run_bench(args):
     if getattr(args, "zero_from_layer", None) is not None:
         zero_from_layer(net, args.zero_from_layer)
     engine = make_engine(args, net, make_speculative(args, cfg))
-    trace = build_trace(
-        args.requests, args.rate, args.seed, args.vocab,
-        args.prompt_min, args.prompt_max, args.new_min, args.new_max,
-    )
+    if getattr(args, "mix", None):
+        trace = build_mix_trace(
+            args.mix, args.requests, args.rate, args.seed, args.vocab,
+            args.prompt_min, args.prompt_max, args.new_min, args.new_max,
+        )
+    else:
+        trace = build_trace(
+            args.requests, args.rate, args.seed, args.vocab,
+            args.prompt_min, args.prompt_max, args.new_min, args.new_max,
+        )
 
     # warmup: compile the decode step + the prompt buckets off the clock
     if args.warmup:
@@ -172,7 +280,7 @@ def run_bench(args):
         # table the record carries
         engine.warmup()
         for bucket in sorted({
-            engine.pool.bucket_for(p.shape[1]) for _, p, _ in trace
+            engine.pool.bucket_for(p.shape[1]) for _, p, _, _ in trace
         }):
             # largest prompt length that still lands in `bucket` AND
             # leaves room for the 2 warmup tokens under max_seq (a
@@ -206,8 +314,8 @@ def run_bench(args):
         while pending or engine.scheduler.depth or engine.active_slots:
             now = time.monotonic() - t0
             while pending and pending[0][0] <= now:
-                _, ids, m = pending.pop(0)
-                handles.append(engine.submit(ids, m))
+                _, ids, m, cls = pending.pop(0)
+                handles.append(engine.submit(ids, m, slo_class=cls))
             if engine.scheduler.depth or engine.active_slots:
                 engine.step()
                 peak_active = max(peak_active, engine.active_slots)
@@ -235,6 +343,14 @@ def run_bench(args):
         "metrics": rep,
     }
     out["peak_active_requests"] = peak_active
+    if getattr(args, "mix", None):
+        out["mix"] = args.mix
+        out["mix_classes"] = sorted({cls for _, _, _, cls in trace})
+    # per-class SLO attainment table straight off the labeled latency
+    # histograms (warmup was excluded above by the metrics reset)
+    from paddle_tpu.observability.slo import attainment_report
+
+    out["slo"] = attainment_report()
     mem = engine.memory_report()
     if mem is not None:
         # the warmup-time HBM footprint table: estimated peak resident
@@ -271,7 +387,7 @@ def run_bench(args):
         # byte budget the whole arena pins, so a quantized-KV record is
         # directly comparable against a bf16 one at equal HBM.
         mean_total = sum(
-            p.shape[1] + m for _, p, m in trace
+            p.shape[1] + m for _, p, m, _ in trace
         ) / max(len(trace), 1)
         out["page_pool"]["request_resident_bytes_mean"] = (
             page_pool.request_resident_bytes(int(round(mean_total)))
@@ -551,12 +667,13 @@ def run_fleet_bench(args):
         ttfts, itls, rejects, tokens = [], [], {}, [0]
         lock = threading.Lock()
 
-        def one(i, ids, max_new):
+        def one(i, ids, max_new, cls):
             try:
                 events, tm = stream_generate(
                     "127.0.0.1", router.port,
                     {"input_ids": [int(t) for t in ids[0]],
-                     "max_new_tokens": int(max_new)},
+                     "max_new_tokens": int(max_new),
+                     "slo_class": cls},
                 )
             except HTTPRejected as e:
                 with lock:
@@ -593,12 +710,12 @@ def run_fleet_bench(args):
         t0 = time.monotonic()
         threads = []
         try:
-            for i, (arrival, ids, max_new) in enumerate(trace):
+            for i, (arrival, ids, max_new, cls) in enumerate(trace):
                 dt = arrival - (time.monotonic() - t0)
                 if dt > 0:
                     time.sleep(dt)
                 th = threading.Thread(target=one,
-                                      args=(i, ids, max_new),
+                                      args=(i, ids, max_new, cls),
                                       daemon=True)
                 th.start()
                 threads.append(th)
@@ -769,12 +886,13 @@ def run_http_trace(engine, trace):
     ttfts, itls, rejects = [], [], {}
     lock = threading.Lock()
 
-    def one(i, ids, max_new):
+    def one(i, ids, max_new, cls):
         try:
             events, tm = stream_generate(
                 "127.0.0.1", fe.port,
                 {"input_ids": [int(t) for t in ids[0]],
-                 "max_new_tokens": int(max_new)},
+                 "max_new_tokens": int(max_new),
+                 "slo_class": cls},
             )
         except HTTPRejected as e:
             with lock:
@@ -809,11 +927,12 @@ def run_http_trace(engine, trace):
     sampler = threading.Thread(target=sample_peak, daemon=True)
     sampler.start()
     try:
-        for i, (arrival, ids, max_new) in enumerate(trace):
+        for i, (arrival, ids, max_new, cls) in enumerate(trace):
             dt = arrival - (time.monotonic() - t0)
             if dt > 0:
                 time.sleep(dt)
-            th = threading.Thread(target=one, args=(i, ids, max_new),
+            th = threading.Thread(target=one,
+                                  args=(i, ids, max_new, cls),
                                   daemon=True)
             th.start()
             threads.append(th)
@@ -922,6 +1041,12 @@ def main(argv=None):
     ap.add_argument("--trace-top", type=int, default=8,
                     help="how many slowest-request traces --trace-out "
                          "records")
+    ap.add_argument("--mix", default=None, metavar="NAMES",
+                    help="comma list of traffic scenarios "
+                         "(chat,rag,batch,agent) replacing the uniform "
+                         "Poisson trace — each scenario has its own "
+                         "arrival shape and SLO class; the record "
+                         "gains a per-class 'slo' attainment block")
     ap.add_argument("--json", action="store_true",
                     help="print the JSON report only")
     ap.add_argument("--prom-out", default=None, metavar="PATH",
@@ -1021,6 +1146,18 @@ def main(argv=None):
                 f"accepted), tokens/s/request p50="
                 f"{tr.get('p50', 0.0):.1f}"
             )
+        for cls, entry in sorted((out.get("slo") or {}).items()):
+            parts = []
+            for metric in ("ttft", "itl", "e2e"):
+                e = entry.get(metric)
+                if e:
+                    parts.append(
+                        f"{metric} {100 * e['attainment']:.1f}% "
+                        f"(budget {e['budget_s']}s, "
+                        f"{e['breaches']} breach)"
+                    )
+            print(f"slo[{cls}] target {100 * entry['target']:.0f}%: "
+                  + "; ".join(parts))
         print(engine.metrics.render())
     return out
 

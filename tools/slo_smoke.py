@@ -1,0 +1,361 @@
+"""slo-smoke — end-to-end gate for the SLO observability plane.
+
+Starts the HTTP/SSE front-end over a paged engine with a deliberately
+TIGHT interactive TTFT budget and a fake-clock :class:`SLOMonitor`,
+then walks the full incident lifecycle:
+
+1. **Per-class labels at the wire**: a mixed-class burst (default /
+   ``rag`` / ``batch``) lands ``slo_class``-labeled series on the
+   serving TTFT histogram; ``/metrics`` round-trips the strict parser
+   WITH trace-id exemplars on the labeled buckets; an unknown class is
+   a 400 before admission.
+2. **Breach -> fast burn fires**: the engine step is throttled past
+   the interactive budget; windowed attainment collapses and the
+   ``interactive_ttft:fast`` alert must fire within THREE scrape
+   intervals of the breach traffic, visible in ``/alerts``, the
+   ``/healthz`` alerts block, the ``paddle_alerts_active`` gauge, and
+   the flight-recorder bundle (``sections.slo`` + ``slo_alert`` event).
+3. **Fleet propagation**: an in-process router scraping that replica
+   must surface the alert in its own ``/metrics``
+   (``paddle_fleet_replica_alerts_active``) and ``/alerts`` aggregate.
+4. **Recovery clears**: throttle off, healthy traffic, windows roll —
+   the alert clears everywhere (monitor, gauge -> 0, router
+   aggregate -> 0) with a ``slo_alert_cleared`` event.
+5. **Scenario-mix harness**: a ``serve_bench --mix chat,rag`` run in a
+   subprocess must emit a per-class ``slo`` attainment block.
+
+Exit 0 = gate passed. Wired as ``make slo-smoke`` next to
+``trace-smoke``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# exemplars + tracing are opt-in; the gate asserts the opted-in path
+os.environ["PADDLE_TPU_METRICS_EXEMPLARS"] = "1"
+os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "1"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+BUDGET_S = 0.25     # tight interactive TTFT budget (a bucket boundary)
+THROTTLE_S = 0.35   # per-step stall during the breach phase (> budget)
+
+
+def _get_json(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return json.loads(body)
+
+
+def _get_text(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode("utf-8")
+    conn.close()
+    return body
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import (
+        get_flight_recorder,
+        parse_prometheus_text,
+    )
+    from paddle_tpu.observability.slo import (
+        BurnRateRule,
+        SLOClass,
+        SLOMonitor,
+        SLORegistry,
+        set_slo_registry,
+    )
+    from paddle_tpu.serving import (
+        HTTPRejected,
+        PagedServingEngine,
+        ServingFrontend,
+        stream_generate,
+    )
+    from paddle_tpu.serving.fleet import FleetRouter
+
+    failures = []
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    rng = np.random.RandomState(9)
+
+    # deliberately tight interactive budget so a throttled step breaches;
+    # target 0.9 keeps the burn math round: burn = (1 - att) / 0.1
+    set_slo_registry(SLORegistry([
+        SLOClass("interactive", ttft_p99_s=BUDGET_S, itl_p99_s=5.0,
+                 e2e_p99_s=60.0, target=0.9),
+        SLOClass("rag", ttft_p99_s=2.0, itl_p99_s=5.0, e2e_p99_s=60.0,
+                 target=0.9),
+        SLOClass("batch", ttft_p99_s=30.0, itl_p99_s=5.0,
+                 e2e_p99_s=600.0, target=0.9),
+    ]))
+    rule = BurnRateRule(
+        "interactive_ttft", "interactive", metric="ttft",
+        fast_window_s=2.0, slow_window_s=8.0, fast_burn=2.0,
+        slow_burn=1.0, min_requests=2,
+    )
+    monitor = SLOMonitor(rules=[rule], interval_s=0.25)
+
+    engine = PagedServingEngine(
+        net, max_batch_size=2, max_seq_len=64, min_bucket=8,
+        page_size=8,
+    )
+    # the front-end's drive loop captures the stepper ONCE at thread
+    # start, so the throttle shim must wrap step() before start()
+    real_step = engine.step
+    throttle = {"s": 0.0}
+
+    def throttled_step():
+        if throttle["s"]:
+            time.sleep(throttle["s"])
+        return real_step()
+
+    engine.step = throttled_step
+    fe = ServingFrontend(engine, slo_monitor=monitor).start()
+    print(f"slo_smoke: front-end at {fe.url}")
+    router = None
+    try:
+        prompt = [int(t) for t in rng.randint(0, 64, (6,))]
+
+        def one(slo_class=None, max_new=3):
+            payload = {"input_ids": prompt, "max_new_tokens": max_new}
+            if slo_class is not None:
+                payload["slo_class"] = slo_class
+            events, _ = stream_generate("127.0.0.1", fe.port, payload)
+            assert events[-1][0] == "done", events[-1]
+
+        # ---- 1. mixed-class burst + wire contract ----------------------
+        one()  # warmup: compile prefill+decode before the clock starts
+        try:
+            one(slo_class="nope")
+            failures.append("unknown slo_class was not rejected")
+        except HTTPRejected as e:
+            if e.code != 400 or "unknown slo_class" not in str(e.body):
+                failures.append(
+                    f"unknown class: want 400 unknown slo_class, got "
+                    f"{e.code} {e.body!r}"
+                )
+        monitor.sample(now=0.0)
+
+        for cls in (None, None, None, None, "rag", "batch"):
+            one(slo_class=cls)
+        monitor.sample(now=1.0)
+        monitor.sample(now=2.0)
+        att = monitor.attainment("interactive", "ttft", 2.0)
+        if att is None or att < 0.9:
+            failures.append(
+                f"healthy interactive attainment {att} (want >= 0.9)"
+            )
+        if monitor.active_alerts():
+            failures.append(
+                f"alerts active on healthy traffic: "
+                f"{monitor.active_alerts()}"
+            )
+
+        text = _get_text(fe.port, "/metrics")
+        series, exemplars = parse_prometheus_text(text, exemplars=True)
+        for cls in ("interactive", "rag", "batch"):
+            if f'slo_class="{cls}"' not in text:
+                failures.append(f"/metrics missing slo_class={cls} series")
+        tid_ex = [e for e in exemplars
+                  if e["exemplar_labels"].get("trace_id")]
+        if not tid_ex:
+            failures.append("/metrics carries no trace_id exemplars")
+        else:
+            print(f"slo_smoke: mixed burst labeled 3 classes, "
+                  f"{len(tid_ex)} exemplars parse, healthy att={att}")
+
+        # ---- 2. throttle -> breach -> fast burn fires ------------------
+        throttle["s"] = THROTTLE_S
+        for _ in range(3):
+            one(max_new=2)
+        throttle["s"] = 0.0
+        before = monitor.samples_taken
+        fired_at = None
+        for tick in (3.0, 4.0, 5.0):
+            monitor.sample(now=tick)
+            if any(a["rule"] == "interactive_ttft:fast"
+                   for a in monitor.active_alerts()):
+                fired_at = monitor.samples_taken - before
+                break
+        if fired_at is None:
+            failures.append(
+                f"fast burn alert did not fire within 3 samples of the "
+                f"breach; alerts={monitor.active_alerts()}"
+            )
+        else:
+            print(f"slo_smoke: interactive_ttft:fast fired after "
+                  f"{fired_at} scrape(s)")
+
+        status = _get_json(fe.port, "/alerts")
+        active_rules = [a["rule"] for a in status.get("alerts", [])]
+        if "interactive_ttft:fast" not in active_rules:
+            failures.append(f"/alerts missing fast alert: {active_rules}")
+        hz = _get_json(fe.port, "/healthz")
+        if not (hz.get("alerts") or {}).get("count"):
+            failures.append(f"/healthz alerts block empty: {hz.get('alerts')}")
+        text = _get_text(fe.port, "/metrics")
+        gauge_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("paddle_alerts_active{")
+            and 'rule="interactive_ttft:fast"' in ln
+        ]
+        if not gauge_lines or float(gauge_lines[0].rsplit(" ", 1)[1]) != 1:
+            failures.append(
+                f"paddle_alerts_active gauge not 1: {gauge_lines}"
+            )
+
+        bundle = get_flight_recorder().bundle()
+        slo_sec = (bundle.get("sections") or {}).get("slo") or {}
+        if not slo_sec.get("active_alerts"):
+            failures.append(
+                f"flight bundle sections.slo has no active alerts: "
+                f"{slo_sec}"
+            )
+        if not slo_sec.get("window_samples"):
+            failures.append("flight bundle sections.slo has no samples")
+        kinds = {e.get("kind") for e in bundle.get("events", [])}
+        if "slo_alert" not in kinds:
+            failures.append(f"no slo_alert event in flight ring: {kinds}")
+
+        # ---- 3. router aggregates the replica's alert ------------------
+        router = FleetRouter(
+            [("127.0.0.1", fe.port)], health_interval_s=0.05,
+        ).start()
+        deadline = time.monotonic() + 10.0
+        agg = None
+        while time.monotonic() < deadline:
+            agg = _get_json(router.port, "/alerts")
+            if agg.get("active_total", 0) > 0:
+                break
+            time.sleep(0.05)
+        if not agg or agg.get("active_total", 0) < 1:
+            failures.append(f"router /alerts never aggregated: {agg}")
+        rtext = _get_text(router.port, "/metrics")
+        rlines = [
+            ln for ln in rtext.splitlines()
+            if "_replica_alerts_active{" in ln
+            and 'rule="interactive_ttft:fast"' in ln
+        ]
+        if not rlines or float(rlines[0].rsplit(" ", 1)[1]) != 1:
+            failures.append(
+                f"router replica_alerts_active gauge not 1: {rlines}"
+            )
+        else:
+            print("slo_smoke: router surfaced the alert "
+                  "(/alerts aggregate + replica_alerts_active gauge)")
+
+        # ---- 4. recovery clears everywhere -----------------------------
+        for _ in range(4):
+            one()
+        # jump the fake clock so the breach window rolls off entirely
+        monitor.sample(now=12.0)
+        monitor.sample(now=13.0)
+        if monitor.active_alerts():
+            failures.append(
+                f"alerts did not clear after recovery: "
+                f"{monitor.active_alerts()}"
+            )
+        kinds = {e.get("kind") for e in get_flight_recorder().events()}
+        if "slo_alert_cleared" not in kinds:
+            failures.append(f"no slo_alert_cleared event: {kinds}")
+        text = _get_text(fe.port, "/metrics")
+        gauge_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("paddle_alerts_active{")
+            and 'rule="interactive_ttft:fast"' in ln
+        ]
+        if not gauge_lines or float(gauge_lines[0].rsplit(" ", 1)[1]) != 0:
+            failures.append(
+                f"paddle_alerts_active gauge not back to 0: {gauge_lines}"
+            )
+        deadline = time.monotonic() + 10.0
+        agg = None
+        while time.monotonic() < deadline:
+            agg = _get_json(router.port, "/alerts")
+            if agg.get("active_total", 0) == 0:
+                break
+            time.sleep(0.05)
+        if not agg or agg.get("active_total", 0) != 0:
+            failures.append(f"router aggregate did not clear: {agg}")
+        else:
+            print("slo_smoke: recovery cleared the alert end to end")
+
+        router.stop()
+        router = None
+    except Exception as e:  # noqa: BLE001 - smoke gate reports and exits
+        failures.append(f"exception: {e!r}")
+    finally:
+        if router is not None:
+            router.stop()
+        fe.stop()
+
+    # ---- 5. scenario-mix bench emits the per-class slo block -----------
+    if not failures:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tools",
+                                          "serve_bench.py"),
+             "--mix", "chat,rag", "--requests", "10", "--rate", "50",
+             "--max-batch", "2", "--layers", "1", "--hidden", "32",
+             "--json"],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode != 0:
+            failures.append(
+                f"serve_bench --mix exited {proc.returncode}: "
+                f"{proc.stderr[-400:]}"
+            )
+        else:
+            out = json.loads(proc.stdout)
+            slo = out.get("slo") or {}
+            if out.get("mix") != "chat,rag":
+                failures.append(f"bench mix missing: {out.get('mix')}")
+            missing = {"interactive", "rag"} - set(slo)
+            if missing:
+                failures.append(
+                    f"bench slo block missing classes {missing}: "
+                    f"{sorted(slo)}"
+                )
+            elif not all("ttft" in slo[c] and "attainment" in
+                         slo[c]["ttft"] for c in ("interactive", "rag")):
+                failures.append(f"bench slo block malformed: {slo}")
+            else:
+                print(f"slo_smoke: serve_bench --mix chat,rag slo block "
+                      f"has {sorted(slo)} attainment")
+
+    if failures:
+        print("slo_smoke FAILURES:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("slo_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
