@@ -7,7 +7,7 @@ ENV = JAX_PLATFORMS=cpu
 .PHONY: lint lint-fast lint-update test tier1 metrics-smoke ckpt-smoke \
 	tune-smoke serve-smoke quant-smoke layout-smoke fleet-smoke \
 	reload-smoke train-chaos-smoke prefix-smoke trace-smoke \
-	spec-smoke memlint-smoke slo-smoke smoke-all
+	spec-smoke memlint-smoke slo-smoke session-smoke smoke-all
 
 # The pre-commit gate: graph lint (llama fwd / train step / serving
 # decode / optimizer step, incl. collective-divergence) + AST lint +
@@ -166,11 +166,22 @@ memlint-smoke:
 slo-smoke:
 	$(ENV) $(PY) tools/slo_smoke.py
 
+# Session-KV gate: a 3-turn HTTP/SSE chat under one session_id must
+# stream token-exact vs net.generate every turn with turns 2..3
+# hitting the prefix cache (decode-written answer KV reused), a
+# forced full spill mid-conversation must restore from the host tier
+# and stay exact with zero page-accounting drift, and the multi-turn
+# serve_bench must show turn-2 TTFT within 1.2x of a plain
+# warm-prefix hit with every conversation tier-resident after a full
+# spill (capacity sweep monotone in the simulated host budget).
+session-smoke:
+	$(ENV) $(PY) tools/session_smoke.py
+
 # Every smoke gate in sequence (the full pre-merge battery).
 smoke-all: lint metrics-smoke ckpt-smoke tune-smoke serve-smoke \
 		quant-smoke layout-smoke fleet-smoke reload-smoke \
 		train-chaos-smoke prefix-smoke trace-smoke spec-smoke \
-		memlint-smoke slo-smoke
+		memlint-smoke slo-smoke session-smoke
 	@echo "smoke-all: every gate green"
 
 test:

@@ -183,7 +183,12 @@ class LlamaAttention(nn.Layer):
             p = jnp.asarray(pos.value if hasattr(pos, "value") else pos)
             # scatter this step's k/v at each row's (page, offset);
             # free rows land on the reserved garbage page 0 (an int8
-            # arena quantizes-on-scatter — quantization/kv.py)
+            # arena quantizes-on-scatter — quantization/kv.py). The
+            # scattered bytes must be BITWISE what prefilling this
+            # position would write: the serving prefix cache publishes
+            # decode-written pages as reusable prefix KV (a bf16 arena
+            # re-rounds per position; int8 pins via the quantizer's
+            # bf16-grid scales — tests/test_prefix_cache.py)
             pp = jnp.take_along_axis(tbl, (p // ps)[:, None],
                                      axis=1)[:, 0]
             po = p % ps

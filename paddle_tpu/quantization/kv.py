@@ -99,10 +99,20 @@ def alloc_quantized(shape):
 
 def quantize_kv(x):
     """``[..., D]`` float -> (int8 values ``[..., D]``, fp32 scales
-    ``[...]``). Symmetric per-vector absmax: scale = max|x| / 127."""
+    ``[...]``). Symmetric per-vector absmax: scale = max|x| / 127,
+    rounded through bf16 before use. The rounding is what makes int8
+    KV provenance-independent at the byte level: different compiled
+    programs computing the same position (full prefill, chunked tail,
+    S=1 decode step) may reduce ``max|x|`` in different tree shapes
+    and disagree by one float32 ulp — a bf16-grid scale absorbs that,
+    so a decode-written page is bitwise what re-prefilling those
+    tokens writes (the serving prefix cache's decode-publish pin).
+    Cost: <=2^-9 relative scale error, well under int8's own 1/127
+    step."""
     xf = x.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.maximum(absmax, _EPS) / QMAX
+    scale = (jnp.maximum(absmax, _EPS) / QMAX) \
+        .astype(jnp.bfloat16).astype(jnp.float32)
     q = jnp.clip(
         jnp.round(xf / scale[..., None]), -QMAX, QMAX
     ).astype(jnp.int8)  # tpu-lint: quant

@@ -30,6 +30,18 @@ from decode ACROSS processes — finished KV pages ship over a
 CRC-checked socket and adopt bit-identically to local prefill, with
 clean local fallback.
 
+The session KV runtime (``sessions.SessionStore`` +
+``kv_tiering.TieredPageStore``) serves conversations, not requests: a
+``session_id`` on submit threads chat turns into one identity with
+TTL/LRU retirement, finished requests publish their decode-written
+pages into the prefix cache (bitwise-equal to what re-prefilling
+those tokens would write — the quantizer's bf16-grid scales pin this
+for int8 too), and refcount-0 prefix pages spill to host RAM/disk as
+CRC-checked PKV2 frames instead of being dropped, restoring
+bit-identically on the next hit. Warm turn-N+1 prefill therefore
+reuses turn N's full KV including the generated answer, and resident
+conversational state scales with host memory at fixed HBM.
+
 Speculative decoding (``speculative.SpeculativeDecoder``) pairs a
 small draft (or the target's own early-exit layers) with either
 engine: the draft proposes K tokens, ONE batched target launch
@@ -65,6 +77,13 @@ from .kv_pool import (  # noqa: F401
     PoolExhausted,
     bucket_for,
 )
+from .kv_tiering import (  # noqa: F401
+    TIER_DISK,
+    TIER_HOST,
+    TieredPageStore,
+    pack_page,
+    unpack_page,
+)
 from .metrics import Counter, Histogram, ServingMetrics  # noqa: F401
 from .paged_engine import PagedServingEngine  # noqa: F401
 from .paged_pool import PagedKVPool, PagesExhausted  # noqa: F401
@@ -83,4 +102,5 @@ from .scheduler import (  # noqa: F401
     RequestHandle,
     Scheduler,
 )
+from .sessions import Session, SessionStore  # noqa: F401
 from .speculative import SpeculativeDecoder  # noqa: F401

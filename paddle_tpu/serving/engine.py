@@ -168,7 +168,7 @@ class ServingEngine:
                  scheduler=None, metrics=None, pool=None,
                  clock=time.monotonic, recompile_guard_max=None,
                  weights_version=None, reload_template=None,
-                 speculative=None):
+                 speculative=None, sessions=None):
         cfg = net.config
         self.net = net
         self.config = cfg
@@ -214,6 +214,15 @@ class ServingEngine:
             max_queue_size=max_queue_size, clock=clock
         )
         self.metrics = metrics or ServingMetrics()
+        # conversation bookkeeping (serving.sessions.SessionStore):
+        # True builds a default store; a caller-built store passes
+        # through; None serves request-at-a-time exactly as before
+        if sessions is True:
+            from .sessions import SessionStore
+
+            sessions = SessionStore(clock=clock)
+        # explicit None/False check: an EMPTY store is len()-falsy
+        self.sessions = None if sessions in (None, False) else sessions
         # weight snapshot: serving uses these, not live layer attrs
         self._params = {k: p.value for k, p in net.named_parameters()}
         self._buffers = {k: b.value for k, b in net.named_buffers()}
@@ -446,7 +455,7 @@ class ServingEngine:
 
     def submit(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
                priority=0, deadline_s=None, slo_class=None,
-               on_token=None, on_event=None):
+               session_id=None, on_token=None, on_event=None):
         """Enqueue one request; always returns a RequestHandle (status
         REJECTED with ``.reason`` set on backpressure — submit never
         blocks and never throws for load reasons).
@@ -454,16 +463,23 @@ class ServingEngine:
         ``slo_class`` names the request's SLO traffic class
         (``interactive`` when None; see ``observability.slo``) — it
         labels the TTFT/ITL/E2E histograms this request lands in.
-        ``on_token(tok, handle)`` streams each emitted token as the
-        engine produces it; ``on_event(handle)`` fires exactly once at
-        the terminal transition (including submit-time rejects — a
-        stream consumer always gets an ending)."""
+        ``session_id`` marks the request as one turn of a conversation
+        (``serving.sessions``): the session store is touched here and
+        records the finished turn's full token chain — never affecting
+        the token stream itself. ``on_token(tok, handle)`` streams each
+        emitted token as the engine produces it; ``on_event(handle)``
+        fires exactly once at the terminal transition (including
+        submit-time rejects — a stream consumer always gets an
+        ending)."""
         req = Request(
             input_ids, max_new_tokens, eos_token_id=eos_token_id,
             priority=priority, deadline_s=deadline_s,
-            slo_class=slo_class,
+            slo_class=slo_class, session_id=session_id,
         )
         self.metrics.submitted.inc()
+        if session_id is not None and self.sessions is not None \
+                and not self._closed:
+            self.sessions.touch(session_id)
         if self._closed:
             h = RequestHandle(req, on_token=on_token, on_event=on_event)
             h.submit_time = h.finish_time = self.clock()
@@ -527,6 +543,12 @@ class ServingEngine:
             self._traced_live -= 1
             sp.finish(status=status, tokens=len(h.tokens),
                       **({"error": reason} if reason else {}))
+        sid = h.request.session_id
+        if sid is not None and self.sessions is not None \
+                and not self._closed:
+            # the finished turn's FULL conversation ids (prompt +
+            # answer) — the exact chain turn N+1's prompt extends
+            self.sessions.note_turn(sid, h.output_ids)
         self._seqs[slot] = None
         self._release_slot(slot)
         h._fire_terminal()
@@ -1160,6 +1182,8 @@ class ServingEngine:
             h._fire_terminal()
         self._flat = None
         self._decode_fn = None
+        if self.sessions is not None:
+            self.sessions.close()
         # the guard's watch entry holds the jitted callable too — drop
         # it, or close() would keep the compiled program resident
         self.trace_guard.unwatch("serving::decode_step")

@@ -441,8 +441,14 @@ class FleetRouter:
         return out
 
     def _affinity_key(self, parsed):
-        """Prompt-prefix head used for cache-affinity placement (None
-        when the body carries no usable input_ids)."""
+        """Cache-affinity placement key: the session id when the body
+        carries one (every turn of a chat lands on the replica holding
+        its decode-published KV chain), else the prompt-prefix head
+        (None when the body carries no usable input_ids)."""
+        if isinstance(parsed, dict):
+            sid = parsed.get("session_id")
+            if isinstance(sid, str) and sid:
+                return ("session", sid)
         ids = parsed.get("input_ids") if isinstance(parsed, dict) else None
         if not isinstance(ids, list) or not ids:
             return None

@@ -336,11 +336,22 @@ class ServingFrontend:
                 # warm-capacity signal for the fleet router: hit stats
                 # drive the cache-affinity bonus in its load score
                 out["prefix_cache"] = prefix.stats()
+            tier = getattr(eng, "kv_tier", None)
+            if tier is not None:
+                # hierarchical KV tiering: spilled-page residency per
+                # tier (host/disk) plus refusal counters — the capacity
+                # story behind "resident sessions grow with host RAM"
+                out["kv_tier"] = tier.stats()
         else:
             slab = getattr(eng, "_slab", None)
             if slab is not None:
                 # slab rows are the closest capacity analogue
                 out["free_pages"] = slab.free_slots
+        sessions = getattr(eng, "sessions", None)
+        if sessions is not None:
+            # conversation bookkeeping: active-session count and
+            # retirement breakdown (ttl vs lru)
+            out["sessions"] = sessions.stats()
         spec = getattr(eng, "speculative", None)
         if spec is not None:
             # speculative decoding: acceptance stats plus the verify-
@@ -433,6 +444,16 @@ class ServingFrontend:
                     raise ValueError("slo_class must be a string")
                 slo_class = get_slo_registry().validate(raw)
                 kwargs["slo_class"] = slo_class
+            # conversation identity: forwarded only when present so a
+            # session-less engine (user-supplied stub without the
+            # kwarg) still takes plain traffic unchanged
+            if body.get("session_id") is not None:
+                sid = body["session_id"]
+                if not isinstance(sid, str) or not sid:
+                    raise ValueError(
+                        "session_id must be a non-empty string"
+                    )
+                kwargs["session_id"] = sid
         except Exception as e:
             self._send_json(h, 400, {"error": f"bad request: {e}"})
             return

@@ -108,7 +108,8 @@ class PagedServingEngine(ServingEngine):
                  clock=time.monotonic, recompile_guard_max=None,
                  weights_version=None, prefill_transport=None,
                  reload_template=None, prefix_cache=None,
-                 demand_paging=None, speculative=None):
+                 demand_paging=None, speculative=None,
+                 kv_tiering=None, sessions=None):
         ps = int(page_size)
         if ps < 1 or (ps & (ps - 1)):
             raise ValueError(
@@ -129,6 +130,17 @@ class PagedServingEngine(ServingEngine):
         self._num_pages_arg = num_pages
         self._page_pool_arg = page_pool
         self._prefix_cache_arg = prefix_cache
+        # hierarchical KV tiering (kv_tiering.TieredPageStore): True
+        # builds a default host-RAM tier, a dict passes ctor kwargs
+        # through, a built store attaches as-is. Requires a prefix
+        # cache — the tier spills/restores ITS pages.
+        self._kv_tiering_arg = kv_tiering
+        if kv_tiering not in (None, False) \
+                and prefix_cache in (None, False):
+            raise ValueError(
+                "kv_tiering requires prefix_cache: the tier spills "
+                "and restores prefix-cache pages"
+            )
         self._demand_paging = (
             bool(demand_paging) if demand_paging is not None
             else prefix_cache not in (None, False)
@@ -159,16 +171,19 @@ class PagedServingEngine(ServingEngine):
             recompile_guard_max=recompile_guard_max,
             weights_version=weights_version,
             reload_template=reload_template,
-            speculative=speculative,
+            speculative=speculative, sessions=sessions,
         )
         if self.prefix_cache is not None and recompile_guard_max is None:
             # prefix mode legitimately compiles one gather program per
             # bucket and one chunk program per (bucket, tail-bucket)
             # pair — widen the storm bar to the real steady-state
-            # inventory instead of firing on warm-path compiles
+            # inventory instead of firing on warm-path compiles. A
+            # spill tier adds ONE more: the page-size restore adopt.
             nb = len(self._warmup_buckets())
             self.trace_guard.max_compiles = max(
-                self.trace_guard.max_compiles, nb * (nb + 3) // 2 + 2
+                self.trace_guard.max_compiles,
+                nb * (nb + 3) // 2 + 2
+                + (1 if self.kv_tier is not None else 0),
             )
 
     # ------------------------------------------------------- KV backend
@@ -211,6 +226,25 @@ class PagedServingEngine(ServingEngine):
                 "engine's — pass the same pool to both"
             )
         self.prefix_cache = pc
+        tier = getattr(self, "_kv_tiering_arg", None)
+        if tier is True:
+            from .kv_tiering import TieredPageStore
+
+            tier = TieredPageStore()
+        elif isinstance(tier, dict):
+            from .kv_tiering import TieredPageStore
+
+            tier = TieredPageStore(**tier)
+        elif tier in (None, False):
+            tier = None
+        self.kv_tier = tier
+        if tier is not None:
+            pc.attach_tier(
+                tier,
+                read_page=self._tier_read_page,
+                restore_page=self._tier_restore_page,
+                current_version=lambda: self.weights_version,
+            )
         self.table_width = pp.table_width()
         self._flat = _flatten(pp.alloc_arena_arrays())
         self._tables = np.zeros(
@@ -253,6 +287,41 @@ class PagedServingEngine(ServingEngine):
         self._row_meta[slot] = None
         self._tables[slot, :] = 0  # free row reads/writes garbage page
         self._free_rows.append(slot)
+
+    def _finish(self, slot, status, reason=None):
+        """Decode-publish, then the base terminal transition. While
+        the row's sequence and pages are still live, every page the
+        finished request WROTE — prompt AND generated answer — is
+        published into the prefix chain: the decode step and the
+        prefill program share one masked-SDPA op order (pinned
+        bitwise-equal in tier-1, bf16 and int8), so decode-written KV
+        for position ``p`` is byte-for-byte what re-prefilling
+        ``tokens[0..p]`` would write. Valid span: the LAST emitted
+        token's KV is never written (nothing consumed it), so
+        ``prompt_len + emitted - 1`` positions publish — turn N+1 of
+        a chat warm-admits turn N's full context including the
+        answer."""
+        seq = self._seqs[slot]
+        if (seq is not None and self.prefix_cache is not None
+                and not self._closed):
+            pages = self._row_pages[slot]
+            meta = self._row_meta[slot]
+            if pages and meta is not None:
+                h = seq.handle
+                prompt, prompt_len = meta
+                toks = prompt + tuple(int(t) for t in h.tokens)
+                valid = prompt_len + max(0, len(h.tokens) - 1)
+                if valid > prompt_len:
+                    self.prefix_cache.publish(
+                        toks, valid, pages, self.weights_version
+                    )
+                    ps = self.page_size
+                    k, r = valid // ps, valid % ps
+                    if r and k < len(pages):
+                        self.prefix_cache.publish_partial(
+                            toks, valid, pages[k], self.weights_version
+                        )
+        super()._finish(slot, status, reason=reason)
 
     @property
     def free_rows(self):
@@ -492,6 +561,75 @@ class PagedServingEngine(ServingEngine):
             need = n - self.page_pool.free_pages
             self.prefix_cache.evict(need)
             return self.page_pool.claim(n)
+
+    # ------------------------------------------------------- KV tiering
+    def _tier_read_page(self, page_id):
+        """One arena page's bytes on the host, flattened one array per
+        raw buffer (a QuantizedKV leaf contributes q then scale) — the
+        spill side of the tier attachment. Read-only: shared pages are
+        never touched, only copied out."""
+        from ..quantization.kv import is_quantized
+
+        out = []
+        for leaf in self._flat:
+            if is_quantized(leaf):
+                out.append(np.asarray(leaf.q[page_id]))
+                out.append(np.asarray(leaf.scale[page_id]))
+            else:
+                out.append(np.asarray(leaf[page_id]))
+        return out
+
+    def _page_block(self, arrays=None):
+        """A [1, page_size]-wide flat block matching ``self._flat``'s
+        leaf structure — from spilled host ``arrays`` (restore), or
+        zeros (warmup example args). One shape for both, so the
+        restore program warms with the exact block it later runs."""
+        from ..quantization.kv import QuantizedKV, is_quantized
+
+        ps = self.page_size
+        block, i = [], 0
+        for leaf in self._flat:
+            if is_quantized(leaf):
+                if arrays is None:
+                    kvh, d = leaf.q.shape[2], leaf.q.shape[3]
+                    q = jnp.zeros((1, ps, kvh, d), leaf.q.dtype)
+                    s = jnp.zeros((1, ps, kvh), leaf.scale.dtype)
+                else:
+                    q = jnp.asarray(arrays[i])[None]
+                    s = jnp.asarray(arrays[i + 1])[None]
+                block.append(QuantizedKV(q, s))
+                i += 2
+            else:
+                if arrays is None:
+                    a = jnp.zeros((1, ps) + tuple(leaf.shape[2:]),
+                                  leaf.dtype)
+                else:
+                    a = jnp.asarray(arrays[i])[None]
+                block.append(a)
+                i += 1
+        return block
+
+    def _tier_restore_page(self, arrays):
+        """The restore side: claim one fresh arena page, adopt the
+        spilled bytes into it through the page-size adopt program
+        (same scatter the prefill path uses — restored bytes land
+        bit-identical), return its id. None when the arena has no
+        page to spare RIGHT NOW — the record stays spilled and the
+        request cold-prefills; claiming directly from the pool (not
+        ``_claim_pages``) keeps a restore from recursing into
+        eviction, which could spill the very chain being walked."""
+        try:
+            page = self.page_pool.claim(1)
+        except PagesExhausted:
+            return None
+        ps = self.page_size
+        with profiler.RecordEvent(f"serving::restore_adopt_b{ps}"):
+            self._flat = self._run(
+                ("adopt", ps), self._adopt_fn(ps),
+                self._flat, self._page_block(arrays),
+                jnp.asarray(page, jnp.int32),
+            )
+        return page[0]
 
     # ------------------------------------------- speculative backend seams
     def _verify_widths(self, buckets):
@@ -873,6 +1011,21 @@ class PagedServingEngine(ServingEngine):
                         )
                 finally:
                     self.pool.free(blk)
+            if self.kv_tier is not None:
+                # the tier's restore program: a single-page adopt at
+                # bucket == page_size (already warmed when page_size
+                # equals the smallest prompt bucket — _warm_one
+                # dedups on the trace key)
+                ps = self.page_size
+                self._warm_one(
+                    cache, f"adopt_b{ps}", ("adopt", ps),
+                    self._adopt_fn(ps),
+                    (self._flat, self._page_block(),
+                     jnp.zeros((1,), jnp.int32)),
+                    lambda comp: self._adopt_fns
+                    .__setitem__(ps, comp), stats,
+                    donate=(0,) if self._donate else (),
+                )
         finally:
             # lowering traced the bodies — restore concrete weights
             self._restore_net_state()

@@ -23,19 +23,34 @@ prefix cache layers content-addressed sharing on the
   through the gather -> chunk-prefill -> adopt pipeline into a fresh
   page the request owns; the shared original is never written
   (``cow_clones`` counts these).
-- **Eviction is leaf-first LRU.** Only pages whose sole reference is
-  the cache's own (refcount 1) are evictable, and only entries whose
-  cached descendants are themselves reclaimable — evicting a middle
-  page would orphan its (still resident) children. Triggered by the
-  engine under arena pressure; every reclaimed page counts.
+- **Eviction is leaf-first LRU — and with a tier attached, eviction
+  becomes SPILL.** Only pages whose sole reference is the cache's own
+  (refcount 1) are evictable, and only entries whose cached
+  descendants are themselves reclaimable — evicting a middle page
+  would orphan its (still resident) children. Triggered by the engine
+  under arena pressure; every reclaimed page counts. When a
+  :class:`~.kv_tiering.TieredPageStore` is attached
+  (:meth:`PrefixCache.attach_tier`), the victim's arena bytes are
+  read out and stored as a CRC-checked host/disk payload before the
+  HBM page is freed; :meth:`match` then consults the tier wherever
+  its resident chain walk breaks and RESTORES the page through the
+  engine's adopt program — a cold conversation costs a host->HBM copy
+  instead of a full re-prefill, and a tier refusal (budget, CRC,
+  stale weights) just degrades to the cold path.
 
-Exactness is the contract, not a trade: cached KV for position ``p`` is
-a pure function of ``tokens[0..p]`` under fixed weights, and only
-prefill-provenance content is ever published (full prompt pages at
-admission; the partial prompt-tail page at request finish with its
-prefill-written length recorded) — decode-written KV is never adopted,
-so a warm request's token stream is pinned exact-equal to the cold path
-and to ``net.generate`` (bf16 AND int8 arenas).
+Exactness is the contract, not a trade: cached KV for position ``p``
+is a pure function of ``tokens[0..p]`` under fixed weights, and every
+published page carries provenance for exactly the positions recorded
+as valid — full prompt pages at admission, the partial prompt-tail
+page at finish, and (since the session-KV PR) the DECODE-written span
+at finish too: the decode step and the prefill program share one
+masked-SDPA op order, pinned bitwise-equal in tier-1 for bf16 AND
+int8 arenas, so a generated answer's KV is byte-for-byte what
+re-prefilling those tokens would write. A warm request's token stream
+is therefore pinned exact-equal to the cold path and to
+``net.generate`` whether its prefix came from prefill, from decode,
+or back out of a spill tier (restored bytes are pinned bit-identical
+to the pre-spill arena page).
 """
 from __future__ import annotations
 
@@ -107,6 +122,12 @@ class PrefixCache:
         self._children = {}   # parent key -> set of child keys
         self._tick = itertools.count()
         self.flushes = 0
+        # spill tier (kv_tiering.TieredPageStore) + the engine-supplied
+        # closures that move page bytes across the HBM boundary
+        self._tier = None
+        self._read_page = None
+        self._restore_page = None
+        self._current_version = None
         ns = namespace
         # per-INSTANCE instruments with replace-on-register, like
         # ServingMetrics: the newest cache owns the exported series and
@@ -154,6 +175,45 @@ class PrefixCache:
         return ("prefix-root", str(weights_version),
                 str(self.pool.dtype))
 
+    # ----------------------------------------------------------- tiering
+    def attach_tier(self, tier, *, read_page, restore_page,
+                    current_version):
+        """Attach a :class:`~.kv_tiering.TieredPageStore` below this
+        cache. ``read_page(page_id)`` returns the page's host arrays
+        (spill side); ``restore_page(arrays)`` claims a fresh arena
+        page, adopts the bytes, and returns its id (or None when the
+        arena cannot spare one — the record stays spilled);
+        ``current_version()`` is the engine's live weights version,
+        stamped into every spilled payload for the stale-refusal
+        check. The engine wires these at construction."""
+        self._tier = tier
+        self._read_page = read_page
+        self._restore_page = restore_page
+        self._current_version = current_version
+
+    def _restore(self, child_key, parent, weights_version):
+        """Pull one spilled page back into the arena as a live cache
+        entry, or None (absent / refused / arena full). The tier
+        record is consumed BEFORE the entry lands so a later publish
+        of the same key never races a stale payload."""
+        tier = self._tier
+        if tier is None or self._restore_page is None:
+            return None
+        got = tier.get(child_key, weights_version=weights_version)
+        if got is None:
+            return None
+        rec, _meta, arrays = got
+        page = self._restore_page(arrays)
+        if page is None:
+            return None  # arena full right now; stays spilled
+        tier.pop(child_key, restored=True)
+        e = self._add(child_key, parent, page, rec.tokens,
+                      rec.valid_len)
+        # _add holds the cache reference; drop the restore claim
+        self.pool.release([page])
+        self.update_gauges()
+        return e
+
     # --------------------------------------------------------- matching
     def match(self, tokens, prompt_len, weights_version):
         """Walk the chain for ``tokens[:prompt_len]``. Full pages match
@@ -169,9 +229,14 @@ class PrefixCache:
         k = 0
         tick = next(self._tick)
         while (k + 1) * ps <= prompt_len:
-            child = self._entries.get(
-                (key, tuple(int(t) for t in tokens[k * ps:(k + 1) * ps]))
+            child_key = (
+                key, tuple(int(t) for t in tokens[k * ps:(k + 1) * ps])
             )
+            child = self._entries.get(child_key)
+            if child is None and self._tier is not None:
+                # the resident chain breaks here — a spilled copy of
+                # exactly this page restores and the walk continues
+                child = self._restore(child_key, key, weights_version)
             if child is None or not child.full:
                 break
             child.last_hit = tick
@@ -190,6 +255,17 @@ class PrefixCache:
                     e.last_hit = tick
                     tail = e
                     break
+            if tail is None and self._tier is not None:
+                for ck in self._tier.children(key):
+                    rec = self._tier.peek(ck)
+                    if rec is None or rec.valid_len < r \
+                            or rec.tokens[:r] != rest:
+                        continue
+                    e = self._restore(ck, key, weights_version)
+                    if e is not None:
+                        e.last_hit = tick
+                        tail = e
+                        break
         covered = k * ps + (r if tail is not None else 0)
         return PrefixMatch(entries, tail, covered)
 
@@ -200,6 +276,11 @@ class PrefixCache:
         self.pool.incref([page])
         self._entries[key] = e
         self._children.setdefault(parent, set()).add(key)
+        if self._tier is not None and self._tier.peek(key) is not None:
+            # a fresh publish supersedes any spilled copy of this key
+            # (e.g. a restore that once failed for arena room): drop
+            # it so a later match can never prefer stale tier bytes
+            self._tier.pop(key)
         return e
 
     def publish(self, tokens, prompt_len, page_ids, weights_version):
@@ -255,6 +336,14 @@ class PrefixCache:
         self._add((key, rest), key, page_id, rest, r)
         self.update_gauges()
         return True
+
+    def peek(self, key):
+        """Resident entry for one chain key, or None — a pure
+        bookkeeping lookup: no LRU touch, no tier restore. The
+        capacity sweep in ``tools/serve_bench.py --multi-turn`` walks
+        chains with this to ask "still servable?" without changing
+        what is."""
+        return self._entries.get(key)
 
     # ---------------------------------------------------------- eviction
     def _reclaimable(self, exclude=()):
@@ -344,6 +433,21 @@ class PrefixCache:
             if victim is None:
                 continue
             parent = victim.parent
+            if self._tier is not None and self._read_page is not None:
+                # spill replaces outright eviction: the victim's arena
+                # bytes land in the tier (same leaf-first LRU order)
+                # before the HBM page frees. Best-effort — a budget
+                # refusal or read failure degrades to plain eviction,
+                # never an error into the admission path.
+                try:
+                    self._tier.put(
+                        victim.key, victim.parent, victim.tokens,
+                        victim.valid_len,
+                        self._read_page(victim.page),
+                        weights_version=self._current_version(),
+                    )
+                except Exception:
+                    pass
             self._drop(victim)
             freed += 1
             self.evictions.inc()
@@ -368,6 +472,11 @@ class PrefixCache:
             self.pool.release([e.page])
         self._entries.clear()
         self._children.clear()
+        if self._tier is not None:
+            # spilled payloads die with the resident entries: after a
+            # weight swap they could never pass the stale check, and
+            # keeping them would only squat on the spill budget
+            self._tier.flush(reason=reason)
         if n:
             self.flushes += 1
         self.update_gauges()
@@ -404,4 +513,6 @@ class PrefixCache:
             "tokens_saved": int(self.tokens_saved.value),
             "hbm_saved_bytes": int(self.hbm_saved_bytes()),
             "flushes": self.flushes,
+            **({"tier": self._tier.stats()}
+               if self._tier is not None else {}),
         }

@@ -54,7 +54,8 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, input_ids, max_new_tokens, *, eos_token_id=None,
-                 priority=0, deadline_s=None, slo_class=None):
+                 priority=0, deadline_s=None, slo_class=None,
+                 session_id=None):
         import numpy as np
 
         ids = np.asarray(input_ids)
@@ -83,6 +84,11 @@ class Request:
 
             slo_class = DEFAULT_CLASS
         self.slo_class = str(slo_class)
+        # conversation identity (serving.sessions): labels this request
+        # as one turn of a chat session — session bookkeeping, router
+        # affinity, and decode-publish chain continuity key on it. None
+        # = a standalone request, served exactly as before.
+        self.session_id = None if session_id is None else str(session_id)
         self.request_id = next(Request._ids)
 
     @property

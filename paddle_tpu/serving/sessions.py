@@ -1,0 +1,177 @@
+"""Session runtime — the serving stack's unit becomes the conversation.
+
+A chat product sends the SAME engine a growing prompt every turn:
+turn N+1's prompt is turn N's prompt + turn N's answer + the new user
+message. The KV for everything before the new message already exists
+the moment turn N finishes — the prefix cache (with decode-publish,
+see ``paged_engine``) holds it. What was missing is the bookkeeping
+that makes conversations first-class:
+
+- ``SessionStore`` maps a client-chosen ``session_id`` to its token
+  chain and turn lifecycle: ``touch`` at submit (create or refresh),
+  ``note_turn`` at finish (records the full conversation ids so far —
+  the exact prefix the next turn will warm-hit on).
+- **Retirement** is TTL + LRU: a session idle past ``ttl_s`` or past
+  the ``max_sessions`` cap is dropped from the store (counted, with a
+  flight-recorder event). Retirement is bookkeeping only — the KV
+  pages themselves live and die by the prefix cache's own refcounts
+  and the tier store's budgets; a retired session that comes back
+  simply warm-hits whatever of its prefix still survives.
+- ``session_id`` rides ``POST /v1/generate`` (``http_frontend``),
+  ``engine.submit``, and the fleet router's affinity key, so fleet
+  turns land on the replica already holding the session's pages.
+
+Sessions never affect token streams: matching is by token content
+through the prefix cache, and a request without a ``session_id`` is
+served exactly as before. Clock-injectable for deterministic TTL
+tests; driver-thread-only like the engine."""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from ..observability import Gauge, get_flight_recorder
+from .metrics import Counter
+
+
+class Session:
+    """One conversation's bookkeeping: its id, the token ids of the
+    full conversation so far (prompt + answer, every finished turn),
+    and its lifecycle timestamps."""
+
+    __slots__ = ("session_id", "tokens", "turns", "created",
+                 "last_active")
+
+    def __init__(self, session_id, now):
+        self.session_id = str(session_id)
+        self.tokens = ()      # full conversation ids after last turn
+        self.turns = 0
+        self.created = now
+        self.last_active = now
+
+    def __repr__(self):
+        return (f"Session({self.session_id!r}, turns={self.turns}, "
+                f"tokens={len(self.tokens)})")
+
+
+class SessionStore:
+    """Bounded TTL+LRU map of live conversations.
+
+    ``max_sessions`` caps residency (oldest-idle retired first);
+    ``ttl_s=None`` disables idle expiry. All counters/gauges register
+    under the serving namespace with replace-on-register, like every
+    per-engine instrument."""
+
+    def __init__(self, *, max_sessions=1024, ttl_s=None,
+                 clock=time.monotonic, registry=None,
+                 namespace="paddle_serving", recorder=None):
+        self.max_sessions = int(max_sessions)
+        if self.max_sessions < 1:
+            raise ValueError("max_sessions must be >= 1")
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
+        self.clock = clock
+        self._sessions = OrderedDict()  # session_id -> Session, LRU
+        self._rec = recorder if recorder is not None \
+            else get_flight_recorder()
+        ns = namespace
+        self.active = Gauge(
+            "sessions_active", prom_name=f"{ns}_sessions_active",
+            help="conversations resident in the session store")
+        self.created = Counter(
+            "sessions_created",
+            prom_name=f"{ns}_sessions_created_total",
+            help="new sessions admitted")
+        self.retired = Counter(
+            "sessions_retired", labelname="reason",
+            prom_name=f"{ns}_sessions_retired_total",
+            help="sessions retired from the store, by reason "
+                 "(ttl | lru)")
+        self.turns = Counter(
+            "session_turns", prom_name=f"{ns}_session_turns_total",
+            help="finished turns recorded against a session")
+        if registry is None:
+            from ..observability import get_registry
+
+            registry = get_registry()
+        registry.register_all([
+            self.active, self.created, self.retired, self.turns,
+        ])
+        self.active.set(0.0)
+
+    def __len__(self):
+        return len(self._sessions)
+
+    def get(self, session_id):
+        return self._sessions.get(str(session_id))
+
+    # ---------------------------------------------------------- lifecycle
+    def touch(self, session_id):
+        """Create-or-refresh at submit time: sweeps TTL, bumps LRU,
+        retires over-cap sessions. Returns the (live) Session."""
+        now = self.clock()
+        self.sweep(now)
+        sid = str(session_id)
+        s = self._sessions.get(sid)
+        if s is None:
+            s = Session(sid, now)
+            self._sessions[sid] = s
+            self.created.inc()
+            self._rec.note("session_open", session_id=sid)
+            while len(self._sessions) > self.max_sessions:
+                old_sid, old = self._sessions.popitem(last=False)
+                self._retire(old, "lru")
+        else:
+            self._sessions.move_to_end(sid)
+        s.last_active = now
+        self.active.set(float(len(self._sessions)))
+        return s
+
+    def note_turn(self, session_id, output_ids):
+        """Record one finished turn: ``output_ids`` is the FULL
+        conversation so far (prompt + generated answer) — exactly the
+        token chain the prefix cache published, and the prefix turn
+        N+1 extends."""
+        s = self._sessions.get(str(session_id))
+        if s is None:
+            return None
+        s.tokens = tuple(int(t) for t in output_ids)
+        s.turns += 1
+        s.last_active = self.clock()
+        self._sessions.move_to_end(s.session_id)
+        self.turns.inc()
+        return s
+
+    def _retire(self, session, reason):
+        self.retired.inc(label=reason)
+        self._rec.note("session_retired",
+                       session_id=session.session_id, reason=reason,
+                       turns=session.turns)
+
+    def sweep(self, now=None):
+        """Retire every session idle past the TTL; returns how many."""
+        if self.ttl_s is None:
+            return 0
+        if now is None:
+            now = self.clock()
+        dead = [sid for sid, s in self._sessions.items()
+                if now - s.last_active > self.ttl_s]
+        for sid in dead:
+            self._retire(self._sessions.pop(sid), "ttl")
+        if dead:
+            self.active.set(float(len(self._sessions)))
+        return len(dead)
+
+    def close(self):
+        self._sessions.clear()
+        self.active.set(0.0)
+
+    # -------------------------------------------------------- accounting
+    def stats(self):
+        return {
+            "active": len(self._sessions),
+            "max_sessions": self.max_sessions,
+            "ttl_s": self.ttl_s,
+            "created": int(self.created.value),
+            "retired": self.retired.by_label(),
+            "turns": int(self.turns.value),
+        }

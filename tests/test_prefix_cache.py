@@ -59,6 +59,50 @@ def _assert_drained(eng):
     assert eng.page_pool._refs == {}
 
 
+@pytest.fixture(scope="module")
+def engines(net):
+    """Shared engines, built once per module — the wall-time diet.
+
+    Engine construction dominates this file's runtime (each engine
+    compiles its own prefill/decode/gather/chunk programs), so tests
+    that only need a standard-geometry engine share ONE instance per
+    tag instead of building their own. The rules that keep sharing
+    sound: every test uses FRESH random prompts (no cross-test cache
+    hits), asserts counter DELTAS (never absolutes), and leaves its
+    requests finished. Teardown closes every shared engine and runs
+    the zero-leak/zero-drift check over the ACCUMULATED churn of all
+    of them — a strictly stronger drain pin than per-test checks.
+    Tests that need special geometry (num_pages pressure, custom
+    clocks) or mid-test close still build private engines."""
+    made = {}
+
+    def get(tag, **kw):
+        if tag not in made:
+            made[tag] = PagedServingEngine(net, **kw)
+        return made[tag]
+
+    yield get
+    for eng in made.values():
+        eng.close()
+        _assert_drained(eng)
+
+
+def _warm_engine(engines, dtype="bfloat16"):
+    """The shared standard warm engine: prefix cache + spill tier."""
+    return engines(
+        f"warm-{dtype}", max_batch_size=4, max_seq_len=64,
+        min_bucket=8, page_size=8, cache_dtype=dtype,
+        prefix_cache=True, kv_tiering=True,
+    )
+
+
+def _cold_engine(engines, dtype="bfloat16"):
+    return engines(
+        f"cold-{dtype}", max_batch_size=4, max_seq_len=64,
+        min_bucket=8, page_size=8, cache_dtype=dtype,
+    )
+
+
 # ------------------------------------------------------------ pool refcounts
 def test_pool_refcount_share_and_release(net):
     pool = PagedKVPool(net.config, page_size=8, num_pages=6,
@@ -211,29 +255,24 @@ def test_chunked_prefill_bitwise_equals_full(net):
         net.eval()
 
 
-def test_chunk_plan_never_overflows_the_bucket(net):
+def test_chunk_plan_never_overflows_the_bucket(net, engines):
     """The plan invariant that keeps chunked prefill exact: the chunk
     writes [c, c + tail_bucket) into a [bucket] block, and a start past
     ``bucket - tail_bucket`` would make dynamic_update_slice CLAMP the
     write into cached positions. Every emitted plan obeys it, the
     recompute start never reaches the full prompt, and maximum
     coverage is reused within the constraint."""
-    eng = PagedServingEngine(net, max_batch_size=1, max_seq_len=64,
-                             min_bucket=8, page_size=8,
-                             prefix_cache=True)
-    try:
-        for prompt_len in range(2, 57):
-            bucket = eng.pool.bucket_for(prompt_len)
-            for covered in range(1, prompt_len + 1):
-                plan = eng._chunk_plan(prompt_len, bucket, covered)
-                if plan is None:
-                    continue
-                c, tb = plan
-                assert 0 < c <= prompt_len - 1
-                assert c + tb <= bucket, (prompt_len, covered, plan)
-                assert prompt_len - c <= tb
-    finally:
-        eng.close()
+    eng = _warm_engine(engines)
+    for prompt_len in range(2, 57):
+        bucket = eng.pool.bucket_for(prompt_len)
+        for covered in range(1, prompt_len + 1):
+            plan = eng._chunk_plan(prompt_len, bucket, covered)
+            if plan is None:
+                continue
+            c, tb = plan
+            assert 0 < c <= prompt_len - 1
+            assert c + tb <= bucket, (prompt_len, covered, plan)
+            assert prompt_len - c <= tb
 
 
 # ------------------------------------------------------- warm-path exactness
@@ -244,7 +283,7 @@ def test_chunk_plan_never_overflows_the_bucket(net):
     pytest.param("bfloat16", marks=pytest.mark.slow),
     "int8",
 ])
-def test_warm_streams_exact_vs_cold_and_generate(net, dtype):
+def test_warm_streams_exact_vs_cold_and_generate(net, engines, dtype):
     """The tentpole pin: warm-prefix streams (full hits, partial-tail
     COW hits, divergence exactly at a page boundary, identical full
     reuse) are bitwise-equal to a cold no-cache engine AND to
@@ -256,12 +295,8 @@ def test_warm_streams_exact_vs_cold_and_generate(net, dtype):
         prefix[:16][None, :],   # page-aligned prompt: boundary COW
         np.concatenate([prefix, RNG.randint(0, 64, (4,))])[None, :],
     ]
-    warm = PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
-                              min_bucket=8, page_size=8,
-                              cache_dtype=dtype, prefix_cache=True)
-    cold = PagedServingEngine(net, max_batch_size=4, max_seq_len=64,
-                              min_bucket=8, page_size=8,
-                              cache_dtype=dtype)
+    warm = _warm_engine(engines, dtype)
+    cold = _cold_engine(engines, dtype)
     hits0 = int(warm.prefix_cache.hits.value)
     cow0 = int(warm.prefix_cache.cow_clones.value)
     # seed: first submission publishes; drain so finish publishes the
@@ -286,32 +321,135 @@ def test_warm_streams_exact_vs_cold_and_generate(net, dtype):
     # equals the prompt, so the plan recomputes from a page boundary)
     assert int(warm.prefix_cache.cow_clones.value) - cow0 >= 1
     assert st["cached_pages"] > 0
-    warm.close()
-    cold.close()
-    _assert_drained(warm)
-    _assert_drained(cold)
 
 
-def test_warm_hit_skips_prefill_compute(net):
+def test_warm_hit_skips_prefill_compute(net, engines):
     """The hit actually saves work: a warm admission runs the CHUNK
     program, not the full prefill (chunk_prefills counted; tokens_saved
     advances by the cached span)."""
     prefix = RNG.randint(0, 64, (16,))
     p1 = np.concatenate([prefix, RNG.randint(0, 64, (5,))])[None, :]
     p2 = np.concatenate([prefix, RNG.randint(0, 64, (5,))])[None, :]
-    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
-                             min_bucket=8, page_size=8,
-                             prefix_cache=True)
+    eng = _warm_engine(engines)
     saved0 = int(eng.prefix_cache.tokens_saved.value)
+    c0, l0 = eng.chunk_prefills, eng.local_prefills
     eng.submit(p1, 4)
     eng.run_until_idle()
-    assert eng.chunk_prefills == 0 and eng.local_prefills == 1
+    assert eng.chunk_prefills == c0 and eng.local_prefills == l0 + 1
     eng.submit(p2, 4)
     eng.run_until_idle()
-    assert eng.chunk_prefills == 1 and eng.local_prefills == 1
+    assert eng.chunk_prefills == c0 + 1 and eng.local_prefills == l0 + 1
     assert int(eng.prefix_cache.tokens_saved.value) - saved0 == 16
-    eng.close()
-    _assert_drained(eng)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_decode_published_kv_bitwise_equals_prefill(net, engines, dtype):
+    """The session-KV keystone: the pages a FINISHED request publishes
+    — prompt pages from prefill AND the span its decode steps wrote —
+    hold byte-for-byte the KV that ONE pure prefill of the same tokens
+    computes. That provenance-independence is what lets chat turn N+1
+    warm-admit over turn N's generated answer with zero recompute. A
+    bf16 arena re-rounds every position onto the bf16 grid; int8 pins
+    through the quantizer's bf16-grid scales (quantization/kv.py) —
+    without that rounding, different compiled program shapes disagree
+    on max|x| by one float32 ulp and the scales diverge."""
+    import jax
+
+    from paddle_tpu.models.generation import alloc_kv_caches, prefill
+
+    eng = _warm_engine(engines, dtype)
+    ps = 8
+    prompt = RNG.randint(0, 64, (16,))
+    h = eng.submit(prompt[None, :], 8)
+    eng.run_until_idle()
+    assert h.status == "DONE" and len(h.tokens) == 8
+    full = [int(t) for t in prompt] + [int(t) for t in h.tokens]
+    valid = 16 + len(h.tokens) - 1   # the last token's KV never lands
+    m = eng.prefix_cache.match(full, valid, eng.weights_version)
+    assert m.covered == valid        # decode-publish covered everything
+    # reference: one functional prefill over full[:valid] — exactly the
+    # provenance the cache records for every published position
+    params = {k: p.value for k, p in net.named_parameters()}
+    buffers = {k: b.value for k, b in net.named_buffers()}
+
+    def body(pp, bb, ids, n, caches):
+        net.load_functional_state(pp, bb)
+        net.eval()
+        return prefill(net, ids, caches, length=n)
+
+    bucket = eng.pool.bucket_for(valid)
+    ids = np.zeros((1, bucket), np.int32)
+    ids[0, :valid] = full[:valid]
+    try:
+        caches = alloc_kv_caches(net.config, 1, bucket, dtype)
+        _, cf = jax.jit(body)(params, buffers, jnp.asarray(ids),
+                              jnp.int32(valid), caches)
+    finally:
+        net.load_functional_state(params, buffers)
+        net.eval()
+    ref_leaves = []
+    for k_, v_ in cf:
+        for leaf in (k_, v_):
+            if dtype == "int8":
+                ref_leaves.extend([np.asarray(leaf.q[0]),
+                                   np.asarray(leaf.scale[0])])
+            else:
+                ref_leaves.append(np.asarray(leaf[0]))
+    for i, page in enumerate(m.pages):
+        rows = ps if (i + 1) * ps <= valid else valid - i * ps
+        got = eng._tier_read_page(page)
+        assert len(got) == len(ref_leaves)
+        for g, r in zip(got, ref_leaves):
+            a = np.asarray(g)[:rows]
+            b = r[i * ps:i * ps + rows]
+            assert a.tobytes() == b.tobytes(), (dtype, i)
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_spill_restore_round_trip_bit_identical(net, engines, dtype):
+    """Tiering's exactness pin: a cached page spilled to the host tier
+    and restored on the next match lands back in the arena
+    byte-for-byte identical, and the restored chain serves the same
+    coverage the resident chain did."""
+    eng = _warm_engine(engines, dtype)
+    ps = 8
+    prompt = RNG.randint(0, 64, (16,))
+    h = eng.submit(prompt[None, :], 4)
+    eng.run_until_idle()
+    assert h.status == "DONE"
+    full = [int(t) for t in prompt] + [int(t) for t in h.tokens]
+    valid = 16 + len(h.tokens) - 1   # 19: 2 full pages + 3-row tail
+    wv = eng.weights_version
+
+    def snap(m):
+        out = []
+        for i, page in enumerate(m.pages):
+            rows = ps if (i + 1) * ps <= valid else valid - i * ps
+            out.append(tuple(np.asarray(a)[:rows].tobytes()
+                             for a in eng._tier_read_page(page)))
+        return out
+
+    m0 = eng.prefix_cache.match(full, valid, wv)
+    assert m0.covered == valid
+    before = snap(m0)
+    tier = eng.kv_tier
+    st0 = tier.stats()
+    # spill EVERYTHING evictable (the shared engine's other residents
+    # ride along); the chain's 3 pages must be among the spilled
+    freed = eng.prefix_cache.evict(10_000)
+    assert freed >= 3
+    assert eng.prefix_cache.cached_pages == 0
+    st1 = tier.stats()
+    assert (sum(st1["spills"].values())
+            - sum(st0["spills"].values())) == freed
+    # the next match restores the chain — same coverage, same bytes
+    m1 = eng.prefix_cache.match(full, valid, wv)
+    assert m1.covered == valid
+    assert snap(m1) == before
+    st2 = tier.stats()
+    assert (sum(st2["restores"].values())
+            - sum(st1["restores"].values())) == 3
+    assert st2["crc_refused"] == st1["crc_refused"]
 
 
 # -------------------------------------------------- demand growth + shedding
@@ -405,7 +543,10 @@ def test_warm_head_waits_when_only_its_own_pages_are_evictable(net):
     eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
                              min_bucket=8, page_size=8, num_pages=4,
                              prefix_cache=True)
-    ha = eng.submit(prefix[None, :], 2)   # publishes 2 full pages
+    # max_new=1: the lone emitted token's KV is never written back, so
+    # finish publishes exactly the 2 full prompt pages (a longer decode
+    # would decode-publish its tail page too and change the geometry)
+    ha = eng.submit(prefix[None, :], 1)
     eng.run_until_idle()
     assert ha.status == "DONE"
     assert eng.prefix_cache.cached_pages == 2
@@ -506,36 +647,35 @@ def test_reload_flushes_prefix_cache_exact_after_swap(net, tmp_path):
 
 
 # ------------------------------------------------------------- observability
-def test_healthz_and_prom_series_carry_prefix_stats(net):
+def test_healthz_and_prom_series_carry_prefix_stats(net, engines):
     prefix = RNG.randint(0, 64, (16,))
-    eng = PagedServingEngine(net, max_batch_size=2, max_seq_len=64,
-                             min_bucket=8, page_size=8,
-                             prefix_cache=True)
+    eng = _warm_engine(engines)
     fe = ServingFrontend(eng)
-    try:
-        for _ in range(2):
-            eng.submit(np.concatenate(
-                [prefix, RNG.randint(0, 64, (3,))])[None, :], 3)
-            eng.run_until_idle()
-        h = fe.health()
-        pc = h.get("prefix_cache")
-        assert pc is not None and pc["hits"] >= 1
-        assert "hbm_saved_bytes" in pc and "evictions" in pc
-        from paddle_tpu.observability import (
-            parse_prometheus_text,
-            prometheus_text,
-        )
+    for _ in range(2):
+        eng.submit(np.concatenate(
+            [prefix, RNG.randint(0, 64, (3,))])[None, :], 3)
+        eng.run_until_idle()
+    h = fe.health()
+    pc = h.get("prefix_cache")
+    assert pc is not None and pc["hits"] >= 1
+    assert "hbm_saved_bytes" in pc and "evictions" in pc
+    # the spill tier reports through the same snapshot (both nested in
+    # the prefix stats and as its own healthz block)
+    assert "tier" in pc and "bytes" in pc["tier"]
+    kt = h.get("kv_tier")
+    assert kt is not None and set(kt["pages"]) == {"host", "disk"}
+    from paddle_tpu.observability import (
+        parse_prometheus_text,
+        prometheus_text,
+    )
 
-        series = parse_prometheus_text(prometheus_text())
-        for name in ("paddle_serving_prefix_hits_total",
-                     "paddle_serving_prefix_misses_total",
-                     "paddle_serving_prefix_evictions_total",
-                     "paddle_serving_prefix_cow_clones_total",
-                     "paddle_serving_prefix_shared_hbm_saved_bytes"):
-            assert name in series, (name, sorted(series)[:20])
-    finally:
-        eng.close()
-    _assert_drained(eng)
+    series = parse_prometheus_text(prometheus_text())
+    for name in ("paddle_serving_prefix_hits_total",
+                 "paddle_serving_prefix_misses_total",
+                 "paddle_serving_prefix_evictions_total",
+                 "paddle_serving_prefix_cow_clones_total",
+                 "paddle_serving_prefix_shared_hbm_saved_bytes"):
+        assert name in series, (name, sorted(series)[:20])
 
 
 # ---------------------------------------------------------- router affinity

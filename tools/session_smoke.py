@@ -1,0 +1,250 @@
+"""session-smoke — end-to-end gate for the session KV runtime.
+
+Three phases, every one asserting exactness and zero-leak accounting:
+
+1. **Three-turn chat over HTTP/SSE**: a real socket conversation —
+   every POST carries the same ``session_id``, every turn's prompt is
+   the FULL prior conversation (prompt + generated answer) plus a
+   fresh user tail — and every turn's stream must be token-exact vs
+   ``net.generate`` on that turn's whole prompt. Turns 2..3 must HIT
+   the prefix cache (the decode-written answer KV is reusable prefix
+   state), and ``/healthz`` must report the session with one turn per
+   POST.
+2. **Forced spill -> restore mid-conversation**: every refcount-0
+   page is evicted into the tier (spills counted), then the NEXT turn
+   of the same chat must restore its chain from host RAM (restores
+   counted) and still stream token-exact. Engine close must show zero
+   page-accounting drift.
+3. **Turn-2 economics** (the acceptance number): a subprocess
+   ``serve_bench --multi-turn`` record must show turn-2 TTFT within
+   1.2x of a plain warm-prefix hit, every conversation fully
+   tier-resident after a full forced spill, and the capacity sweep
+   growing monotonically with the simulated host budget.
+
+Exit 0 = gate passed. Wired as ``make session-smoke`` into
+``make smoke-all``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SEED = 17
+
+
+def _build_net(seed, hidden=32):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=hidden, intermediate_size=2 * hidden,
+        num_hidden_layers=2, num_attention_heads=4,
+    )
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _ref(net, ids, max_new):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    out = np.asarray(net.generate(
+        Tensor(jnp.asarray([list(ids)])), max_new_tokens=max_new
+    ).numpy())[0]
+    return [int(t) for t in out[len(ids):]]
+
+
+def _stream(port, ids, max_new, session_id=None):
+    from paddle_tpu.serving import stream_generate
+
+    body = {"input_ids": [int(t) for t in ids],
+            "max_new_tokens": max_new}
+    if session_id is not None:
+        body["session_id"] = session_id
+    events, _ = stream_generate("127.0.0.1", port, body)
+    toks = [d["token"] for e, d in events if e == "token"]
+    return events[-1][0], toks
+
+
+def _healthz(port):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request("GET", "/healthz")
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out
+
+
+def phase_chat_and_spill(failures):
+    """One conversation over real sockets: exact every turn, cache
+    hits from turn 2, forced spill -> restore mid-chat, zero leaks."""
+    import numpy as np
+
+    from paddle_tpu.serving import PagedServingEngine, ServingFrontend
+
+    net = _build_net(SEED)
+    ref = _build_net(SEED)
+    rng = np.random.RandomState(7)
+    eng = PagedServingEngine(
+        net, max_batch_size=4, max_seq_len=64, min_bucket=8,
+        page_size=8, prefix_cache=True, kv_tiering=True, sessions=True,
+    )
+    fe = ServingFrontend(eng).start()
+    try:
+        conv = [int(t) for t in rng.randint(0, 64, (16,))]
+        hits_at = []
+        for turn in range(3):
+            if turn > 0:
+                conv += [int(t) for t in rng.randint(0, 64, (4,))]
+            status, toks = _stream(fe.port, conv, 5,
+                                   session_id="smoke-chat")
+            if status != "done":
+                failures.append(f"turn {turn + 1} ended {status}")
+                return
+            want = _ref(ref, conv, 5)
+            if toks != want:
+                failures.append(
+                    f"turn {turn + 1} tokens {toks} != generate {want}"
+                )
+            conv += toks
+            hits_at.append(
+                (_healthz(fe.port).get("prefix_cache") or {})
+                .get("hits", 0)
+            )
+        if hits_at[2] <= hits_at[0]:
+            failures.append(
+                f"warm turns never hit the prefix cache: {hits_at}"
+            )
+        h = _healthz(fe.port)
+        sess = h.get("sessions") or {}
+        if sess.get("active", 0) < 1 or sess.get("turns", 0) != 3:
+            failures.append(f"session bookkeeping off: {sess}")
+        print(
+            f"session_smoke: 3-turn chat exact over SSE "
+            f"(prefix hits {hits_at[0]} -> {hits_at[2]}, "
+            f"session turns {sess.get('turns')})"
+        )
+
+        # ---- forced spill: the NEXT turn must restore, not re-prefill
+        spilled = eng.prefix_cache.evict(10 ** 9)
+        t0 = eng.kv_tier.stats()
+        if spilled < 1 or sum(t0["pages"].values()) < spilled:
+            failures.append(
+                f"forced eviction did not spill: {spilled} freed, "
+                f"tier {t0['pages']}"
+            )
+        conv += [int(t) for t in rng.randint(0, 64, (4,))]
+        status, toks = _stream(fe.port, conv, 5,
+                               session_id="smoke-chat")
+        want = _ref(ref, conv, 5)
+        if status != "done" or toks != want:
+            failures.append(
+                f"post-spill turn not exact: {status} {toks} vs {want}"
+            )
+        t1 = eng.kv_tier.stats()
+        restored = sum(t1["restores"].values())
+        if restored < 1:
+            failures.append(
+                f"post-spill turn restored nothing: {t0} -> {t1}"
+            )
+        if t1["crc_refused"] or t1["stale_refused"]:
+            failures.append(f"restore refusals on a healthy tier: {t1}")
+        print(
+            f"session_smoke: forced spill of {spilled} pages, turn 4 "
+            f"restored {restored} from host RAM and stayed exact"
+        )
+    finally:
+        fe.stop(close_engine=True)
+    pp = eng.page_pool.stats()
+    if pp["pages_in_use"] != 0 or pp["claims"] != pp["releases"]:
+        failures.append(f"page accounting drift after close: {pp}")
+
+
+def phase_turn2_economics(failures):
+    """serve_bench --multi-turn: turn-2 within 1.2x warm-prefix, full
+    tier residency, monotone capacity sweep."""
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "serve_bench.py"),
+        "--multi-turn", "--json", "--sessions", "16", "--turns", "3",
+        "--hidden", "128", "--max-seq", "256", "--prompt-min", "48",
+        "--prompt-max", "64", "--tail-max", "6", "--new-min", "4",
+        "--new-max", "10", "--spill-host-mb", "4",
+        # ample arena: pressure spills must not land on measured
+        # requests — the forced-spill phase covers tiering
+        "--num-pages", "384",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=900, env=env)
+    if proc.returncode != 0:
+        failures.append(
+            f"multi-turn bench failed rc={proc.returncode}: "
+            f"{proc.stderr[-800:]}"
+        )
+        return
+    rec = json.loads(proc.stdout)
+    n = rec["sessions"]
+    for t, pct in enumerate(rec["ttft_by_turn"]):
+        if pct.get("count") != n:
+            failures.append(
+                f"turn {t + 1} completed {pct.get('count')} of {n}"
+            )
+    ratio = rec.get("turn2_vs_warm_prefix_ttft_ratio")
+    if ratio is None or ratio > 1.2:
+        failures.append(
+            f"turn-2 TTFT not within 1.2x of warm-prefix: x{ratio}"
+        )
+    cap = rec["capacity"]
+    if cap["resident_sessions_after_full_spill"] != n:
+        failures.append(
+            f"not every conversation tier-resident after full spill: "
+            f"{cap}"
+        )
+    counts = [c["resident_sessions"] for c in cap["sweep"]]
+    if counts != sorted(counts) or counts[-1] != n:
+        failures.append(
+            f"capacity sweep not monotone to {n}: {cap['sweep']}"
+        )
+    if rec["kv_tier"]["crc_refused"] or rec["kv_tier"]["stale_refused"]:
+        failures.append(f"bench hit refusals: {rec['kv_tier']}")
+    print(
+        f"session_smoke: turn-2 TTFT x{ratio} of warm-prefix "
+        f"(p50 {1e3 * rec['ttft_by_turn'][1]['p50']:.2f}ms), "
+        f"{cap['resident_sessions_after_full_spill']}/{n} chats "
+        f"tier-resident after spilling {rec['forced_spill_pages']} "
+        f"pages, sweep {counts}"
+    )
+
+
+def main():
+    failures = []
+    phase_chat_and_spill(failures)
+    phase_turn2_economics(failures)
+    if failures:
+        print("session_smoke: FAILED")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("session_smoke: OK — 3-turn chat exact over SSE, spill -> "
+          "restore exact mid-conversation, turn-2 at warm-prefix "
+          "cost, zero leaked pages")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
